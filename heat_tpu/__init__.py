@@ -1,0 +1,37 @@
+"""heat_tpu — a TPU-native distributed n-dimensional array framework.
+
+Brand-new implementation of the capabilities of Heat (baurse/heat, see
+SURVEY.md): a NumPy-like distributed ``DNDarray`` with a ``split`` axis, the
+full elementwise/reduction/manipulation/linalg/statistics op surface, a
+counter-based parallel RNG, parallel I/O, an sklearn-style ML layer, and
+data-parallel NN training — architected for TPU: local tensors are
+``jax.Array`` shards on a pjit mesh, the MPI layer is replaced by an ICI/DCN
+collective facade (``jax.lax`` collectives under GSPMD/shard_map), and hot
+kernels drop to Pallas.
+
+Usage matches the reference: ``import heat_tpu as ht``.
+"""
+
+import os as _os
+
+# x64 must be enabled before any tracing so the int64/float64 members of the
+# type lattice are real (JAX disables them by default).
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from .core import *
+from . import core
+from .core import communication, devices, types, factories, manipulations, linalg
+from .core import random
+from . import cluster
+from . import classification
+from . import graph
+from . import naive_bayes
+from . import regression
+from . import spatial
+from . import nn
+from . import optim
+from . import utils
+
+__version__ = core.__version__

@@ -1,0 +1,215 @@
+"""Arithmetic operations (reference ``heat/core/arithmetics.py:63-989``).
+
+Every function funnels through the op engine in ``_operations.py``; local
+compute is a fused XLA kernel, cross-device reduction is a GSPMD ``psum``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import _operations, types
+from .dndarray import DNDarray
+
+__all__ = [
+    "add",
+    "bitwise_and",
+    "bitwise_not",
+    "bitwise_or",
+    "bitwise_xor",
+    "cumprod",
+    "cumproduct",
+    "cumsum",
+    "diff",
+    "div",
+    "divide",
+    "floordiv",
+    "floor_divide",
+    "fmod",
+    "invert",
+    "left_shift",
+    "mod",
+    "mul",
+    "multiply",
+    "neg",
+    "negative",
+    "pos",
+    "positive",
+    "pow",
+    "power",
+    "prod",
+    "remainder",
+    "right_shift",
+    "sub",
+    "subtract",
+    "sum",
+]
+
+
+def add(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise addition (reference ``arithmetics.py:63``)."""
+    return _operations._binary_op(jnp.add, t1, t2, out, where)
+
+
+def bitwise_and(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise AND of integer/bool arrays (reference ``:121``)."""
+    _check_int_args(t1, t2, "bitwise_and")
+    return _operations._binary_op(jnp.bitwise_and, t1, t2, out, where)
+
+
+def bitwise_or(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise OR (reference ``:175``)."""
+    _check_int_args(t1, t2, "bitwise_or")
+    return _operations._binary_op(jnp.bitwise_or, t1, t2, out, where)
+
+
+def bitwise_xor(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise XOR (reference ``:229``)."""
+    _check_int_args(t1, t2, "bitwise_xor")
+    return _operations._binary_op(jnp.bitwise_xor, t1, t2, out, where)
+
+
+def _check_int_args(t1, t2, name):
+    for t in (t1, t2):
+        if isinstance(t, DNDarray) and types.heat_type_is_inexact(t.dtype):
+            raise TypeError(f"{name} is only supported for integer or boolean arrays")
+        if isinstance(t, float):
+            raise TypeError(f"{name} is only supported for integer or boolean operands")
+
+
+def cumprod(a: DNDarray, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative product along ``axis`` (reference ``:283``)."""
+    return _operations._cum_op(a, jnp.cumprod, axis, 1, out, dtype)
+
+
+cumproduct = cumprod
+
+
+def cumsum(a: DNDarray, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative sum along ``axis`` (reference ``:330``)."""
+    return _operations._cum_op(a, jnp.cumsum, axis, 0, out, dtype)
+
+
+def diff(a: DNDarray, n: int = 1, axis: int = -1) -> DNDarray:
+    """n-th discrete difference along ``axis`` (reference ``:377``)."""
+    from .stride_tricks import sanitize_axis
+
+    if n == 0:
+        return a
+    if n < 0:
+        raise ValueError(f"diff requires that n be a positive number, got {n}")
+    axis = sanitize_axis(a.shape, axis)
+    logical = a._logical()
+    res = jnp.diff(logical, n=n, axis=axis)
+    split = a.split
+    if split is not None and res.shape[split] == 0:
+        split = None
+    return DNDarray.from_logical(res, split, a.device, a.comm)
+
+
+def div(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise true division (reference ``:443``)."""
+    return _operations._binary_op(jnp.true_divide, t1, t2, out, where)
+
+
+divide = div
+
+
+def floordiv(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise floor division (reference ``:528``)."""
+    return _operations._binary_op(jnp.floor_divide, t1, t2, out, where)
+
+
+floor_divide = floordiv
+
+
+def fmod(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise C-style remainder (reference ``:576``)."""
+    return _operations._binary_op(jnp.fmod, t1, t2, out, where)
+
+
+def invert(a: DNDarray, out=None) -> DNDarray:
+    """Element-wise bitwise NOT (reference ``:624``)."""
+    if types.heat_type_is_inexact(a.dtype):
+        raise TypeError("invert is only supported for integer or boolean arrays")
+    return _operations._local_op(jnp.invert, a, out)
+
+
+bitwise_not = invert
+
+
+def left_shift(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise left bit-shift (reference ``:664``)."""
+    _check_int_args(t1, t2, "left_shift")
+    return _operations._binary_op(jnp.left_shift, t1, t2, out, where)
+
+
+def mod(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise Python-style modulo (reference ``:704``)."""
+    return _operations._binary_op(jnp.mod, t1, t2, out, where)
+
+
+remainder = mod
+
+
+def mul(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise multiplication (reference ``:746``)."""
+    return _operations._binary_op(jnp.multiply, t1, t2, out, where)
+
+
+multiply = mul
+
+
+def neg(a: DNDarray, out=None) -> DNDarray:
+    """Element-wise negation (reference ``:788``)."""
+    return _operations._local_op(jnp.negative, a, out)
+
+
+negative = neg
+
+
+def pos(a: DNDarray, out=None) -> DNDarray:
+    """Element-wise unary plus (reference ``:820``)."""
+    return _operations._local_op(jnp.positive, a, out)
+
+
+positive = pos
+
+
+def pow(t1, t2, out=None, where=None) -> DNDarray:  # noqa: A001
+    """Element-wise exponentiation (reference ``:852``)."""
+    return _operations._binary_op(jnp.power, t1, t2, out, where)
+
+
+power = pow
+
+
+def prod(a: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:
+    """Product reduction (reference ``:902``): local product + ``psum``-style
+    all-multiply when the split axis is reduced."""
+    return _operations._reduce_op(a, jnp.prod, 1, axis=axis, out=out, keepdims=keepdims)
+
+
+def right_shift(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise right bit-shift (reference ``:922``)."""
+    _check_int_args(t1, t2, "right_shift")
+    return _operations._binary_op(jnp.right_shift, t1, t2, out, where)
+
+
+def sub(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise subtraction (reference ``:962``)."""
+    return _operations._binary_op(jnp.subtract, t1, t2, out, where)
+
+
+subtract = sub
+
+
+def sum(a: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:  # noqa: A001
+    """Sum reduction (reference ``:946``): the canonical local-reduce +
+    ``Allreduce`` stack of the reference (``_operations.py:440-445``) becomes
+    one XLA program with a ``psum`` over the mesh."""
+    return _operations._reduce_op(a, jnp.sum, 0, axis=axis, out=out, keepdims=keepdims)

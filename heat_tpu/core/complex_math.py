@@ -1,0 +1,35 @@
+"""Complex-number operations (reference ``heat/core/complex_math.py:18-110``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations, types
+from .dndarray import DNDarray
+
+__all__ = ["angle", "conj", "conjugate", "imag", "real"]
+
+
+def angle(x: DNDarray, deg: bool = False, out=None) -> DNDarray:
+    """Element-wise argument of a complex number (reference ``complex_math.py:18``)."""
+    return _operations._local_op(lambda a: jnp.angle(a, deg=deg), x, out)
+
+
+def conjugate(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise complex conjugate (reference ``:50``)."""
+    return _operations._local_op(jnp.conjugate, x, out)
+
+
+conj = conjugate
+
+
+def imag(x: DNDarray) -> DNDarray:
+    """Imaginary part (reference ``:78``)."""
+    return _operations._local_op(jnp.imag, x)
+
+
+def real(x: DNDarray) -> DNDarray:
+    """Real part (reference ``:94``)."""
+    if types.heat_type_is_complexfloating(x.dtype):
+        return _operations._local_op(jnp.real, x)
+    return x

@@ -1,0 +1,18 @@
+"""Mathematical constants exposed at the ``ht.`` namespace.
+
+Parity with the reference's ``heat/core/constants.py`` (pi, e, inf, nan).
+"""
+
+import numpy as np
+
+__all__ = ["e", "Euler", "inf", "Inf", "Infty", "Infinity", "nan", "NaN", "pi"]
+
+e = float(np.e)
+Euler = e
+inf = float(np.inf)
+Inf = inf
+Infty = inf
+Infinity = inf
+nan = float(np.nan)
+NaN = nan
+pi = float(np.pi)

@@ -1,0 +1,92 @@
+"""Device abstraction (reference ``heat/core/devices.py``).
+
+The reference pins each MPI rank to a CPU or a round-robin CUDA device
+(``devices.py:79-100``). Under single-controller JAX the platform is chosen at
+backend init; a :class:`Device` here names a *platform* ("tpu" or "cpu") whose
+actual device placement is governed by the mesh in
+:class:`~heat_tpu.core.communication.TPUCommunication`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+
+__all__ = ["Device", "cpu", "get_device", "sanitize_device", "use_device"]
+
+
+class Device:
+    """Platform identity of a DNDarray (reference ``devices.py:17``)."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.__device_type = str(device_type)
+        self.__device_id = int(device_id)
+
+    @property
+    def device_type(self) -> str:
+        return self.__device_type
+
+    @property
+    def device_id(self) -> int:
+        return self.__device_id
+
+    def __repr__(self) -> str:
+        return f"device({str(self)!r})"
+
+    def __str__(self) -> str:
+        return f"{self.device_type}:{self.device_id}"
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Device):
+            return self.device_type == other.device_type and self.device_id == other.device_id
+        if isinstance(other, str):
+            try:
+                return self == sanitize_device(other)
+            except (ValueError, TypeError):
+                return False
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(str(self))
+
+
+cpu = Device("cpu", 0)
+"""The host-CPU platform singleton (reference ``devices.py:79``)."""
+
+# accelerator singleton: present when the JAX backend is TPU (or GPU)
+_platform = jax.default_backend()
+if _platform not in ("cpu",):
+    globals()[_platform] = Device(_platform, 0)
+    __default_device = globals()[_platform]
+else:
+    __default_device = cpu
+
+# convenience: expose `tpu` if a TPU backend exists
+tpu: Optional[Device] = globals().get("tpu")
+
+
+def get_device() -> Device:
+    """Default device for new arrays (reference ``get_device``, ``devices.py:113``)."""
+    return __default_device
+
+
+def sanitize_device(device: Union[str, Device, None]) -> Device:
+    """Normalize a device argument (reference ``sanitize_device``, ``devices.py:126``)."""
+    if device is None:
+        return get_device()
+    if isinstance(device, Device):
+        return device
+    name = str(device).split(":")[0].strip().lower()
+    if name == "cpu":
+        return cpu
+    known = globals().get(name)
+    if isinstance(known, Device):
+        return known
+    raise ValueError(f"Unknown device, must be 'cpu' or '{_platform}', got {device!r}")
+
+
+def use_device(device: Union[str, Device, None] = None) -> None:
+    """Set the default device (reference ``use_device``, ``devices.py:157``)."""
+    global __default_device
+    __default_device = sanitize_device(device)

@@ -1,0 +1,65 @@
+"""Exponential and logarithmic operations (reference ``heat/core/exponential.py:26-318``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = ["exp", "expm1", "exp2", "log", "log2", "log10", "log1p", "logaddexp", "logaddexp2", "sqrt", "square"]
+
+
+def exp(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise e**x (reference ``exponential.py:26``)."""
+    return _operations._local_op(jnp.exp, x, out)
+
+
+def expm1(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise e**x - 1 (reference ``:60``)."""
+    return _operations._local_op(jnp.expm1, x, out)
+
+
+def exp2(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise 2**x (reference ``:94``)."""
+    return _operations._local_op(jnp.exp2, x, out)
+
+
+def log(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise natural log (reference ``:128``)."""
+    return _operations._local_op(jnp.log, x, out)
+
+
+def log2(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise base-2 log (reference ``:162``)."""
+    return _operations._local_op(jnp.log2, x, out)
+
+
+def log10(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise base-10 log (reference ``:196``)."""
+    return _operations._local_op(jnp.log10, x, out)
+
+
+def log1p(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise log(1+x) (reference ``:230``)."""
+    return _operations._local_op(jnp.log1p, x, out)
+
+
+def logaddexp(t1, t2, out=None, where=None) -> DNDarray:
+    """log(exp(x1) + exp(x2)) (reference ``:250``)."""
+    return _operations._binary_op(jnp.logaddexp, t1, t2, out, where)
+
+
+def logaddexp2(t1, t2, out=None, where=None) -> DNDarray:
+    """log2(2**x1 + 2**x2) (reference ``:270``)."""
+    return _operations._binary_op(jnp.logaddexp2, t1, t2, out, where)
+
+
+def sqrt(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise square root (reference ``:264``)."""
+    return _operations._local_op(jnp.sqrt, x, out)
+
+
+def square(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise square (reference ``:298``)."""
+    return _operations._local_op(jnp.square, x, out)

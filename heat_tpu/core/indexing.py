@@ -1,0 +1,45 @@
+"""Index-producing operations (reference ``heat/core/indexing.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations, types
+from .dndarray import DNDarray
+
+__all__ = ["nonzero", "where"]
+
+
+def nonzero(x: DNDarray) -> DNDarray:
+    """Indices of nonzero elements as an (nnz, ndim) array (reference
+    ``indexing.py:16``).
+
+    Dynamic-shape op: the result is materialized replicated (host-synced
+    count), the documented semantic for shape-data-dependent ops on the XLA
+    backend (SURVEY.md §7, hard part 4).
+    """
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
+    logical = x._logical()
+    idx = jnp.nonzero(logical)
+    stacked = jnp.stack(idx, axis=1) if x.ndim > 0 else jnp.zeros((0, 0), jnp.int64)
+    split = 0 if x.split is not None else None
+    return DNDarray.from_logical(stacked, split, x.device, x.comm)
+
+
+def where(cond, x=None, y=None) -> DNDarray:
+    """Ternary select / nonzero (reference ``indexing.py:91``)."""
+    if x is None and y is None:
+        return nonzero(cond)
+    if x is None or y is None:
+        raise TypeError("either both or neither of x and y should be given")
+    if not isinstance(cond, DNDarray):
+        raise TypeError(f"expected cond to be a DNDarray, but was {type(cond)}")
+
+    from . import arithmetics
+
+    # cond*x + (1-cond)*y with proper promotion, via the binary op engine
+    c = cond.astype(types.canonical_heat_type(jnp.bool_))
+    picked_x = _operations._binary_op(lambda c_, x_: jnp.where(c_, x_, 0), c, x)
+    picked_y = _operations._binary_op(lambda c_, y_: jnp.where(c_, 0, y_), c, y)
+    return arithmetics.add(picked_x, picked_y)

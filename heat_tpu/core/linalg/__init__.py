@@ -1,0 +1,6 @@
+"""Linear algebra for distributed DNDarrays (reference ``heat/core/linalg/``)."""
+
+from .basics import *
+from .qr import *
+from .solver import *
+from .svd import *

@@ -1,0 +1,98 @@
+"""QR decomposition (reference ``heat/core/linalg/qr.py:17-1042``).
+
+The reference implements a tiled CAQR over ``SquareDiagTiles`` with explicit
+Householder-merge sends between ranks (``__split0_r_calc`` ``:319``,
+``__split0_merge_tile_rows`` ``:490``, ``__split0_send_q_to_diag_pr``
+``:609``). Re-derived here as **blockwise TSQR** — the communication-optimal
+tall-skinny QR that maps directly onto the mesh (SURVEY.md §7, M5):
+
+1. each device QR-factors its local row block             (MXU)
+2. the stacked small R factors are QR-factored once       (replicated)
+3. local Qs are combined with the merge Q's row blocks    (MXU)
+
+For ``split=1`` or replicated operands the factorization is a single XLA
+``qr`` on the logical array (the reference's column-block Bcast loop
+``__split1_qr_loop`` ``:866`` is XLA's internal blocking here).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..dndarray import DNDarray
+from .. import types
+
+__all__ = ["qr"]
+
+QR = collections.namedtuple("QR", "Q, R")
+
+
+def qr(a: DNDarray, tiles_per_proc: int = 1, calc_q: bool = True, overwrite_a: bool = False) -> QR:
+    """Reduced QR factorization ``a = Q @ R`` (reference ``qr.py:17``).
+
+    ``tiles_per_proc`` is accepted for API parity; TSQR's block size is the
+    canonical shard, so it has no effect.
+    """
+    if not isinstance(a, DNDarray):
+        raise TypeError(f"'a' must be a DNDarray, got {type(a)}")
+    if a.ndim != 2:
+        raise ValueError(f"qr requires a 2-D array, got {a.ndim}-D")
+
+    n, m = a.shape
+    if a.split == 0 and a.comm.size > 1 and n >= m * a.comm.size:
+        return _tsqr(a, calc_q)
+
+    logical = a._logical()
+    q, r = jnp.linalg.qr(logical, mode="reduced")
+    q_d = DNDarray.from_logical(q, a.split, a.device, a.comm) if calc_q else None
+    r_split = None if a.split is None else (1 if a.split == 1 else None)
+    r_d = DNDarray.from_logical(r, r_split, a.device, a.comm)
+    return QR(q_d, r_d)
+
+
+def _tsqr(a: DNDarray, calc_q: bool) -> QR:
+    """Two-level TSQR over the mesh via shard_map."""
+    from jax import shard_map
+
+    comm = a.comm
+    nprocs = comm.size
+    n, m = a.shape
+    physical = a.filled(0) if a.pad else a.larray
+    spec_split0 = comm.spec(2, 0)
+    spec_rep = comm.spec(2, None)
+
+    def local_qr(x):
+        # x: (chunk, m) local block → q (chunk, m), r (m, m)
+        q, r = jnp.linalg.qr(x, mode="reduced")
+        return q, r
+
+    def body(x):
+        q1, r1 = local_qr(x)
+        # gather all local R factors: (nprocs * m, m), replicated
+        r_stack = jax.lax.all_gather(r1, comm.axis_name, axis=0, tiled=True)
+        q2, r2 = jnp.linalg.qr(r_stack, mode="reduced")
+        # my row block of q2
+        idx = jax.lax.axis_index(comm.axis_name)
+        my_q2 = jax.lax.dynamic_slice_in_dim(q2, idx * m, m, axis=0)
+        q_final = q1 @ my_q2
+        return q_final, r2
+
+    fn = shard_map(
+        body,
+        mesh=comm.mesh,
+        in_specs=spec_split0,
+        out_specs=(spec_split0, spec_rep),
+        check_vma=False,
+    )
+    q_phys, r_rep = jax.jit(fn)(physical)
+    # r_rep is replicated per device then stacked by shard_map on axis 0 of
+    # the *global* result; out_specs=P() replication gives global (m, m)
+    q_d = None
+    if calc_q:
+        q_d = DNDarray(q_phys, (n, m), types.canonical_heat_type(q_phys.dtype), 0, a.device, a.comm)
+    r_d = DNDarray.from_logical(r_rep, None, a.device, a.comm)
+    return QR(q_d, r_d)

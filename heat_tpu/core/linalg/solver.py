@@ -1,0 +1,119 @@
+"""Iterative solvers (reference ``heat/core/linalg/solver.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import arithmetics, factories
+from ..dndarray import DNDarray
+from .basics import matmul, dot, transpose
+
+__all__ = ["cg", "lanczos"]
+
+
+def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
+    """Conjugate gradients on DNDarray ops (reference ``solver.py:13-67``).
+
+    Every iteration is two distributed matvecs plus psum'd inner products —
+    all fused by XLA.
+    """
+    if not isinstance(A, DNDarray) or not isinstance(b, DNDarray) or not isinstance(x0, DNDarray):
+        raise TypeError("A, b and x0 need to be of type ht.DNDarray")
+    if A.ndim != 2:
+        raise RuntimeError("A needs to be a 2D matrix")
+    if b.ndim != 1:
+        raise RuntimeError("b needs to be a 1D vector")
+    if x0.ndim != 1:
+        raise RuntimeError("c needs to be a 1D vector")
+
+    r = arithmetics.sub(b, matmul(A, x0.reshape((x0.size, 1))).reshape((b.size,)))
+    p = r
+    rsold = dot(r, r)
+    x = x0
+
+    for _ in range(len(b)):
+        Ap = matmul(A, p.reshape((p.size, 1))).reshape((b.size,))
+        alpha = arithmetics.div(rsold, dot(p, Ap))
+        x = arithmetics.add(x, arithmetics.mul(alpha, p))
+        r = arithmetics.sub(r, arithmetics.mul(alpha, Ap))
+        rsnew = dot(r, r)
+        if float(rsnew.item()) ** 0.5 < 1e-10:
+            return x
+        p = arithmetics.add(r, arithmetics.mul(arithmetics.div(rsnew, rsold), p))
+        rsold = rsnew
+    return x
+
+
+def lanczos(
+    A: DNDarray,
+    m: int,
+    v0: Optional[DNDarray] = None,
+    V_out: Optional[DNDarray] = None,
+    T_out: Optional[DNDarray] = None,
+):
+    """Lanczos tridiagonalization (reference ``solver.py:68-184``).
+
+    Returns ``(V, T)`` with ``A ≈ V @ T @ V.T``; used by spectral clustering
+    exactly like the reference (``cluster/spectral.py:127``).
+    """
+    if not isinstance(A, DNDarray):
+        raise TypeError(f"A needs to be of type ht.DNDarray, but was {type(A)}")
+    if A.ndim != 2 or A.shape[0] != A.shape[1]:
+        raise RuntimeError("A needs to be a square matrix")
+
+    n = A.shape[0]
+    m = int(m)
+    from .. import random as ht_random
+    from .. import exponential
+
+    if v0 is None:
+        vr = ht_random.rand(n, split=A.split and 0, comm=A.comm)
+        norm0 = exponential.sqrt(dot(vr, vr))
+        v0 = arithmetics.div(vr, norm0)
+
+    alphas = []
+    betas = [0.0]
+    vs = [v0]
+    w = matmul(A, v0.reshape((n, 1))).reshape((n,))
+    alpha = dot(w, v0)
+    w = arithmetics.sub(w, arithmetics.mul(alpha, v0))
+    alphas.append(float(alpha.item()))
+
+    for i in range(1, m):
+        beta = float(exponential.sqrt(dot(w, w)).item())
+        if beta < 1e-10:
+            # restart with a random orthogonal vector
+            vr = ht_random.rand(n, split=v0.split, comm=A.comm)
+            # orthogonalize against previous vectors
+            for v in vs:
+                proj = dot(vr, v)
+                vr = arithmetics.sub(vr, arithmetics.mul(proj, v))
+            nrm = exponential.sqrt(dot(vr, vr))
+            vi = arithmetics.div(vr, nrm)
+        else:
+            vi = arithmetics.div(w, beta)
+        w = matmul(A, vi.reshape((n, 1))).reshape((n,))
+        alpha = dot(w, vi)
+        w = arithmetics.sub(w, arithmetics.mul(alpha, vi))
+        w = arithmetics.sub(w, arithmetics.mul(beta, vs[-1]))
+        alphas.append(float(alpha.item()))
+        betas.append(beta)
+        vs.append(vi)
+
+    from .. import manipulations
+
+    V = manipulations.stack(vs, axis=1)  # (n, m)
+    T_np = jnp.diag(jnp.asarray(alphas))
+    if m > 1:
+        off = jnp.asarray(betas[1:])
+        T_np = T_np + jnp.diag(off, k=1) + jnp.diag(off, k=-1)
+    T = DNDarray.from_logical(T_np, None, A.device, A.comm)
+    if V_out is not None:
+        V_out.larray = V.resplit(V_out.split).larray
+        if T_out is not None:
+            T_out.larray = T.larray
+            return V_out, T_out
+        return V_out, T
+    return V, T

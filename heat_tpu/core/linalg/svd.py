@@ -1,0 +1,55 @@
+"""Singular value decomposition.
+
+The reference ships only an empty stub (``heat/core/linalg/svd.py:1-5``,
+"Future file for SVD functions"); this implementation therefore *exceeds*
+reference parity: tall-skinny split-0 matrices are decomposed via TSQR
+(QR on the mesh, then SVD of the small R), everything else by XLA's fused
+SVD on the logical array.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..dndarray import DNDarray
+
+__all__ = ["svd"]
+
+SVD = collections.namedtuple("SVD", "U, S, V")
+
+
+def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
+    """Reduced SVD ``a = U @ diag(S) @ V.T``."""
+    if not isinstance(a, DNDarray):
+        raise TypeError(f"'a' must be a DNDarray, got {type(a)}")
+    if a.ndim != 2:
+        raise ValueError("svd requires a 2-D array")
+    if full_matrices:
+        raise NotImplementedError("only reduced SVD (full_matrices=False) is supported")
+
+    n, m = a.shape
+    if a.split == 0 and a.comm.size > 1 and n >= m * a.comm.size:
+        from .qr import qr
+        from .basics import matmul
+
+        q, r = qr(a)
+        u_r, s, vt = jnp.linalg.svd(r._logical(), full_matrices=False)
+        if not compute_uv:
+            return DNDarray.from_logical(s, None, a.device, a.comm)
+        u_r_d = DNDarray.from_logical(u_r, None, a.device, a.comm)
+        U = matmul(q, u_r_d)
+        S = DNDarray.from_logical(s, None, a.device, a.comm)
+        V = DNDarray.from_logical(vt.T, None, a.device, a.comm)
+        return SVD(U, S, V)
+
+    u, s, vt = jnp.linalg.svd(a._logical(), full_matrices=False)
+    if not compute_uv:
+        return DNDarray.from_logical(s, None, a.device, a.comm)
+    return SVD(
+        DNDarray.from_logical(u, a.split if a.split == 0 else None, a.device, a.comm),
+        DNDarray.from_logical(s, None, a.device, a.comm),
+        DNDarray.from_logical(vt.T, None, a.device, a.comm),
+    )

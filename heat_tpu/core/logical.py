@@ -1,0 +1,110 @@
+"""Logical operations (reference ``heat/core/logical.py:38-531``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import _operations, types
+from .dndarray import DNDarray
+
+__all__ = [
+    "all",
+    "allclose",
+    "any",
+    "isclose",
+    "isfinite",
+    "isinf",
+    "isnan",
+    "isneginf",
+    "isposinf",
+    "logical_and",
+    "logical_not",
+    "logical_or",
+    "logical_xor",
+    "signbit",
+]
+
+
+def all(x: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:  # noqa: A001
+    """Test whether all elements evaluate True (reference ``logical.py:38``):
+    local reduce + ``Allreduce(LAND)`` in the reference, one fused reduce
+    here."""
+    return _operations._reduce_op(
+        x, lambda a, axis=None, keepdims=False: jnp.all(a != 0, axis=axis, keepdims=keepdims),
+        1, axis=axis, out=out, keepdims=keepdims,
+    )
+
+
+def allclose(x: DNDarray, y: DNDarray, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
+    """Global closeness test (reference ``:130``)."""
+    close = isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    return bool(all(close).item())
+
+
+def any(x: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:  # noqa: A001
+    """Test whether any element evaluates True (reference ``:190``)."""
+    return _operations._reduce_op(
+        x, lambda a, axis=None, keepdims=False: jnp.any(a != 0, axis=axis, keepdims=keepdims),
+        0, axis=axis, out=out, keepdims=keepdims,
+    )
+
+
+def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> DNDarray:
+    """Element-wise closeness (reference ``:250``)."""
+    return _operations._binary_op(
+        jnp.isclose, x, y, fn_kwargs={"rtol": rtol, "atol": atol, "equal_nan": equal_nan}
+    )
+
+
+def isfinite(x: DNDarray) -> DNDarray:
+    """Element-wise finiteness test (reference ``:310``)."""
+    return _operations._local_op(jnp.isfinite, x)
+
+
+def isinf(x: DNDarray) -> DNDarray:
+    """Element-wise infinity test (reference ``:340``)."""
+    return _operations._local_op(jnp.isinf, x)
+
+
+def isnan(x: DNDarray) -> DNDarray:
+    """Element-wise NaN test (reference ``:370``)."""
+    return _operations._local_op(jnp.isnan, x)
+
+
+def isneginf(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise -inf test (reference ``:400``)."""
+    return _operations._local_op(jnp.isneginf, x, out)
+
+
+def isposinf(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise +inf test (reference ``:420``)."""
+    return _operations._local_op(jnp.isposinf, x, out)
+
+
+def logical_and(t1, t2) -> DNDarray:
+    """Element-wise logical AND (reference ``:440``)."""
+    return _operations._binary_op(jnp.logical_and, t1, t2)
+
+
+def logical_not(t: DNDarray, out=None) -> DNDarray:
+    """Element-wise logical NOT (reference ``:460``)."""
+    return _operations._local_op(jnp.logical_not, t, out)
+
+
+def logical_or(t1, t2) -> DNDarray:
+    """Element-wise logical OR (reference ``:480``)."""
+    return _operations._binary_op(jnp.logical_or, t1, t2)
+
+
+def logical_xor(t1, t2) -> DNDarray:
+    """Element-wise logical XOR (reference ``:500``)."""
+    return _operations._binary_op(jnp.logical_xor, t1, t2)
+
+
+def signbit(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise signbit test (reference ``:520``)."""
+    return _operations._local_op(jnp.signbit, x, out)

@@ -1,0 +1,514 @@
+"""Shape and data manipulation operations (reference ``heat/core/manipulations.py``).
+
+Strategy on the XLA backend: ops that do not touch the split axis run on the
+physical (padded) array with zero communication; ops that cross or transform
+the split axis run on the *logical* global view and re-shard the result —
+the data motion (the reference's Alltoallv machinery for ``reshape``
+``:1817``, sample-sort for ``sort`` ``:2263``, Allgatherv for ``unique``
+``:3051``) is scheduled by XLA instead of hand-written collectives.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import factories, types
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis, sanitize_shape
+
+__all__ = [
+    "balance",
+    "broadcast_arrays",
+    "broadcast_to",
+    "column_stack",
+    "concatenate",
+    "diag",
+    "diagonal",
+    "dsplit",
+    "dstack",
+    "expand_dims",
+    "flatten",
+    "flip",
+    "fliplr",
+    "flipud",
+    "hsplit",
+    "hstack",
+    "moveaxis",
+    "pad",
+    "ravel",
+    "redistribute",
+    "repeat",
+    "reshape",
+    "resplit",
+    "roll",
+    "rot90",
+    "row_stack",
+    "shape",
+    "sort",
+    "split",
+    "squeeze",
+    "stack",
+    "swapaxes",
+    "tile",
+    "topk",
+    "unique",
+    "vsplit",
+    "vstack",
+]
+
+
+def _wrap_logical(arr, split, like: DNDarray, dtype=None) -> DNDarray:
+    return DNDarray.from_logical(arr, split, like.device, like.comm, dtype=dtype)
+
+
+def balance(array: DNDarray, copy: bool = False) -> DNDarray:
+    """Balanced copy (reference ``manipulations.py:73``): canonical layout is
+    always balanced, so this is identity/copy."""
+    if copy:
+        from . import memory
+
+        return memory.copy(array)
+    return array
+
+
+def broadcast_arrays(*arrays: DNDarray) -> List[DNDarray]:
+    """Broadcast arrays against each other (reference ``:100``)."""
+    from .stride_tricks import broadcast_shapes
+
+    target = broadcast_shapes(*[a.shape for a in arrays])
+    return [broadcast_to(a, target) for a in arrays]
+
+
+def broadcast_to(x: DNDarray, shape) -> DNDarray:
+    """Broadcast to a new shape (reference ``:140``)."""
+    shape = sanitize_shape(shape)
+    out_split = None
+    if x.split is not None:
+        out_split = x.split + (len(shape) - x.ndim)
+        if x.shape[x.split] == 1 and shape[out_split] != 1:
+            x = x.resplit(None)
+            out_split = None
+    res = jnp.broadcast_to(x._logical(), shape)
+    return _wrap_logical(res, out_split, x)
+
+
+def column_stack(arrays: Sequence[DNDarray]) -> DNDarray:
+    """Stack 1-D/2-D arrays as columns (reference ``:188`` family)."""
+    prepped = [a.reshape((a.shape[0], 1)) if a.ndim == 1 else a for a in arrays]
+    return concatenate(prepped, axis=1)
+
+
+def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
+    """Join arrays along an existing axis (reference ``:188``)."""
+    arrays = list(arrays)
+    if len(arrays) < 1:
+        raise ValueError("need at least one array to concatenate")
+    for a in arrays:
+        if not isinstance(a, DNDarray):
+            raise TypeError(f"inputs must be DNDarrays, found {type(a)}")
+    axis = sanitize_axis(arrays[0].shape, axis)
+    out_split = arrays[0].split
+    for a in arrays[1:]:
+        if a.split != out_split:
+            a_splits = {x.split for x in arrays}
+            non_none = [s for s in a_splits if s is not None]
+            out_split = non_none[0] if non_none else None
+            break
+    dtype = types.result_type(*arrays)
+    logicals = [a._logical().astype(dtype.jax_type()) for a in arrays]
+    res = jnp.concatenate(logicals, axis=axis)
+    return _wrap_logical(res, out_split, arrays[0], dtype=dtype)
+
+
+def diag(a: DNDarray, offset: int = 0) -> DNDarray:
+    """Extract or construct a diagonal (reference ``:512``)."""
+    if a.ndim == 1:
+        res = jnp.diag(a._logical(), k=offset)
+        return _wrap_logical(res, 0 if a.split is not None else None, a)
+    return diagonal(a, offset=offset)
+
+
+def diagonal(a: DNDarray, offset: int = 0, dim1: int = 0, dim2: int = 1) -> DNDarray:
+    """Extract a diagonal (reference ``:587``)."""
+    res = jnp.diagonal(a._logical(), offset=offset, axis1=dim1, axis2=dim2)
+    out_split = None
+    if a.split is not None:
+        out_split = res.ndim - 1 if a.split in (dim1, dim2) else 0
+    return _wrap_logical(res, out_split, a)
+
+
+def dsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    """Split along axis 2 (reference ``:700`` family)."""
+    return split(x, indices_or_sections, axis=2)
+
+
+def dstack(arrays: Sequence[DNDarray]) -> DNDarray:
+    """Stack along the third axis (reference ``:760``)."""
+    prepped = []
+    for a in arrays:
+        if a.ndim == 1:
+            a = a.reshape((1, a.shape[0], 1))
+        elif a.ndim == 2:
+            a = a.reshape((a.shape[0], a.shape[1], 1))
+        prepped.append(a)
+    return concatenate(prepped, axis=2)
+
+
+def expand_dims(a: DNDarray, axis: int) -> DNDarray:
+    """Insert a size-1 dimension (reference ``:840``). Zero communication:
+    operates on the physical array; the split index shifts."""
+    axis = sanitize_axis(tuple(list(a.shape) + [1]), axis)
+    res = jnp.expand_dims(a.larray, axis)
+    out_split = a.split if a.split is None or a.split < axis else a.split + 1
+    gshape = list(a.shape)
+    gshape.insert(axis, 1)
+    return DNDarray(res, tuple(gshape), a.dtype, out_split, a.device, a.comm)
+
+
+def flatten(a: DNDarray) -> DNDarray:
+    """Collapse to 1-D (reference ``:900``)."""
+    res = a._logical().reshape(-1)
+    return _wrap_logical(res, 0 if a.split is not None else None, a)
+
+
+def flip(a: DNDarray, axis=None) -> DNDarray:
+    """Reverse element order along axes (reference ``:960``)."""
+    if axis is None:
+        axes = tuple(range(a.ndim))
+    else:
+        axes = (sanitize_axis(a.shape, axis),) if isinstance(axis, int) else tuple(
+            sanitize_axis(a.shape, ax) for ax in axis
+        )
+    if a.split is not None and a.split in axes:
+        res = jnp.flip(a._logical(), axis=axes)
+        return _wrap_logical(res, a.split, a)
+    res = jnp.flip(a.larray, axis=axes)
+    return DNDarray(res, a.gshape, a.dtype, a.split, a.device, a.comm)
+
+
+def fliplr(a: DNDarray) -> DNDarray:
+    """Flip along axis 1 (reference ``:1020``)."""
+    if a.ndim < 2:
+        raise IndexError("expected array with at least 2 dimensions")
+    return flip(a, 1)
+
+
+def flipud(a: DNDarray) -> DNDarray:
+    """Flip along axis 0 (reference ``:1040``)."""
+    return flip(a, 0)
+
+
+def hsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    """Split along axis 1 (axis 0 for 1-D) (reference family)."""
+    if x.ndim < 2:
+        return split(x, indices_or_sections, axis=0)
+    return split(x, indices_or_sections, axis=1)
+
+
+def hstack(arrays: Sequence[DNDarray]) -> DNDarray:
+    """Stack horizontally (reference ``:1100``)."""
+    if all(a.ndim == 1 for a in arrays):
+        return concatenate(arrays, axis=0)
+    return concatenate(arrays, axis=1)
+
+
+def moveaxis(x: DNDarray, source, destination) -> DNDarray:
+    """Move axes to new positions (reference ``:1108``)."""
+    if isinstance(source, int):
+        source = (source,)
+    if isinstance(destination, int):
+        destination = (destination,)
+    source = tuple(sanitize_axis(x.shape, s) for s in source)
+    destination = tuple(sanitize_axis(x.shape, d) for d in destination)
+    if len(source) != len(destination):
+        raise ValueError("source and destination arguments must have the same number of elements")
+    order = [n for n in range(x.ndim) if n not in source]
+    for dest, src in sorted(zip(destination, source)):
+        order.insert(dest, src)
+    from .linalg import transpose
+
+    return transpose(x, order)
+
+
+def pad(array: DNDarray, pad_width, mode: str = "constant", constant_values=0) -> DNDarray:
+    """Pad an array (reference ``:1128``)."""
+    # normalize pad_width like numpy
+    res = jnp.pad(
+        array._logical(),
+        pad_width,
+        mode=mode,
+        **({"constant_values": constant_values} if mode == "constant" else {}),
+    )
+    return _wrap_logical(res, array.split, array)
+
+
+def ravel(a: DNDarray) -> DNDarray:
+    """Flattened view (reference ``:1680``)."""
+    return flatten(a)
+
+
+def redistribute(arr: DNDarray, lshape_map=None, target_map=None) -> DNDarray:
+    """Out-of-place redistribute (reference ``:1740``): canonical layout is
+    XLA-managed; this validates and returns a copy."""
+    from . import memory
+
+    out = memory.copy(arr)
+    out.redistribute_(lshape_map, target_map)
+    return out
+
+
+def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
+    """Repeat elements (reference ``:1770``)."""
+    if isinstance(repeats, DNDarray):
+        repeats = repeats._logical()
+    res = jnp.repeat(a._logical(), repeats, axis=axis)
+    if axis is None:
+        out_split = 0 if a.split is not None else None
+    else:
+        out_split = a.split
+    return _wrap_logical(res, out_split, a)
+
+
+def reshape(a: DNDarray, *shape, new_split=None, **kwargs) -> DNDarray:
+    """Reshape to a new global shape (reference ``:1817``).
+
+    The reference implements this with an Alltoallv over row-block
+    boundaries; here the logical array is reshaped and re-sharded by XLA
+    (the all-to-all is generated by the partitioner when needed).
+    """
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    shape = tuple(int(s) for s in shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape = tuple(a.size // known if s == -1 else s for s in shape)
+    if int(np.prod(shape)) != a.size:
+        raise ValueError(f"cannot reshape array of size {a.size} into shape {shape}")
+    if new_split is None:
+        new_split = a.split if a.split is None else builtins_min(a.split, len(shape) - 1)
+    res = a._logical().reshape(shape)
+    return _wrap_logical(res, new_split, a)
+
+
+def builtins_min(a, b):
+    return a if a < b else b
+
+
+def resplit(arr: DNDarray, axis=None) -> DNDarray:
+    """Out-of-place split change (reference ``:3325``)."""
+    return arr.resplit(axis)
+
+
+def roll(x: DNDarray, shift, axis=None) -> DNDarray:
+    """Circular shift (reference ``:1985``)."""
+    if axis is None:
+        res = jnp.roll(x._logical().reshape(-1), shift).reshape(x.shape)
+        return _wrap_logical(res, x.split, x)
+    if x.split is not None and (
+        axis == x.split
+        or (isinstance(axis, (tuple, list)) and sanitize_axis(x.shape, x.split) in
+            tuple(sanitize_axis(x.shape, ax) for ax in axis))
+    ):
+        res = jnp.roll(x._logical(), shift, axis)
+        return _wrap_logical(res, x.split, x)
+    res = jnp.roll(x.larray, shift, axis)
+    return DNDarray(res, x.gshape, x.dtype, x.split, x.device, x.comm)
+
+
+def rot90(m: DNDarray, k: int = 1, axes: Sequence[int] = (0, 1)) -> DNDarray:
+    """Rotate in a plane (reference ``:2100``)."""
+    axes = tuple(sanitize_axis(m.shape, ax) for ax in axes)
+    if len(axes) != 2 or axes[0] == axes[1]:
+        raise ValueError("len(axes) must be 2 and they must differ")
+    res = jnp.rot90(m._logical(), k=k, axes=axes)
+    out_split = m.split
+    if out_split in axes and k % 4 != 0:
+        out_split = axes[0] if m.split == axes[1] else axes[1]
+        if k % 2 == 0:
+            out_split = m.split
+    return _wrap_logical(res, out_split, m)
+
+
+def row_stack(arrays: Sequence[DNDarray]) -> DNDarray:
+    """Stack arrays as rows (reference family)."""
+    prepped = [a.reshape((1, a.shape[0])) if a.ndim == 1 else a for a in arrays]
+    return concatenate(prepped, axis=0)
+
+
+def shape(a: DNDarray) -> Tuple[int, ...]:
+    """Global shape (reference ``:2240``)."""
+    return a.shape
+
+
+def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
+    """Sort along an axis (reference ``:2263``).
+
+    The reference runs a parallel sample-sort (local sort → pivot exchange →
+    Alltoallv). Here the padded physical array is filled with ±inf sentinels
+    and sorted by XLA's partitioned sort; sentinels land in the trailing
+    padding positions, preserving the canonical layout. Returns
+    ``(values, indices)`` like the reference.
+    """
+    axis = sanitize_axis(a.shape, axis)
+    if a.split == axis and a.pad:
+        sentinel = _sort_sentinel(a, descending)
+        physical = a.filled(sentinel)
+    else:
+        physical = a.larray
+    idx = jnp.argsort(physical, axis=axis, descending=descending)
+    values = jnp.take_along_axis(physical, idx, axis=axis)
+    vals = DNDarray(values, a.gshape, a.dtype, a.split, a.device, a.comm)
+    indices = DNDarray(idx, a.gshape, types.canonical_heat_type(idx.dtype), a.split, a.device, a.comm)
+    if out is not None:
+        out.larray = vals.larray
+        return out, indices
+    return vals, indices
+
+
+def _sort_sentinel(a: DNDarray, descending: bool):
+    from . import statistics
+
+    if descending:
+        return statistics._max_neutral(a)
+    return statistics._min_neutral(a)
+
+
+def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
+    """Split into sub-arrays (reference ``:2450``)."""
+    axis = sanitize_axis(x.shape, axis)
+    if isinstance(indices_or_sections, DNDarray):
+        indices_or_sections = indices_or_sections.numpy().tolist()
+    elif isinstance(indices_or_sections, (np.ndarray, jnp.ndarray)):
+        indices_or_sections = np.asarray(indices_or_sections).tolist()
+    logical = x._logical()
+    parts = jnp.split(logical, indices_or_sections, axis=axis)
+    out_split = x.split
+    return [_wrap_logical(p, out_split if out_split != axis else x.split, x) for p in parts]
+
+
+def squeeze(x: DNDarray, axis=None) -> DNDarray:
+    """Remove size-1 dimensions (reference ``:2620``)."""
+    if axis is not None:
+        axes = (sanitize_axis(x.shape, axis),) if isinstance(axis, int) else tuple(
+            sanitize_axis(x.shape, ax) for ax in axis
+        )
+        for ax in axes:
+            if x.shape[ax] != 1:
+                raise ValueError(f"cannot select an axis to squeeze out which has size not equal to one, got axis {ax}")
+    else:
+        axes = tuple(i for i, s in enumerate(x.shape) if s == 1)
+    if x.split is not None and x.split in axes:
+        x = x.resplit(None)
+    res = jnp.squeeze(x.larray, axis=axes if axes else None)
+    out_split = x.split
+    if out_split is not None:
+        out_split -= sum(1 for ax in axes if ax < out_split)
+    gshape = tuple(s for i, s in enumerate(x.shape) if i not in axes)
+    return DNDarray(res, gshape, x.dtype, out_split, x.device, x.comm)
+
+
+def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
+    """Join along a new axis (reference ``:2720``)."""
+    arrays = list(arrays)
+    shapes = {a.shape for a in arrays}
+    if len(shapes) != 1:
+        raise ValueError(f"all input arrays must have the same shape, got {shapes}")
+    axis = sanitize_axis(tuple([len(arrays)] + list(arrays[0].shape)), axis)
+    logicals = [a._logical() for a in arrays]
+    res = jnp.stack(logicals, axis=axis)
+    base_split = arrays[0].split
+    out_split = None
+    if base_split is not None:
+        out_split = base_split + (1 if axis <= base_split else 0)
+    result = _wrap_logical(res, out_split, arrays[0])
+    if out is not None:
+        out.larray = result.resplit(out.split).larray
+        return out
+    return result
+
+
+def swapaxes(x: DNDarray, axis1: int, axis2: int) -> DNDarray:
+    """Interchange two axes (reference ``:2850``)."""
+    from .linalg import transpose
+
+    axes = list(range(x.ndim))
+    axis1 = sanitize_axis(x.shape, axis1)
+    axis2 = sanitize_axis(x.shape, axis2)
+    axes[axis1], axes[axis2] = axes[axis2], axes[axis1]
+    return transpose(x, axes)
+
+
+def tile(x: DNDarray, reps) -> DNDarray:
+    """Tile an array (reference ``:3574``)."""
+    if isinstance(reps, DNDarray):
+        reps = reps.numpy().tolist()
+    res = jnp.tile(x._logical(), reps)
+    out_split = x.split
+    if out_split is not None:
+        out_split = out_split + (res.ndim - x.ndim)
+    return _wrap_logical(res, out_split, x)
+
+
+def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool = True, out=None):
+    """Top-k values and indices (reference ``:3830``; custom MPI op
+    ``mpi_topk`` ``:3971`` becomes ``lax.top_k``)."""
+    dim = sanitize_axis(a.shape, dim)
+    if a.split == dim:
+        logical = a._logical()
+    else:
+        logical = a.larray
+    moved = jnp.moveaxis(logical, dim, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        # negation is not order-reversing for unsigned ints (modular wrap at
+        # 0); select indices on a signed/float view, gather original values
+        neg_src = moved.astype(jnp.int64) if jnp.issubdtype(moved.dtype, jnp.unsignedinteger) else moved
+        _, idx = jax.lax.top_k(-neg_src, k)
+        vals = jnp.take_along_axis(moved, idx, axis=-1)
+    vals = jnp.moveaxis(vals, -1, dim)
+    idx = jnp.moveaxis(idx, -1, dim).astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+    gshape = tuple(k if i == dim else s for i, s in enumerate(a.shape))
+    if a.split == dim:
+        vals_d = _wrap_logical(vals, a.split, a)
+        idx_d = _wrap_logical(idx, a.split, a)
+    else:
+        vals_d = DNDarray(vals, gshape, a.dtype, a.split, a.device, a.comm)
+        idx_d = DNDarray(idx, gshape, types.canonical_heat_type(idx.dtype), a.split, a.device, a.comm)
+    if out is not None:
+        out[0].larray = vals_d.larray
+        out[1].larray = idx_d.larray
+        return out
+    return vals_d, idx_d
+
+
+def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):
+    """Unique elements (reference ``:3051``).
+
+    Dynamic-shape op: computed on the gathered logical array (documented XLA
+    semantic, SURVEY.md §7 hard part 4); result is replicated.
+    """
+    logical = a._logical()
+    if return_inverse:
+        res, inverse = jnp.unique(logical, return_inverse=True, axis=axis)
+        return (
+            _wrap_logical(res, None, a),
+            _wrap_logical(inverse.reshape(logical.shape if axis is None else (-1,)), None, a),
+        )
+    res = jnp.unique(logical, axis=axis)
+    return _wrap_logical(res, None, a)
+
+
+def vsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    return split(x, indices_or_sections, axis=0)
+
+
+def vstack(arrays: Sequence[DNDarray]) -> DNDarray:
+    """Stack vertically (reference ``:3700``)."""
+    prepped = [a.reshape((1, a.shape[0])) if a.ndim == 1 else a for a in arrays]
+    return concatenate(prepped, axis=0)

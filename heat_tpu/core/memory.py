@@ -1,0 +1,31 @@
+"""Memory layout helpers (reference ``heat/core/memory.py``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dndarray import DNDarray
+
+__all__ = ["copy", "sanitize_memory_layout"]
+
+
+def copy(x: DNDarray) -> DNDarray:
+    """Physical copy of a DNDarray (reference ``memory.py:13``)."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+    return DNDarray(jnp.copy(x.larray), x.gshape, x.dtype, x.split, x.device, x.comm)
+
+
+def sanitize_memory_layout(x, order: str = "C"):
+    """Memory-order enforcement (reference ``memory.py:42``).
+
+    XLA owns physical layout on TPU; only the default row-major view is
+    meaningful, so ``order='F'`` is rejected rather than silently ignored.
+    """
+    if order == "K":
+        raise NotImplementedError("Internal usage of torch.clone() means losing original memory layout for now.")
+    if order not in ("C", "F"):
+        raise ValueError(f"order must be 'C' or 'F', got {order}")
+    if order == "F":
+        raise NotImplementedError("column-major layout is not supported on the XLA backend")
+    return x

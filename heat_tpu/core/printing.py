@@ -1,0 +1,83 @@
+"""Printing controls (reference ``heat/core/printing.py``).
+
+The reference distinguishes *global* printing (gather to rank 0, summarize)
+from *local* printing (each rank prints its shard). Under a single controller
+the global array is always addressable; "local" mode prints per-device shard
+shapes and the addressable shards instead.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["get_printoptions", "global_printing", "local_printing", "print0", "set_printoptions"]
+
+# summarization threshold mirrors the reference's default behavior
+__PRINT_LOCAL = False
+
+
+def get_printoptions() -> dict:
+    """Current NumPy print options (reference ``printing.py:23``)."""
+    return dict(np.get_printoptions())
+
+
+def global_printing() -> None:
+    """Print the global array (default; reference ``printing.py:62``)."""
+    global __PRINT_LOCAL
+    __PRINT_LOCAL = False
+
+
+def local_printing() -> None:
+    """Print per-device shards (reference ``printing.py:30``)."""
+    global __PRINT_LOCAL
+    __PRINT_LOCAL = True
+
+
+def print0(*args, **kwargs) -> None:
+    """Print once from the controller (reference ``printing.py:100``).
+
+    Single-controller JAX has exactly one printing process, so this is
+    plain ``print`` — kept for script parity with ``mpirun`` jobs.
+    """
+    print(*args, **kwargs)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None, linewidth=None, profile=None, sci_mode=None):
+    """Configure summarization (reference ``printing.py:150``)."""
+    if profile is not None:
+        profiles = {
+            "default": dict(precision=4, threshold=1000, edgeitems=3, linewidth=80),
+            "short": dict(precision=2, threshold=1000, edgeitems=2, linewidth=80),
+            "full": dict(precision=4, threshold=int(1e9), edgeitems=3, linewidth=80),
+        }
+        if profile not in profiles:
+            raise ValueError(f"unknown profile {profile!r}")
+        np.set_printoptions(**profiles[profile])
+    opts = {}
+    if precision is not None:
+        opts["precision"] = precision
+    if threshold is not None:
+        opts["threshold"] = threshold
+    if edgeitems is not None:
+        opts["edgeitems"] = edgeitems
+    if linewidth is not None:
+        opts["linewidth"] = linewidth
+    if sci_mode is not None:
+        opts["suppress"] = not sci_mode
+    if opts:
+        np.set_printoptions(**opts)
+
+
+def __str__(x) -> str:
+    """Render a DNDarray (used by ``DNDarray.__repr__``)."""
+    if __PRINT_LOCAL:
+        shards = [
+            f"device {i}: shape {tuple(s.data.shape)}" for i, s in enumerate(x.larray.addressable_shards)
+        ]
+        return f"DNDarray(split={x.split}, local shards: " + "; ".join(shards) + ")"
+    try:
+        values = np.asarray(x._logical())
+        body = np.array2string(values, separator=", ")
+    except Exception as exc:  # un-materializable (e.g., inside tracing)
+        body = f"<unrealized: {exc}>"
+    return f"DNDarray({body}, dtype=ht.{x.dtype.__name__}, device={x.device}, split={x.split})"

@@ -1,0 +1,271 @@
+"""Parallel pseudo-random number generation (reference ``heat/core/random.py``).
+
+The reference hand-implements a counter-based Threefry-2x32/2x64 generator in
+torch integer ops (``random.py:55-200, 868-1040``) so that results are
+identical regardless of process count. JAX's native PRNG *is* counter-based
+Threefry — this is the one subsystem that maps more naturally to the TPU
+stack than to the reference's (SURVEY.md §5). The global state here is a
+``(seed, counter)`` pair mirroring the reference's
+``seed``/``get_state``/``set_state`` API; every draw derives an independent
+key via ``fold_in`` and generates the **global logical array** (sharded
+directly on device via a jitted creator), so results are independent of the
+mesh size — the same process-count invariance the reference engineers by
+hand.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import devices, types
+from .communication import sanitize_comm
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis, sanitize_shape
+
+__all__ = [
+    "get_state",
+    "normal",
+    "permutation",
+    "rand",
+    "randint",
+    "randn",
+    "random",
+    "random_integer",
+    "random_sample",
+    "randperm",
+    "ranf",
+    "sample",
+    "seed",
+    "set_state",
+    "standard_normal",
+    "uniform",
+]
+
+# global (seed, counter) state — parity with the reference's __seed/__counter
+__seed: int = None  # type: ignore[assignment]
+__counter: int = 0
+
+# cache of jitted sharded generators keyed by (kind, shape, dtype, split, mesh, extras)
+_GEN_CACHE: dict = {}
+
+
+def seed(seed: Optional[int] = None) -> None:
+    """Reset the RNG state (reference ``random.py:764``)."""
+    global __seed, __counter
+    if seed is None:
+        seed = int(time.time() * 256) % (2**63)
+    __seed = int(seed)
+    __counter = 0
+
+
+def get_state() -> Tuple[str, int, int, int, float]:
+    """Return the RNG state tuple (reference ``random.py:203``)."""
+    return ("Threefry", __seed, __counter, 0, 0.0)
+
+
+def set_state(state: Tuple) -> None:
+    """Restore an RNG state tuple (reference ``random.py:782``)."""
+    global __seed, __counter
+    if not isinstance(state, tuple) or len(state) not in (3, 5):
+        raise ValueError("state needs to be a tuple with 3 or 5 entries")
+    if state[0] != "Threefry":
+        raise ValueError(f"algorithm must be 'Threefry', got {state[0]}")
+    __seed = int(state[1])
+    __counter = int(state[2])
+
+
+def _next_key():
+    """Derive the key for the next draw and advance the counter."""
+    global __counter
+    key_id = __counter
+    __counter += 1
+    return __seed, key_id
+
+
+def _generate(kind, gshape, jdtype, split, comm, make, extras=()):
+    """jit-compiled sharded generation: the global logical array is produced
+    directly with the target sharding (no host materialization), padded to
+    the canonical layout."""
+    gshape = tuple(int(s) for s in gshape)
+    cache_key = (kind, gshape, str(jdtype), split, comm.cache_key, extras)
+    fn = _GEN_CACHE.get(cache_key)
+    if fn is None:
+        sharding = comm.sharding(len(gshape), split)
+
+        def _go(seed_, fold):
+            key = jax.random.fold_in(jax.random.key(seed_), fold)
+            arr = make(key)
+            if split is not None and len(gshape):
+                padn = comm.padded_size(gshape[split]) - gshape[split]
+                if padn:
+                    cfg = [(0, padn if i == split else 0) for i in range(len(gshape))]
+                    arr = jnp.pad(arr, cfg)
+            return arr
+
+        fn = jax.jit(_go, out_shardings=sharding)
+        _GEN_CACHE[cache_key] = fn
+    s, c = _next_key()
+    return fn(s, c)
+
+
+def _ensure_seeded():
+    if __seed is None:
+        seed()
+
+
+def rand(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [0, 1) samples (reference ``random.py:358``)."""
+    _ensure_seeded()
+    if len(d) == 1 and isinstance(d[0], (tuple, list)):
+        d = tuple(d[0])
+    gshape = sanitize_shape(d if d else (1,))
+    if not d:
+        gshape = ()
+    dtype = types.canonical_heat_type(dtype)
+    jdtype = dtype.jax_type()
+    device = devices.sanitize_device(device)
+    comm = sanitize_comm(comm)
+    if split is not None and gshape:
+        split = sanitize_axis(gshape, split)
+    parray = _generate(
+        "rand", gshape, jdtype, split, comm, lambda key: jax.random.uniform(key, gshape, jdtype)
+    )
+    return DNDarray(parray, gshape, dtype, split if gshape else None, device, comm)
+
+
+def random_sample(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [0,1) over a shape tuple (reference ``random.py:640``)."""
+    if shape is None:
+        shape = (1,)
+    return rand(*sanitize_shape(shape), dtype=dtype, split=split, device=device, comm=comm)
+
+
+random = random_sample
+ranf = random_sample
+sample = random_sample
+
+
+def randint(
+    low, high=None, size=None, dtype=None, split=None, device=None, comm=None
+) -> DNDarray:
+    """Uniform random integers in [low, high) (reference ``random.py:473``)."""
+    _ensure_seeded()
+    if high is None:
+        low, high = 0, low
+    if size is None:
+        size = ()
+    if isinstance(size, (int, np.integer)):
+        size = (int(size),)
+    size = sanitize_shape(size)
+    if dtype is None:
+        dtype = types.int64 if jax.config.jax_enable_x64 else types.int32
+    dtype = types.canonical_heat_type(dtype)
+    if not issubclass(dtype, types.integer):
+        raise ValueError(f"Unsupported dtype for randint: {dtype}")
+    jdtype = dtype.jax_type()
+    if low >= high:
+        raise ValueError(f"low >= high: {low}, {high}")
+    device = devices.sanitize_device(device)
+    comm = sanitize_comm(comm)
+    if split is not None and size:
+        split = sanitize_axis(size, split)
+    parray = _generate(
+        ("randint", int(low), int(high)),
+        size,
+        jdtype,
+        split if size else None,
+        comm,
+        lambda key: jax.random.randint(key, size, int(low), int(high), jdtype),
+    )
+    return DNDarray(parray, size, dtype, split if size else None, device, comm)
+
+
+random_integer = randint
+
+
+def randn(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Standard-normal samples (reference ``random.py:557``; the reference
+    converts uniforms with the Kundu transform ``:248`` — JAX draws normals
+    natively)."""
+    _ensure_seeded()
+    if len(d) == 1 and isinstance(d[0], (tuple, list)):
+        d = tuple(d[0])
+    gshape = sanitize_shape(d if d else (1,))
+    dtype = types.canonical_heat_type(dtype)
+    jdtype = dtype.jax_type()
+    device = devices.sanitize_device(device)
+    comm = sanitize_comm(comm)
+    if split is not None:
+        split = sanitize_axis(gshape, split)
+    parray = _generate(
+        "randn", gshape, jdtype, split, comm, lambda key: jax.random.normal(key, gshape, jdtype)
+    )
+    return DNDarray(parray, gshape, dtype, split, device, comm)
+
+
+def standard_normal(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Standard normal over a shape tuple (reference ``random.py:700``)."""
+    if shape is None:
+        shape = (1,)
+    return randn(*sanitize_shape(shape), dtype=dtype, split=split, device=device, comm=comm)
+
+
+def normal(mean=0.0, std=1.0, shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Normal(mean, std) samples (reference ``random.py:290``)."""
+    x = standard_normal(shape, dtype=dtype, split=split, device=device, comm=comm)
+    from . import arithmetics
+
+    return arithmetics.add(arithmetics.mul(x, std), mean)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [low, high) samples (reference ``random.py:820``)."""
+    if size is None:
+        size = (1,)
+    x = random_sample(size, dtype=dtype, split=split, device=device, comm=comm)
+    from . import arithmetics
+
+    return arithmetics.add(arithmetics.mul(x, high - low), low)
+
+
+def randperm(n: int, dtype=types.int64, split=None, device=None, comm=None) -> DNDarray:
+    """Random permutation of ``arange(n)`` (reference ``random.py:744``)."""
+    _ensure_seeded()
+    dtype = types.canonical_heat_type(dtype)
+    jdtype = dtype.jax_type()
+    device = devices.sanitize_device(device)
+    comm = sanitize_comm(comm)
+    gshape = (int(n),)
+    if split is not None:
+        split = sanitize_axis(gshape, split)
+    parray = _generate(
+        "randperm",
+        gshape,
+        jdtype,
+        split,
+        comm,
+        lambda key: jax.random.permutation(key, int(n)).astype(jdtype),
+    )
+    return DNDarray(parray, gshape, dtype, split, device, comm)
+
+
+def permutation(x, split=None, device=None, comm=None) -> DNDarray:
+    """Random permutation of an int range or shuffle of an array's first axis
+    (reference ``random.py:203``)."""
+    _ensure_seeded()
+    if isinstance(x, (int, np.integer)):
+        return randperm(int(x), split=split, device=device, comm=comm)
+    if not isinstance(x, DNDarray):
+        from . import factories
+
+        x = factories.array(x, split=split, device=device, comm=comm)
+    n = x.shape[0]
+    perm = randperm(n, split=None, comm=x.comm)
+    logical = x._logical()[perm._logical()]
+    return DNDarray.from_logical(logical, x.split, x.device, x.comm, dtype=x.dtype)

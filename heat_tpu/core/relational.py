@@ -1,0 +1,75 @@
+"""Relational operations (reference ``heat/core/relational.py:35-420``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = ["eq", "equal", "ge", "greater_equal", "gt", "greater", "le", "less_equal", "lt", "less", "ne", "not_equal"]
+
+
+def eq(t1, t2) -> DNDarray:
+    """Element-wise == (reference ``relational.py:35``)."""
+    return _operations._binary_op(jnp.equal, t1, t2)
+
+
+def equal(t1, t2) -> bool:
+    """Global three-way equality: True iff all elements equal (reference ``:85``,
+    implemented there as a local test + ``Allreduce(LAND)``; here the psum is
+    implicit in the global ``all``)."""
+    from . import logical
+    from .stride_tricks import broadcast_shape
+
+    if not isinstance(t1, DNDarray) and not isinstance(t2, DNDarray):
+        return bool(jnp.all(jnp.equal(jnp.asarray(t1), jnp.asarray(t2))))
+    try:
+        broadcast_shape(
+            t1.shape if isinstance(t1, DNDarray) else jnp.shape(t1),
+            t2.shape if isinstance(t2, DNDarray) else jnp.shape(t2),
+        )
+    except ValueError:
+        return False
+    result = eq(t1, t2)
+    return bool(logical.all(result).item())
+
+
+def ge(t1, t2) -> DNDarray:
+    """Element-wise >= (reference ``:131``)."""
+    return _operations._binary_op(jnp.greater_equal, t1, t2)
+
+
+greater_equal = ge
+
+
+def gt(t1, t2) -> DNDarray:
+    """Element-wise > (reference ``:189``)."""
+    return _operations._binary_op(jnp.greater, t1, t2)
+
+
+greater = gt
+
+
+def le(t1, t2) -> DNDarray:
+    """Element-wise <= (reference ``:247``)."""
+    return _operations._binary_op(jnp.less_equal, t1, t2)
+
+
+less_equal = le
+
+
+def lt(t1, t2) -> DNDarray:
+    """Element-wise < (reference ``:305``)."""
+    return _operations._binary_op(jnp.less, t1, t2)
+
+
+less = lt
+
+
+def ne(t1, t2) -> DNDarray:
+    """Element-wise != (reference ``:363``)."""
+    return _operations._binary_op(jnp.not_equal, t1, t2)
+
+
+not_equal = ne

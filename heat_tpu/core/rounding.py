@@ -1,0 +1,90 @@
+"""Rounding operations (reference ``heat/core/rounding.py:30-454``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from . import _operations, types
+from .dndarray import DNDarray
+
+__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "round", "sgn", "sign", "trunc"]
+
+
+def abs(x, out=None, dtype=None) -> DNDarray:  # noqa: A001
+    """Element-wise absolute value (reference ``rounding.py:30``)."""
+    if dtype is not None and not issubclass(types.canonical_heat_type(dtype), types.generic):
+        raise TypeError("dtype must be a heat data type")
+    res = _operations._local_op(jnp.abs, x, out)
+    if dtype is not None:
+        res = res.astype(types.canonical_heat_type(dtype), copy=False)
+    return res
+
+
+absolute = abs
+
+
+def ceil(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise ceiling (reference ``:100``)."""
+    return _operations._local_op(jnp.ceil, x, out)
+
+
+def clip(x: DNDarray, min=None, max=None, out=None) -> DNDarray:
+    """Clamp values to an interval (reference ``:140``)."""
+    if min is None and max is None:
+        raise ValueError("either min or max must be set")
+    mn = min.larray if isinstance(min, DNDarray) else min
+    mx = max.larray if isinstance(max, DNDarray) else max
+    return _operations._local_op(lambda a: jnp.clip(a, mn, mx), x, out)
+
+
+def fabs(x: DNDarray, out=None) -> DNDarray:
+    """Float absolute value (reference ``:200``)."""
+    return abs(x, out, dtype=None).astype(
+        types.promote_types(x.dtype if isinstance(x, DNDarray) else types.float32, types.float32),
+        copy=False,
+    )
+
+
+def floor(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise floor (reference ``:240``)."""
+    return _operations._local_op(jnp.floor, x, out)
+
+
+def modf(x: DNDarray, out=None) -> tuple:
+    """Split into fractional and integral parts (reference ``:280``)."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
+    frac = _operations._local_op(lambda a: jnp.modf(a)[0], x)
+    integ = _operations._local_op(lambda a: jnp.modf(a)[1], x)
+    if out is not None:
+        if not isinstance(out, tuple) or len(out) != 2:
+            raise TypeError("expected out to be None or a tuple of two DNDarrays")
+        out[0].larray = frac.larray
+        out[1].larray = integ.larray
+        return out
+    return (frac, integ)
+
+
+def round(x: DNDarray, decimals: int = 0, out=None, dtype=None) -> DNDarray:  # noqa: A001
+    """Round to ``decimals`` (reference ``:340``)."""
+    res = _operations._local_op(lambda a: jnp.round(a, decimals), x, out)
+    if dtype is not None:
+        res = res.astype(types.canonical_heat_type(dtype), copy=False)
+    return res
+
+
+def sgn(x: DNDarray, out=None) -> DNDarray:
+    """Sign (complex-aware) (reference ``:400``)."""
+    return _operations._local_op(jnp.sign, x, out)
+
+
+def sign(x: DNDarray, out=None) -> DNDarray:
+    """Sign of real arrays (reference ``:420``)."""
+    return _operations._local_op(jnp.sign, x, out)
+
+
+def trunc(x: DNDarray, out=None) -> DNDarray:
+    """Truncate toward zero (reference ``:440``)."""
+    return _operations._local_op(jnp.trunc, x, out)

@@ -1,0 +1,355 @@
+"""Statistical operations (reference ``heat/core/statistics.py``).
+
+The reference's distributed statistics machinery — custom MPI argmin/argmax
+ops carrying value+index payloads (``statistics.py:1185-1255``) and pairwise
+moment merging for mean/var (``mean`` ``:741``, ``__merge_moments`` ``:893``)
+— disappears on the XLA backend: value-index reductions and numerically
+stable moments are single fused programs over the sharded array, with
+``psum``-style collectives inserted by GSPMD. The only extra step is the
+canonical-padding neutral fill (``DNDarray.filled``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import _operations, arithmetics, types
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis
+
+__all__ = [
+    "argmax",
+    "argmin",
+    "average",
+    "bincount",
+    "bucketize",
+    "cov",
+    "digitize",
+    "histc",
+    "histogram",
+    "kurtosis",
+    "max",
+    "maximum",
+    "mean",
+    "median",
+    "min",
+    "minimum",
+    "percentile",
+    "skew",
+    "std",
+    "var",
+]
+
+
+def _max_neutral(x: DNDarray):
+    """Neutral element for max-reductions (smallest representable)."""
+    if types.heat_type_is_exact(x.dtype):
+        return types.iinfo(x.dtype).min if x.dtype is not types.bool else 0
+    return -float("inf")
+
+
+def _min_neutral(x: DNDarray):
+    if types.heat_type_is_exact(x.dtype):
+        return types.iinfo(x.dtype).max if x.dtype is not types.bool else 1
+    return float("inf")
+
+
+def argmax(x: DNDarray, axis=None, out=None, **kwargs) -> DNDarray:
+    """Index of the maximum (reference ``statistics.py:115``; the custom
+    MPI_ARGMAX value-index reduction ``:1185-1255`` is an XLA variadic
+    reduce here)."""
+    return _arg_reduce(x, jnp.argmax, _max_neutral(x), axis, out)
+
+
+def argmin(x: DNDarray, axis=None, out=None, **kwargs) -> DNDarray:
+    """Index of the minimum (reference ``statistics.py:178``)."""
+    return _arg_reduce(x, jnp.argmin, _min_neutral(x), axis, out)
+
+
+def _arg_reduce(x, op, neutral, axis, out):
+    from . import sanitation
+
+    sanitation.sanitize_in(x)
+    axis = sanitize_axis(x.shape, axis)
+    if axis is None:
+        # flat reduce: physical flat order equals logical order only when
+        # there is no padding; otherwise use the logical view
+        src = x.larray if x.pad == 0 else x._logical()
+        res = op(src.reshape(-1))
+        result = DNDarray.from_logical(res, None, x.device, x.comm)
+        return _operations._finalize(result, out)
+    touches_split = x.split == axis
+    physical = x.filled(neutral) if (touches_split and x.pad) else x.larray
+    res = op(physical, axis=axis)
+    gshape = tuple(s for i, s in enumerate(x.shape) if i != axis)
+    if x.split is None:
+        out_split = None
+    elif touches_split:
+        out_split = None
+    else:
+        out_split = x.split - (1 if axis < x.split else 0)
+    result = DNDarray(res, gshape, types.canonical_heat_type(res.dtype), out_split, x.device, x.comm)
+    return _operations._finalize(result, out)
+
+
+def average(x: DNDarray, axis=None, weights=None, returned=False):
+    """Weighted average (reference ``statistics.py:236``)."""
+    if weights is None:
+        result = mean(x, axis)
+        if returned:
+            n = x.size if axis is None else np.prod([x.shape[a] for a in _axes(x, axis)])
+            from . import factories
+
+            return result, factories.full_like(result, float(n))
+        return result
+    if not isinstance(weights, DNDarray):
+        from . import factories
+
+        weights = factories.array(weights, comm=x.comm)
+    if axis is None:
+        if weights.shape != x.shape:
+            raise TypeError("Axis must be specified when shapes of x and weights differ.")
+        num = arithmetics.sum(arithmetics.mul(x, weights))
+        den = arithmetics.sum(weights)
+    else:
+        axis = sanitize_axis(x.shape, axis)
+        if not isinstance(axis, int):
+            raise NotImplementedError("weighted average over multiple axes is not supported")
+        if weights.ndim == 1 and weights.shape[0] == x.shape[axis]:
+            shape = [1] * x.ndim
+            shape[axis] = x.shape[axis]
+            w = weights.reshape(tuple(shape))
+        elif weights.shape == x.shape:
+            w = weights
+        else:
+            raise ValueError("Length of weights not compatible with specified axis.")
+        num = arithmetics.sum(arithmetics.mul(x, w), axis=axis)
+        den = arithmetics.sum(w, axis=axis) if w.shape == x.shape else arithmetics.sum(weights)
+    zero = bool((den == 0).any().item()) if isinstance(den, DNDarray) else den == 0
+    if zero:
+        raise ZeroDivisionError("Weights sum to zero, can't be normalized")
+    result = arithmetics.div(num, den)
+    if returned:
+        if isinstance(den, DNDarray) and den.shape != result.shape:
+            from . import manipulations
+
+            den = manipulations.broadcast_to(den, result.shape)
+        return result, den
+    return result
+
+
+def _axes(x, axis):
+    axis = sanitize_axis(x.shape, axis)
+    if axis is None:
+        return tuple(range(x.ndim))
+    return (axis,) if isinstance(axis, int) else axis
+
+
+def bincount(x: DNDarray, weights=None, minlength: int = 0) -> DNDarray:
+    """Count occurrences of non-negative ints (reference ``statistics.py:389``)."""
+    if not types.heat_type_is_exact(x.dtype):
+        raise TypeError("bincount requires an integer array")
+    logical = x._logical()
+    w = None
+    if weights is not None:
+        w = weights._logical() if isinstance(weights, DNDarray) else jnp.asarray(weights)
+    length = int(jnp.maximum(minlength, (logical.max() + 1) if logical.size else 0))
+    res = jnp.bincount(logical.reshape(-1), weights=None if w is None else w.reshape(-1), length=length)
+    return DNDarray.from_logical(res, None, x.device, x.comm)
+
+
+def bucketize(input: DNDarray, boundaries, right: bool = False, out=None) -> DNDarray:
+    """Bucket indices by boundaries (reference ``statistics.py:440``)."""
+    b = boundaries._logical() if isinstance(boundaries, DNDarray) else jnp.asarray(boundaries)
+    side = "left" if right else "right"
+    return _operations._local_op(lambda a: jnp.searchsorted(b, a, side=side).astype(jnp.int64), input, out)
+
+
+def digitize(x: DNDarray, bins, right: bool = False) -> DNDarray:
+    """NumPy-style bin indices."""
+    b = bins._logical() if isinstance(bins, DNDarray) else jnp.asarray(bins)
+    return _operations._local_op(lambda a: jnp.digitize(a, b, right=right), x)
+
+
+def cov(m: DNDarray, y=None, rowvar: bool = True, bias: bool = False, ddof=None) -> DNDarray:
+    """Covariance matrix estimate (reference ``statistics.py:544``): centered
+    Gram matrix via distributed matmul (MXU) + psum."""
+    if ddof is not None and not isinstance(ddof, int):
+        raise TypeError("ddof must be integer")
+    if m.ndim > 2:
+        raise ValueError("m has more than 2 dimensions")
+    from . import manipulations
+    from .linalg import matmul, transpose
+
+    x = m
+    if x.ndim == 1:
+        x = x.reshape((1, x.shape[0]))
+    if not rowvar and x.shape[0] != 1:
+        x = transpose(x)
+    if y is not None:
+        if y.ndim > 2:
+            raise ValueError("y has more than 2 dimensions")
+        yy = y
+        if yy.ndim == 1:
+            yy = yy.reshape((1, yy.shape[0]))
+        if not rowvar and yy.shape[0] != 1:
+            yy = transpose(yy)
+        x = manipulations.concatenate([x, yy], axis=0)
+    if ddof is None:
+        ddof = 0 if bias else 1
+    n = x.shape[1]
+    mu = mean(x, axis=1)
+    centered = arithmetics.sub(x, mu.reshape((x.shape[0], 1)))
+    norm = n - ddof
+    c = matmul(centered, transpose(centered))
+    return arithmetics.div(c, float(norm))
+
+
+def histc(input: DNDarray, bins: int = 100, min=0, max=0, out=None) -> DNDarray:
+    """Histogram with uniform bins (reference ``statistics.py:660``)."""
+    logical = input._logical().reshape(-1)
+    lo, hi = float(min), float(max)
+    if lo == 0 and hi == 0:
+        lo = float(logical.min()) if logical.size else 0.0
+        hi = float(logical.max()) if logical.size else 1.0
+    res, _ = jnp.histogram(logical, bins=int(bins), range=(lo, hi))
+    result = DNDarray.from_logical(res.astype(input.dtype.jax_type()), None, input.device, input.comm)
+    return _operations._finalize(result, out)
+
+
+def histogram(a: DNDarray, bins=10, range=None, normed=None, weights=None, density=None):
+    """NumPy-style histogram (reference ``statistics.py:700``)."""
+    logical = a._logical().reshape(-1)
+    w = weights._logical().reshape(-1) if isinstance(weights, DNDarray) else weights
+    hist, edges = jnp.histogram(logical, bins=bins, range=range, weights=w, density=density)
+    return (
+        DNDarray.from_logical(hist, None, a.device, a.comm),
+        DNDarray.from_logical(edges, None, a.device, a.comm),
+    )
+
+
+def kurtosis(x: DNDarray, axis=None, unbiased: bool = True, Fischer: bool = True) -> DNDarray:
+    """Fourth standardized moment (reference ``statistics.py:720``)."""
+    m4 = _central_moment(x, 4, axis)
+    v = var(x, axis, ddof=0)
+    k = arithmetics.div(m4, arithmetics.mul(v, v))
+    if unbiased:
+        n = float(x.size if axis is None else x.shape[sanitize_axis(x.shape, axis)])
+        k = _operations._local_op(
+            lambda g: ((n - 1) / ((n - 2) * (n - 3))) * ((n + 1) * g - 3 * (n - 1)) + 3, k
+        )
+    if Fischer:
+        k = arithmetics.sub(k, 3.0)
+    return k
+
+
+def skew(x: DNDarray, axis=None, unbiased: bool = True) -> DNDarray:
+    """Third standardized moment (reference ``statistics.py:1700``)."""
+    m3 = _central_moment(x, 3, axis)
+    s = std(x, axis, ddof=0)
+    g = arithmetics.div(m3, _operations._local_op(lambda a: a**3, s))
+    if unbiased:
+        n = float(x.size if axis is None else x.shape[sanitize_axis(x.shape, axis)])
+        g = _operations._local_op(lambda v: v * np.sqrt(n * (n - 1)) / (n - 2), g)
+    return g
+
+
+def _central_moment(x: DNDarray, k: int, axis):
+    mu = mean(x, axis)
+    if axis is not None:
+        ax = sanitize_axis(x.shape, axis)
+        shape = list(x.shape)
+        shape[ax] = 1
+        mu = mu.reshape(tuple(shape))
+    centered = arithmetics.sub(x, mu)
+    powed = _operations._local_op(lambda a: a ** k, centered)
+    return mean(powed, axis)
+
+
+def max(x: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:  # noqa: A001
+    """Maximum reduction (reference ``statistics.py:900``)."""
+    return _operations._reduce_op(x, jnp.max, _max_neutral(x), axis=axis, out=out, keepdims=keepdims)
+
+
+def maximum(x1, x2, out=None) -> DNDarray:
+    """Element-wise maximum (reference ``statistics.py:1000``)."""
+    return _operations._binary_op(jnp.maximum, x1, x2, out)
+
+
+def mean(x: DNDarray, axis=None) -> DNDarray:
+    """Arithmetic mean (reference ``statistics.py:741``).
+
+    The reference merges per-rank (μ, n) pairs with the Chan et al. update
+    (``__merge_moments`` ``:893``); here the masked global sum divided by the
+    logical count is a single XLA reduction."""
+    s = arithmetics.sum(x, axis=axis)
+    n = x.size if axis is None else int(np.prod([x.shape[a] for a in _axes(x, axis)]))
+    return arithmetics.div(s, float(n) if n else 1.0)
+
+
+def median(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
+    """Median (reference ``statistics.py:867``) — 50th percentile."""
+    return percentile(x, 50.0, axis=axis, keepdims=keepdims)
+
+
+def min(x: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:  # noqa: A001
+    """Minimum reduction (reference ``statistics.py:1050``)."""
+    return _operations._reduce_op(x, jnp.min, _min_neutral(x), axis=axis, out=out, keepdims=keepdims)
+
+
+def minimum(x1, x2, out=None) -> DNDarray:
+    """Element-wise minimum (reference ``statistics.py:1150``)."""
+    return _operations._binary_op(jnp.minimum, x1, x2, out)
+
+
+def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False) -> DNDarray:
+    """q-th percentile (reference ``statistics.py:1256``).
+
+    Gather-based: percentiles are order statistics with data-dependent
+    communication; the logical array is materialized and reduced by XLA.
+    """
+    logical = x._logical()
+    qa = jnp.asarray(q, dtype=jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    axis_s = sanitize_axis(x.shape, axis)
+    res = jnp.percentile(logical.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32),
+                         qa, axis=axis_s, method=interpolation, keepdims=keepdims)
+    result = DNDarray.from_logical(res, None, x.device, x.comm)
+    return _operations._finalize(result, out)
+
+
+def std(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
+    """Standard deviation (reference ``statistics.py:1850``)."""
+    from . import exponential
+
+    return exponential.sqrt(var(x, axis, ddof=ddof, **kwargs))
+
+
+def var(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
+    """Variance (reference ``statistics.py:1979``): two-pass masked global
+    moments instead of per-rank moment merging."""
+    if not isinstance(ddof, int):
+        raise ValueError(f"ddof must be integer, is {type(ddof)}")
+    if ddof < 0:
+        raise ValueError("Expected ddof >= 0")
+    # heat compatibility: bessel kwarg
+    if kwargs.get("bessel") is True:
+        ddof = 1
+    mu = mean(x, axis)
+    if axis is not None:
+        ax = _axes(x, axis)
+        shape = tuple(1 if i in ax else s for i, s in enumerate(x.shape))
+        mu = mu.reshape(shape)
+    centered = arithmetics.sub(x, mu)
+    sq = _operations._local_op(jnp.square, centered)
+    s = arithmetics.sum(sq, axis=axis)
+    n = x.size if axis is None else int(np.prod([x.shape[a] for a in _axes(x, axis)]))
+    denom = n - ddof
+    if denom <= 0:
+        # NumPy semantics: degrees of freedom <= 0 yields NaN, not 0
+        return _operations._local_op(lambda v: v * jnp.asarray(float("nan"), v.dtype), s)
+    return arithmetics.div(s, float(denom))

@@ -1,0 +1,141 @@
+"""Tile decompositions (reference ``heat/core/tiling.py``).
+
+``SplitTiles`` (reference ``:14-330``) describes the per-device tiles of a
+DNDarray in every dimension; the reference uses it to drive ``resplit_``'s
+Send/Irecv loops. Here resharding is a single XLA program, so ``SplitTiles``
+survives purely as an *introspection* utility with the same accessors.
+
+``SquareDiagTiles`` (reference ``:331-1280``) exists to drive the tiled CAQR;
+our QR is blockwise TSQR (see ``linalg/qr.py``), which needs no tile
+bookkeeping — the class is provided for structural introspection only.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from .dndarray import DNDarray
+
+__all__ = ["SplitTiles", "SquareDiagTiles"]
+
+
+class SplitTiles:
+    """Per-device tile map in every dimension (reference ``tiling.py:14``)."""
+
+    def __init__(self, arr: DNDarray):
+        if not isinstance(arr, DNDarray):
+            raise TypeError(f"arr must be a DNDarray, got {type(arr)}")
+        self.__arr = arr
+        comm = arr.comm
+        nprocs = comm.size
+        # tile ends along each dimension: along the split axis these are the
+        # canonical chunk boundaries; other axes are one tile
+        ends = []
+        for dim, gsize in enumerate(arr.shape):
+            if dim == arr.split:
+                counts, displs = comm.counts_displs(gsize)
+                ends.append(np.cumsum(np.asarray(counts)))
+            else:
+                ends.append(np.asarray([gsize]))
+        self.__tile_ends_per_dim = ends
+        locs = np.zeros([len(e) for e in ends], dtype=np.int64)
+        if arr.split is not None:
+            shape = [1] * arr.ndim
+            shape[arr.split] = nprocs
+            locs = np.arange(nprocs).reshape(shape) * np.ones_like(locs)
+        self.__tile_locations = locs
+
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def tile_ends_per_dim(self) -> List[np.ndarray]:
+        return self.__tile_ends_per_dim
+
+    @property
+    def tile_locations(self) -> np.ndarray:
+        """Which device owns each tile (reference ``set_tile_locations``, ``:108``)."""
+        return self.__tile_locations
+
+    @property
+    def tile_dimensions(self) -> List[np.ndarray]:
+        dims = []
+        for ends in self.__tile_ends_per_dim:
+            starts = np.concatenate([[0], ends[:-1]])
+            dims.append(ends - starts)
+        return dims
+
+    def __getitem__(self, key) -> np.ndarray:
+        """Tile contents by tile index (gathered as numpy)."""
+        slices = self._key_to_slices(key)
+        return self.__arr.numpy()[slices]
+
+    def _key_to_slices(self, key):
+        if not isinstance(key, tuple):
+            key = (key,)
+        slices = []
+        for dim, k in enumerate(key):
+            ends = self.__tile_ends_per_dim[dim]
+            starts = np.concatenate([[0], ends[:-1]])
+            if isinstance(k, int):
+                slices.append(slice(int(starts[k]), int(ends[k])))
+            else:
+                raise NotImplementedError("only integer tile indices are supported")
+        return tuple(slices)
+
+
+class SquareDiagTiles:
+    """Diagonal-aligned 2-D tile map (reference ``tiling.py:331``).
+
+    Introspection-only: computes the diagonal-square tile grid the reference
+    uses for its tiled QR. The TSQR in ``linalg/qr.py`` replaces the tile
+    algebra itself.
+    """
+
+    def __init__(self, arr: DNDarray, tiles_per_proc: int = 1):
+        if not isinstance(arr, DNDarray):
+            raise TypeError(f"arr must be a DNDarray, got {type(arr)}")
+        if arr.ndim != 2:
+            raise ValueError("SquareDiagTiles requires a 2-D DNDarray")
+        self.__arr = arr
+        nprocs = arr.comm.size
+        n, m = arr.shape
+        # square tiles along the diagonal: tile size = chunk of the split
+        # axis divided into tiles_per_proc pieces
+        split = arr.split if arr.split is not None else 0
+        chunk = arr.comm.chunk_size(arr.shape[split])
+        tile = max(1, chunk // max(1, tiles_per_proc))
+        row_ends = np.arange(tile, n + tile, tile).clip(max=n)
+        col_ends = np.arange(tile, m + tile, tile).clip(max=m)
+        self.__row_per_proc_list = [len(row_ends) // nprocs] * nprocs
+        self.__tile_rows = len(row_ends)
+        self.__tile_columns = len(col_ends)
+        self.__row_ends = row_ends
+        self.__col_ends = col_ends
+
+    @property
+    def arr(self) -> DNDarray:
+        return self.__arr
+
+    @property
+    def tile_rows(self) -> int:
+        return self.__tile_rows
+
+    @property
+    def tile_columns(self) -> int:
+        return self.__tile_columns
+
+    @property
+    def lshape_map(self):
+        return self.__arr.lshape_map()
+
+    @property
+    def row_indices(self) -> List[int]:
+        return np.concatenate([[0], self.__row_ends[:-1]]).tolist()
+
+    @property
+    def col_indices(self) -> List[int]:
+        return np.concatenate([[0], self.__col_ends[:-1]]).tolist()

@@ -1,0 +1,104 @@
+"""Trigonometric and hyperbolic operations (reference ``heat/core/trigonometrics.py:46-500``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = [
+    "arccos", "acos", "arccosh", "acosh", "arcsin", "asin", "arcsinh", "asinh",
+    "arctan", "atan", "arctanh", "atanh", "arctan2", "atan2",
+    "cos", "cosh", "deg2rad", "degrees", "rad2deg", "radians",
+    "sin", "sinh", "tan", "tanh",
+]
+
+
+def arccos(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise inverse cosine (reference ``trigonometrics.py:46``)."""
+    return _operations._local_op(jnp.arccos, x, out)
+
+
+acos = arccos
+
+
+def arccosh(x: DNDarray, out=None) -> DNDarray:
+    return _operations._local_op(jnp.arccosh, x, out)
+
+
+acosh = arccosh
+
+
+def arcsin(x: DNDarray, out=None) -> DNDarray:
+    return _operations._local_op(jnp.arcsin, x, out)
+
+
+asin = arcsin
+
+
+def arcsinh(x: DNDarray, out=None) -> DNDarray:
+    return _operations._local_op(jnp.arcsinh, x, out)
+
+
+asinh = arcsinh
+
+
+def arctan(x: DNDarray, out=None) -> DNDarray:
+    return _operations._local_op(jnp.arctan, x, out)
+
+
+atan = arctan
+
+
+def arctanh(x: DNDarray, out=None) -> DNDarray:
+    return _operations._local_op(jnp.arctanh, x, out)
+
+
+atanh = arctanh
+
+
+def arctan2(t1, t2) -> DNDarray:
+    """Element-wise two-argument arctangent (reference ``:200``)."""
+    return _operations._binary_op(jnp.arctan2, t1, t2)
+
+
+atan2 = arctan2
+
+
+def cos(x: DNDarray, out=None) -> DNDarray:
+    return _operations._local_op(jnp.cos, x, out)
+
+
+def cosh(x: DNDarray, out=None) -> DNDarray:
+    return _operations._local_op(jnp.cosh, x, out)
+
+
+def deg2rad(x: DNDarray, out=None) -> DNDarray:
+    return _operations._local_op(jnp.deg2rad, x, out)
+
+
+radians = deg2rad
+
+
+def rad2deg(x: DNDarray, out=None) -> DNDarray:
+    return _operations._local_op(jnp.rad2deg, x, out)
+
+
+degrees = rad2deg
+
+
+def sin(x: DNDarray, out=None) -> DNDarray:
+    return _operations._local_op(jnp.sin, x, out)
+
+
+def sinh(x: DNDarray, out=None) -> DNDarray:
+    return _operations._local_op(jnp.sinh, x, out)
+
+
+def tan(x: DNDarray, out=None) -> DNDarray:
+    return _operations._local_op(jnp.tan, x, out)
+
+
+def tanh(x: DNDarray, out=None) -> DNDarray:
+    return _operations._local_op(jnp.tanh, x, out)

@@ -1,0 +1,19 @@
+"""Version identity for heat_tpu.
+
+Mirrors the role of the reference's ``heat/core/version.py:3-8`` (major/minor/
+micro components assembled into ``__version__``).
+"""
+
+major: int = 0
+"""Major version component."""
+minor: int = 1
+"""Minor version component."""
+micro: int = 0
+"""Micro (patch) version component."""
+extension: str = None
+"""Optional pre-release tag."""
+
+if not extension:
+    __version__ = f"{major}.{minor}.{micro}"
+else:
+    __version__ = f"{major}.{minor}.{micro}-{extension}"

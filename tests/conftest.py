@@ -1,0 +1,29 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's CI strategy of running the whole suite under
+``mpirun -n 1…8`` (reference ``Jenkinsfile:24-33``): multi-*device* on one
+host is the proxy for multi-chip, via
+``--xla_force_host_platform_device_count`` (SURVEY.md §4).
+
+Must run before jax initializes a backend; the axon TPU plugin registers in
+``sitecustomize`` only when ``PALLAS_AXON_POOL_IPS`` is set, so tests must be
+launched with that variable unset or empty (see ``tests/README`` note) —
+otherwise the plugin has already claimed the backend. We defensively override
+the platform here for the common case where the plugin did not register.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+if jax.default_backend() != "cpu" or len(jax.devices()) < 8:
+    raise RuntimeError(
+        "tests require an 8-device CPU mesh; run with "
+        "PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=8 python -m pytest tests/"
+    )

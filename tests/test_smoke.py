@@ -1,0 +1,76 @@
+"""Minimum end-to-end slice (SURVEY.md §7 M1): driver smoke-test config 1 —
+``ht.arange(n, split=0).sum()`` — plus canonical-layout basics."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def test_arange_sum_split0():
+    x = ht.arange(100, split=0)
+    s = x.sum()
+    assert int(s.item()) == 4950
+
+
+def test_arange_sum_uneven():
+    # 10 elements over 8 devices: padded layout must mask correctly
+    x = ht.arange(10, split=0)
+    assert int(x.sum().item()) == 45
+    assert x.shape == (10,)
+    assert x.split == 0
+    # physical is padded to a multiple of the mesh size
+    assert x.larray.shape[0] % x.comm.size == 0
+
+
+def test_mesh_size():
+    assert ht.get_comm().size == 8
+
+
+def test_factories_values():
+    np.testing.assert_array_equal(ht.zeros((4, 5), split=0).numpy(), np.zeros((4, 5)))
+    np.testing.assert_array_equal(ht.ones((3, 7), split=1).numpy(), np.ones((3, 7)))
+    np.testing.assert_array_equal(
+        ht.full((2, 3), 7.0, split=None).numpy(), np.full((2, 3), 7.0)
+    )
+    np.testing.assert_allclose(
+        ht.linspace(0, 1, 11, split=0).numpy(), np.linspace(0, 1, 11), rtol=1e-6
+    )
+
+
+def test_elementwise_binary_mixed_splits():
+    a_np = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b_np = np.ones((3, 4), dtype=np.float32)
+    for sa in (None, 0, 1):
+        for sb in (None, 0, 1):
+            a = ht.array(a_np, split=sa)
+            b = ht.array(b_np, split=sb)
+            c = a + b
+            np.testing.assert_array_equal(c.numpy(), a_np + b_np)
+
+
+def test_scalar_ops():
+    x = ht.arange(10, split=0)
+    y = (x * 2 + 1).numpy()
+    np.testing.assert_array_equal(y, np.arange(10) * 2 + 1)
+
+
+def test_resplit_roundtrip():
+    data = np.arange(24, dtype=np.float32).reshape(4, 6)
+    x = ht.array(data, split=0)
+    x.resplit_(1)
+    assert x.split == 1
+    np.testing.assert_array_equal(x.numpy(), data)
+    x.resplit_(None)
+    assert x.split is None
+    np.testing.assert_array_equal(x.numpy(), data)
+
+
+def test_reduction_axes():
+    data = np.arange(30, dtype=np.float32).reshape(5, 6)
+    for split in (None, 0, 1):
+        x = ht.array(data, split=split)
+        np.testing.assert_allclose(x.sum(axis=0).numpy(), data.sum(axis=0))
+        np.testing.assert_allclose(x.sum(axis=1).numpy(), data.sum(axis=1))
+        np.testing.assert_allclose(x.sum().item(), data.sum())
+        np.testing.assert_allclose(x.mean(axis=0).numpy(), data.mean(axis=0), rtol=1e-6)

@@ -1,0 +1,69 @@
+"""Shared test helpers — the analogue of the reference's
+``heat/core/tests/test_suites/basic_test.py``.
+
+Core idioms:
+
+* ``assert_array_equal(ht_array, np_reference)``: global shape, dtype kind,
+  sharding consistency, and gathered values vs a NumPy reference
+  (reference ``basic_test.py:68``).
+* ``assert_func_equal(...)``: run an op for every split and compare against
+  the NumPy implementation (reference ``basic_test.py:142-307``).
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+def assert_array_equal(ht_array, np_array, rtol=1e-5, atol=1e-8):
+    np_array = np.asarray(np_array)
+    assert isinstance(ht_array, ht.DNDarray), f"not a DNDarray: {type(ht_array)}"
+    assert tuple(ht_array.shape) == tuple(np_array.shape), (
+        f"global shape mismatch: {ht_array.shape} != {np_array.shape}"
+    )
+    gathered = ht_array.numpy()
+    if np_array.dtype.kind in "fc":
+        np.testing.assert_allclose(gathered.astype(np_array.dtype), np_array, rtol=rtol, atol=atol)
+    else:
+        np.testing.assert_array_equal(gathered.astype(np_array.dtype), np_array)
+    # canonical layout invariants
+    if ht_array.split is not None:
+        phys = ht_array.larray.shape[ht_array.split]
+        assert phys % ht_array.comm.size == 0, "physical split axis not evenly divisible"
+        assert phys >= ht_array.shape[ht_array.split], "physical smaller than logical"
+
+
+def all_splits(ndim):
+    """Every split value to parameterize over, including None."""
+    return [None] + list(range(ndim))
+
+
+def assert_func_equal(
+    shape,
+    heat_func,
+    numpy_func,
+    heat_args=None,
+    numpy_args=None,
+    distributed_result=True,
+    dtype=np.float32,
+    low=-10,
+    high=10,
+    seed=42,
+):
+    """Run ``heat_func`` for every split of a random array of ``shape`` and
+    compare to ``numpy_func`` of the same data."""
+    heat_args = heat_args or {}
+    numpy_args = numpy_args or {}
+    rng = np.random.default_rng(seed)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        data = rng.integers(low, high, size=shape).astype(dtype)
+    else:
+        data = ((high - low) * rng.random(size=shape) + low).astype(dtype)
+    expected = numpy_func(data.copy(), **numpy_args)
+    for split in all_splits(len(shape)):
+        a = ht.array(data, split=split)
+        result = heat_func(a, **heat_args)
+        if isinstance(result, ht.DNDarray):
+            assert_array_equal(result, expected, rtol=1e-4, atol=1e-6)
+        else:
+            np.testing.assert_allclose(np.asarray(result), expected, rtol=1e-4, atol=1e-6)
