@@ -1,1 +1,3 @@
-"""Placeholder — populated in subsequent milestones."""
+"""Classification estimators (reference ``heat/classification/``)."""
+
+from .kneighborsclassifier import KNeighborsClassifier

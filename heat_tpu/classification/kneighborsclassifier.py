@@ -1,0 +1,54 @@
+"""K-nearest-neighbors classifier (reference
+``heat/classification/kneighborsclassifier.py:45-136``).
+
+cdist to the training set (ring or GEMM tiles) → top-k smallest → one-hot
+vote, all on-device.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import factories
+from ..core.base import BaseEstimator, ClassificationMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["KNeighborsClassifier"]
+
+
+class KNeighborsClassifier(ClassificationMixin, BaseEstimator):
+    """KNN voting classifier (reference ``kneighborsclassifier.py:18``)."""
+
+    def __init__(self, n_neighbors: int = 5):
+        self.n_neighbors = n_neighbors
+        self.x = None
+        self.y = None
+
+    def fit(self, x: DNDarray, y: DNDarray):
+        """Store the training set (reference ``:45``)."""
+        if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
+            raise TypeError("x and y need to be DNDarrays")
+        self.x = x
+        self.y = y
+        return self
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Vote among the k nearest training points (reference ``:80-136``)."""
+        if self.x is None:
+            raise RuntimeError("fit needs to be called before predict")
+        from ..spatial.distance import cdist
+
+        d = cdist(x, self.x.resplit(None), quadratic_expansion=True)
+        dl = d._logical()
+        k = self.n_neighbors
+        import jax
+
+        # k smallest distances → indices
+        _, idx = jax.lax.top_k(-dl, k)  # (n_test, k)
+        yl = self.y._logical().reshape(-1)
+        labels = yl[idx]  # (n_test, k)
+        classes = jnp.unique(yl)
+        votes = jnp.sum(labels[:, :, None] == classes[None, None, :], axis=1)
+        winner = classes[jnp.argmax(votes, axis=1)]
+        return DNDarray.from_logical(winner, x.split, x.device, x.comm)

@@ -1,1 +1,6 @@
-"""Placeholder — populated in subsequent milestones."""
+"""Clustering estimators (reference ``heat/cluster/``)."""
+
+from .kmeans import KMeans
+from .kmedians import KMedians
+from .kmedoids import KMedoids
+from .spectral import Spectral

@@ -1,0 +1,116 @@
+"""Shared k-clustering machinery (reference ``heat/cluster/_kcluster.py``).
+
+The reference's per-centroid ``Bcast`` initialization (``_kcluster.py:87-194``)
+and cdist+argmin assignment (``:196``) become, respectively, gathers of k
+sampled rows (k tiny) and one fused GEMM-tile + argmin program per shard.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import factories, random as ht_random, types
+from ..core.base import BaseEstimator, ClusteringMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["_KCluster"]
+
+
+class _KCluster(ClusteringMixin, BaseEstimator):
+    """Base class for KMeans/KMedians/KMedoids (reference ``_kcluster.py:16``)."""
+
+    def __init__(self, metric: Callable, n_clusters: int, init, max_iter: int, tol: float, random_state):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.max_iter = max_iter
+        self.tol = tol
+        self.random_state = random_state
+
+        self._cluster_centers = None
+        self._labels = None
+        self._inertia = None
+        self._n_iter = None
+        self._metric = metric
+
+    @property
+    def cluster_centers_(self) -> DNDarray:
+        return self._cluster_centers
+
+    @property
+    def labels_(self) -> DNDarray:
+        return self._labels
+
+    @property
+    def inertia_(self) -> float:
+        return self._inertia
+
+    @property
+    def n_iter_(self) -> int:
+        return self._n_iter
+
+    # ------------------------------------------------------------------ #
+    def _initialize_cluster_centers(self, x: DNDarray):
+        """Centroid init (reference ``_kcluster.py:87-194``)."""
+        k = self.n_clusters
+        if self.random_state is not None:
+            ht_random.seed(self.random_state)
+        if isinstance(self.init, DNDarray):
+            if self.init.shape != (k, x.shape[1]):
+                raise ValueError(
+                    f"passed centroids must have shape ({k}, {x.shape[1]}), got {self.init.shape}"
+                )
+            self._cluster_centers = self.init.resplit(None)
+            return
+        if self.init == "random":
+            idx = ht_random.randint(0, x.shape[0], (k,), split=None, comm=x.comm)
+            rows = x._logical()[idx._logical()]
+            self._cluster_centers = DNDarray.from_logical(rows, None, x.device, x.comm)
+            return
+        if self.init in ("kmeans++", "probability_based"):
+            self._cluster_centers = self._kmeanspp(x)
+            return
+        raise ValueError(f"initialization method {self.init!r} is not supported")
+
+    def _kmeanspp(self, x: DNDarray) -> DNDarray:
+        """k-means++ D²-weighted seeding (reference ``_kcluster.py:120-194``)."""
+        logical_like = x
+        n = x.shape[0]
+        k = self.n_clusters
+        first = ht_random.randint(0, n, (1,), comm=x.comm)._logical()[0]
+        centers = x._logical()[first][None, :]
+        jdt = centers.dtype
+        for i in range(1, k):
+            d2 = self._pairwise_sq_dist_to(x, centers)  # (n,) min sq distance, replicated
+            # D²-weighted draw via the global RNG stream
+            u = ht_random.rand(1, comm=x.comm)._logical()[0]
+            probs = d2 / jnp.maximum(jnp.sum(d2), 1e-30)
+            cdf = jnp.cumsum(probs)
+            nxt = jnp.searchsorted(cdf, u.astype(cdf.dtype))
+            nxt = jnp.minimum(nxt, n - 1)
+            centers = jnp.concatenate([centers, x._logical()[nxt][None, :]], axis=0)
+        return DNDarray.from_logical(centers, None, x.device, x.comm)
+
+    def _pairwise_sq_dist_to(self, x: DNDarray, centers) -> jnp.ndarray:
+        """Min squared distance of every point to the current center set."""
+        from ..spatial.distance import cdist
+
+        c = DNDarray.from_logical(centers, None, x.device, x.comm)
+        d = cdist(x, c, quadratic_expansion=True)
+        dmin = d.min(axis=1)
+        return dmin._logical() ** 2
+
+    def _assign_to_cluster(self, x: DNDarray) -> DNDarray:
+        """Nearest-centroid assignment (reference ``_kcluster.py:196``)."""
+        d = self._metric(x, self._cluster_centers)
+        return d.argmin(axis=1)
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Nearest learned centroid for each sample (reference ``_kcluster.py:230``)."""
+        if not isinstance(x, DNDarray):
+            raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+        return self._assign_to_cluster(x)

@@ -1,0 +1,119 @@
+"""KMeans clustering (reference ``heat/cluster/kmeans.py``).
+
+The benchmark workload (SURVEY.md §3.4, §6). The reference's Lloyd epoch is a
+chain of cdist → argmin → k masked sum/count Allreduces
+(``kmeans.py:73-139``). Here one **fused jitted Lloyd step** runs per
+iteration: squared-distance GEMM tile (MXU) → argmin → one-hot matmul for the
+centroid sums (MXU again) → GSPMD ``psum`` for counts and sums. The whole
+step is a single XLA program over the sharded array; padding rows are masked
+once inside the kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dndarray import DNDarray
+from ..core import types
+from ._kcluster import _KCluster
+
+__all__ = ["KMeans"]
+
+# cache of jitted Lloyd steps keyed by (physical shape, dtype, k, comm)
+_STEP_CACHE: dict = {}
+
+
+def _lloyd_step_fn(phys_shape, jdt, k, n_valid, comm):
+    key = (phys_shape, str(jdt), k, n_valid, comm.cache_key)
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+
+        def _step(xp, centroids):
+            # valid-row mask for canonical padding
+            row = jax.lax.broadcasted_iota(jnp.int32, (phys_shape[0], 1), 0)
+            valid = row < n_valid
+            x2 = jnp.sum(xp * xp, axis=1, keepdims=True)
+            c2 = jnp.sum(centroids * centroids, axis=1, keepdims=True).T
+            d2 = x2 + c2 - 2.0 * (xp @ centroids.T)  # (N_pad, k) GEMM tile
+            labels = jnp.argmin(d2, axis=1)
+            onehot = (labels[:, None] == jnp.arange(k)[None, :]) & valid
+            onehot_f = onehot.astype(xp.dtype)
+            counts = jnp.sum(onehot_f, axis=0)  # (k,)  — psum by GSPMD
+            sums = onehot_f.T @ xp  # (k, d) GEMM — psum by GSPMD
+            new_centroids = sums / jnp.maximum(counts, 1.0)[:, None]
+            # keep empty clusters where they are (reference keeps old centroid)
+            new_centroids = jnp.where((counts > 0)[:, None], new_centroids, centroids)
+            inertia = jnp.sum(jnp.where(valid[:, 0], jnp.min(d2, axis=1), 0.0))
+            shift = jnp.sum((new_centroids - centroids) ** 2)
+            return new_centroids, labels, inertia, shift
+
+        fn = jax.jit(_step)
+        _STEP_CACHE[key] = fn
+    return fn
+
+
+class KMeans(_KCluster):
+    """K-Means with Lloyd's algorithm (reference ``kmeans.py:12``).
+
+    Parameters match the reference: ``n_clusters``, ``init`` ("random",
+    "kmeans++", or a (k, d) DNDarray), ``max_iter``, ``tol``, ``random_state``.
+    """
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, DNDarray] = "random",
+        max_iter: int = 300,
+        tol: float = 1e-4,
+        random_state: Optional[int] = None,
+    ):
+        from ..spatial.distance import cdist
+
+        super().__init__(
+            metric=lambda x, y: cdist(x, y, quadratic_expansion=True),
+            n_clusters=n_clusters,
+            init=init,
+            max_iter=max_iter,
+            tol=tol,
+            random_state=random_state,
+        )
+
+    def fit(self, x: DNDarray) -> "KMeans":
+        """Lloyd iteration to convergence (reference ``kmeans.py:102-139``)."""
+        if not isinstance(x, DNDarray):
+            raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+        if x.ndim != 2:
+            raise ValueError("input needs to be 2-dimensional (n_samples, n_features)")
+        if x.split not in (None, 0):
+            x = x.resplit(0)
+
+        self._initialize_cluster_centers(x)
+        jdt = x.dtype.jax_type()
+        if types.heat_type_is_exact(x.dtype):
+            jdt = jnp.dtype(jnp.float32)
+        xp = x.larray.astype(jdt)
+        centroids = self._cluster_centers._logical().astype(jdt)
+        step = _lloyd_step_fn(xp.shape, jdt, self.n_clusters, x.shape[0], x.comm)
+
+        labels = None
+        inertia = None
+        it = 0
+        for it in range(1, self.max_iter + 1):
+            centroids, labels, inertia, shift = step(xp, centroids)
+            if float(shift) <= self.tol * self.tol:
+                break
+
+        self._cluster_centers = DNDarray.from_logical(centroids, None, x.device, x.comm)
+        n = x.shape[0]
+        self._labels = DNDarray(
+            labels, (n,), types.canonical_heat_type(labels.dtype), 0 if x.split == 0 else None,
+            x.device, x.comm,
+        )
+        self._inertia = float(inertia)
+        self._n_iter = it
+        return self

@@ -1,0 +1,82 @@
+"""KMedians clustering (reference ``heat/cluster/kmedians.py``).
+
+Same Lloyd skeleton as KMeans but the centroid update is the per-cluster
+coordinate-wise **median**; implemented as a masked ``nanmedian`` over the
+gathered per-cluster columns (order statistics are data-dependent; k and d
+are small, n is sharded for the assignment step).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dndarray import DNDarray
+from ..core import types
+from ._kcluster import _KCluster
+
+__all__ = ["KMedians"]
+
+
+class KMedians(_KCluster):
+    """K-Medians with manhattan assignment (reference ``kmedians.py:10``)."""
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, DNDarray] = "random",
+        max_iter: int = 300,
+        tol: float = 1e-4,
+        random_state: Optional[int] = None,
+    ):
+        from ..spatial.distance import manhattan
+
+        super().__init__(
+            metric=lambda x, y: manhattan(x, y),
+            n_clusters=n_clusters,
+            init=init,
+            max_iter=max_iter,
+            tol=tol,
+            random_state=random_state,
+        )
+
+    def fit(self, x: DNDarray) -> "KMedians":
+        if not isinstance(x, DNDarray):
+            raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+        if x.split not in (None, 0):
+            x = x.resplit(0)
+        self._initialize_cluster_centers(x)
+
+        k = self.n_clusters
+        logical = x._logical().astype(jnp.float32)
+        centroids = self._cluster_centers._logical().astype(jnp.float32)
+
+        it = 0
+        for it in range(1, self.max_iter + 1):
+            labels = self._assign_labels(logical, centroids)
+            new_centroids = self._median_update(logical, labels, centroids, k)
+            shift = float(jnp.sum((new_centroids - centroids) ** 2))
+            centroids = new_centroids
+            if shift <= self.tol * self.tol:
+                break
+
+        self._cluster_centers = DNDarray.from_logical(centroids, None, x.device, x.comm)
+        self._labels = DNDarray.from_logical(
+            labels, 0 if x.split == 0 else None, x.device, x.comm
+        )
+        self._n_iter = it
+        return self
+
+    @staticmethod
+    def _assign_labels(logical, centroids):
+        d = jnp.sum(jnp.abs(logical[:, None, :] - centroids[None, :, :]), axis=-1)
+        return jnp.argmin(d, axis=1)
+
+    @staticmethod
+    def _median_update(logical, labels, centroids, k):
+        member = labels[:, None] == jnp.arange(k)[None, :]  # (n, k)
+        vals = jnp.where(member[:, :, None], logical[:, None, :], jnp.nan)
+        med = jnp.nanmedian(vals, axis=0)  # (k, d)
+        return jnp.where(jnp.isnan(med), centroids, med)

@@ -1,0 +1,78 @@
+"""KMedoids clustering (reference ``heat/cluster/kmedoids.py``).
+
+Reference semantics: after the mean update, each centroid is snapped to the
+nearest actual data point of its cluster (``kmedoids.py:10`` — the
+"medoid-by-projection" variant, not full PAM). Implemented as a masked
+argmin of the distance-to-centroid column per cluster.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dndarray import DNDarray
+from ._kcluster import _KCluster
+
+__all__ = ["KMedoids"]
+
+
+class KMedoids(_KCluster):
+    """K-Medoids (snap-to-point Lloyd, reference ``kmedoids.py:10``)."""
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, DNDarray] = "random",
+        max_iter: int = 300,
+        random_state: Optional[int] = None,
+    ):
+        from ..spatial.distance import manhattan
+
+        super().__init__(
+            metric=lambda x, y: manhattan(x, y),
+            n_clusters=n_clusters,
+            init=init,
+            max_iter=max_iter,
+            tol=0.0,
+            random_state=random_state,
+        )
+
+    def fit(self, x: DNDarray) -> "KMedoids":
+        if not isinstance(x, DNDarray):
+            raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+        if x.split not in (None, 0):
+            x = x.resplit(0)
+        self._initialize_cluster_centers(x)
+
+        k = self.n_clusters
+        logical = x._logical().astype(jnp.float32)
+        centroids = self._cluster_centers._logical().astype(jnp.float32)
+
+        it = 0
+        for it in range(1, self.max_iter + 1):
+            d = jnp.sum(jnp.abs(logical[:, None, :] - centroids[None, :, :]), axis=-1)
+            labels = jnp.argmin(d, axis=1)
+            member = labels[:, None] == jnp.arange(k)[None, :]
+            counts = jnp.sum(member, axis=0)
+            sums = member.astype(logical.dtype).T @ logical
+            means = sums / jnp.maximum(counts, 1)[:, None]
+            # snap each mean to the nearest member point (the medoid step)
+            d_mean = jnp.sum(jnp.abs(logical[:, None, :] - means[None, :, :]), axis=-1)
+            d_mean = jnp.where(member, d_mean, jnp.inf)
+            medoid_idx = jnp.argmin(d_mean, axis=0)  # (k,)
+            new_centroids = logical[medoid_idx]
+            new_centroids = jnp.where((counts > 0)[:, None], new_centroids, centroids)
+            shift = float(jnp.sum((new_centroids - centroids) ** 2))
+            centroids = new_centroids
+            if shift == 0.0:
+                break
+
+        self._cluster_centers = DNDarray.from_logical(centroids, None, x.device, x.comm)
+        self._labels = DNDarray.from_logical(
+            labels, 0 if x.split == 0 else None, x.device, x.comm
+        )
+        self._n_iter = it
+        return self

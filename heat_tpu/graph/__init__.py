@@ -1,1 +1,3 @@
-"""Placeholder — populated in subsequent milestones."""
+"""Graph algorithms (reference ``heat/graph/``)."""
+
+from .laplacian import Laplacian

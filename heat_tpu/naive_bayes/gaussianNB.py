@@ -1,0 +1,163 @@
+"""Gaussian naive Bayes (reference ``heat/naive_bayes/gaussianNB.py``).
+
+Distributed per-class mean/variance accumulation (reference ``:131-199``)
+expressed as masked one-hot GEMMs + GSPMD psum; ``partial_fit`` keeps the
+reference's incremental mean/var update formulas.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import factories, types
+from ..core.base import BaseEstimator, ClassificationMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["GaussianNB"]
+
+
+class GaussianNB(ClassificationMixin, BaseEstimator):
+    """Gaussian naive Bayes classifier (reference ``gaussianNB.py:20``)."""
+
+    def __init__(self, priors=None, var_smoothing: float = 1e-9):
+        self.priors = priors
+        self.var_smoothing = var_smoothing
+        self.classes_ = None
+        self.theta_ = None
+        self.var_ = None
+        self.class_count_ = None
+        self.class_prior_ = None
+        self.epsilon_ = None
+
+    def fit(self, x: DNDarray, y: DNDarray, sample_weight=None) -> "GaussianNB":
+        """Full fit (reference ``gaussianNB.py:102``)."""
+        self.classes_ = None
+        self.theta_ = None
+        return self.partial_fit(x, y, classes=None, sample_weight=sample_weight)
+
+    def partial_fit(self, x: DNDarray, y: DNDarray, classes=None, sample_weight=None) -> "GaussianNB":
+        """Incremental fit (reference ``gaussianNB.py:200``)."""
+        if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
+            raise TypeError("x and y need to be DNDarrays")
+        xl = x._logical().astype(jnp.float64)
+        yl = y._logical().reshape(-1)
+
+        if classes is not None:
+            class_vals = np.asarray(
+                classes.numpy() if isinstance(classes, DNDarray) else classes
+            )
+        elif self.classes_ is not None:
+            class_vals = np.asarray(self.classes_.numpy())
+        else:
+            class_vals = np.unique(np.asarray(yl))
+        k = len(class_vals)
+        classes_j = jnp.asarray(class_vals)
+
+        onehot = (yl[:, None] == classes_j[None, :]).astype(jnp.float64)  # (n, k)
+        if sample_weight is not None:
+            w = (
+                sample_weight._logical()
+                if isinstance(sample_weight, DNDarray)
+                else jnp.asarray(sample_weight)
+            ).reshape(-1, 1)
+            onehot = onehot * w
+        counts = jnp.sum(onehot, axis=0)  # (k,)
+        sums = onehot.T @ xl  # (k, d)
+        means = sums / jnp.maximum(counts, 1e-30)[:, None]
+        sq = onehot.T @ (xl * xl)
+        variances = sq / jnp.maximum(counts, 1e-30)[:, None] - means**2
+
+        eps = self.var_smoothing * float(jnp.var(xl, axis=0).max())
+        if self.theta_ is None:
+            new_counts, new_means, new_vars = counts, means, variances
+        else:
+            # incremental merge (reference update_mean_variance ``:131-199``)
+            old_counts = jnp.asarray(self.class_count_.numpy())
+            old_means = jnp.asarray(self.theta_.numpy())
+            old_vars = jnp.asarray(self.var_.numpy()) - self.epsilon_
+            total = old_counts + counts
+            new_means = (
+                old_means * old_counts[:, None] + means * counts[:, None]
+            ) / jnp.maximum(total, 1e-30)[:, None]
+            old_ssd = old_vars * old_counts[:, None]
+            new_ssd = variances * counts[:, None]
+            corr = (
+                (old_counts * counts)[:, None]
+                / jnp.maximum(total, 1e-30)[:, None]
+                * (old_means - means) ** 2
+            )
+            new_vars = (old_ssd + new_ssd + corr) / jnp.maximum(total, 1e-30)[:, None]
+            new_counts = total
+
+        self.epsilon_ = eps
+        comm = x.comm
+        self.classes_ = factories.array(class_vals, comm=comm)
+        self.class_count_ = factories.array(np.asarray(new_counts), comm=comm)
+        self.theta_ = factories.array(np.asarray(new_means), comm=comm)
+        self.var_ = factories.array(np.asarray(new_vars + eps), comm=comm)
+        if self.priors is not None:
+            priors = np.asarray(
+                self.priors.numpy() if isinstance(self.priors, DNDarray) else self.priors
+            )
+            if len(priors) != k:
+                raise ValueError("Number of priors must match number of classes.")
+            if not np.isclose(priors.sum(), 1.0):
+                raise ValueError("The sum of the priors should be 1.")
+            if (priors < 0).any():
+                raise ValueError("Priors must be non-negative.")
+            self.class_prior_ = factories.array(priors, comm=comm)
+        else:
+            total = np.asarray(new_counts).sum()
+            self.class_prior_ = factories.array(np.asarray(new_counts) / total, comm=comm)
+        return self
+
+    def _joint_log_likelihood(self, x: DNDarray):
+        """Per-class joint log likelihood (reference ``gaussianNB.py:391``)."""
+        xl = x._logical().astype(jnp.float64)
+        means = jnp.asarray(self.theta_.numpy())  # (k, d)
+        variances = jnp.asarray(self.var_.numpy())
+        priors = jnp.asarray(self.class_prior_.numpy())
+        log_prior = jnp.log(priors)
+        # (n, k): -0.5 * sum(log(2πσ²)) - 0.5 * sum((x-μ)²/σ²)
+        const = -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * variances), axis=1)  # (k,)
+        diff = xl[:, None, :] - means[None, :, :]
+        mahal = -0.5 * jnp.sum(diff * diff / variances[None, :, :], axis=2)
+        return log_prior[None, :] + const[None, :] + mahal
+
+    def logsumexp(self, a, axis=None, b=None, keepdims=False, return_sign=False):
+        """Stable log-sum-exp (reference ``gaussianNB.py:407``)."""
+        al = a._logical() if isinstance(a, DNDarray) else jnp.asarray(a)
+        res = jax_logsumexp(al, axis=axis, keepdims=keepdims)
+        return DNDarray.from_logical(res, None, getattr(a, "device", None), getattr(a, "comm", None)) \
+            if isinstance(a, DNDarray) else res
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Class prediction (reference ``gaussianNB.py:360``)."""
+        jll = self._joint_log_likelihood(x)
+        idx = jnp.argmax(jll, axis=1)
+        classes = jnp.asarray(self.classes_.numpy())
+        return DNDarray.from_logical(classes[idx], x.split, x.device, x.comm)
+
+    def predict_log_proba(self, x: DNDarray) -> DNDarray:
+        """Log class probabilities (reference ``gaussianNB.py:440``)."""
+        jll = self._joint_log_likelihood(x)
+        norm = jax_logsumexp(jll, axis=1, keepdims=True)
+        return DNDarray.from_logical(jll - norm, x.split, x.device, x.comm)
+
+    def predict_proba(self, x: DNDarray) -> DNDarray:
+        """Class probabilities (reference ``gaussianNB.py:470``)."""
+        lp = self.predict_log_proba(x)
+        return DNDarray.from_logical(jnp.exp(lp._logical()), x.split, x.device, x.comm)
+
+
+def jax_logsumexp(a, axis=None, keepdims=False):
+    amax = jnp.max(a, axis=axis, keepdims=True)
+    out = jnp.log(jnp.sum(jnp.exp(a - amax), axis=axis, keepdims=True)) + amax
+    if not keepdims and axis is not None:
+        out = jnp.squeeze(out, axis=axis)
+    elif not keepdims:
+        out = out.reshape(())
+    return out

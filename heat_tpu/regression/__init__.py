@@ -1,1 +1,3 @@
-"""Placeholder — populated in subsequent milestones."""
+"""Regression estimators (reference ``heat/regression/``)."""
+
+from .lasso import Lasso

@@ -1,0 +1,120 @@
+"""Lasso regression (reference ``heat/regression/lasso.py``).
+
+Coordinate descent with soft thresholding (reference ``lasso.py:90-176``):
+the per-feature loop runs on the controller, each sweep's matvecs are
+distributed GEMMs with GSPMD psum. Feature count is the loop bound exactly
+as in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import factories, types
+from ..core.base import BaseEstimator, RegressionMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["Lasso"]
+
+
+class Lasso(RegressionMixin, BaseEstimator):
+    """L1-regularized linear regression via coordinate descent
+    (reference ``lasso.py:15``)."""
+
+    def __init__(self, lam: float = 0.1, max_iter: int = 100, tol: float = 1e-6):
+        self.__lam = lam
+        self.max_iter = max_iter
+        self.tol = tol
+        self.__theta = None
+        self.n_iter = None
+
+    @property
+    def coef_(self):
+        return None if self.__theta is None else self.__theta[1:]
+
+    @property
+    def intercept_(self):
+        return None if self.__theta is None else self.__theta[:1]
+
+    @property
+    def lam(self):
+        return self.__lam
+
+    @lam.setter
+    def lam(self, arg):
+        self.__lam = arg
+
+    @property
+    def theta(self):
+        return self.__theta
+
+    @staticmethod
+    def soft_threshold(rho, lam):
+        """Soft-thresholding operator (reference ``lasso.py:73``)."""
+        return jnp.sign(rho) * jnp.maximum(jnp.abs(rho) - lam, 0.0)
+
+    @staticmethod
+    def rmse(gt, yest):
+        """Root mean squared error (reference ``lasso.py:84``)."""
+        return float(jnp.sqrt(jnp.mean((gt - yest) ** 2)))
+
+    def fit(self, x: DNDarray, y: DNDarray) -> "Lasso":
+        """Coordinate-descent fit (reference ``lasso.py:90-176``)."""
+        if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
+            raise TypeError("x and y need to be DNDarrays")
+        if x.ndim != 2:
+            raise ValueError("x needs to be 2-dimensional (n_samples, n_features)")
+        yl = y._logical().reshape(-1).astype(jnp.float32)
+        # prepend intercept column
+        xl = x._logical().astype(jnp.float32)
+        n, m = xl.shape
+        X = jnp.concatenate([jnp.ones((n, 1), jnp.float32), xl], axis=1)
+        mm = m + 1
+        theta = jnp.zeros((mm,), jnp.float32)
+        col_sq = jnp.sum(X * X, axis=0)  # feature normalizers
+
+        lam_n = self.__lam * n
+
+        import jax
+
+        @jax.jit
+        def sweep(theta):
+            def body(j, th):
+                pred = X @ th
+                resid = yl - pred + X[:, j] * th[j]
+                rho = X[:, j] @ resid
+                new = jnp.where(
+                    j == 0,
+                    rho / jnp.maximum(col_sq[0], 1e-30),  # intercept: no penalty
+                    Lasso.soft_threshold(rho, lam_n) / jnp.maximum(col_sq[j], 1e-30),
+                )
+                return th.at[j].set(new)
+
+            return jax.lax.fori_loop(0, mm, body, theta)
+
+        it = 0
+        for it in range(1, self.max_iter + 1):
+            new_theta = sweep(theta)
+            diff = float(jnp.max(jnp.abs(new_theta - theta)))
+            theta = new_theta
+            if diff < self.tol:
+                break
+
+        self.n_iter = it
+        self.__theta = factories.array(
+            np.asarray(theta).reshape(-1, 1), dtype=types.float32, comm=x.comm
+        )
+        return self
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Linear prediction (reference ``lasso.py:180``)."""
+        if self.__theta is None:
+            raise RuntimeError("fit needs to be called before predict")
+        xl = x._logical().astype(jnp.float32)
+        n = xl.shape[0]
+        X = jnp.concatenate([jnp.ones((n, 1), jnp.float32), xl], axis=1)
+        pred = X @ self.__theta._logical().reshape(-1)
+        return DNDarray.from_logical(pred.reshape(-1, 1), x.split, x.device, x.comm)

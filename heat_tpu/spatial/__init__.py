@@ -1,1 +1,4 @@
-"""Placeholder — populated in subsequent milestones."""
+"""Pairwise spatial distances (reference ``heat/spatial/``)."""
+
+from .distance import *
+from . import distance

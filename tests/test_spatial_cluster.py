@@ -1,0 +1,157 @@
+"""Tests for spatial distances and clustering (reference test strategy:
+``heat/spatial/tests``, ``heat/cluster/tests``)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def _blobs(n=64, d=4, k=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 10, size=(k, d))
+    labels = rng.integers(0, k, size=n)
+    return (centers[labels] + rng.normal(0, 0.5, size=(n, d))).astype(np.float32), labels
+
+
+def _np_cdist(a, b):
+    return np.sqrt(((a[:, None, :] - b[None, :, :]) ** 2).sum(-1))
+
+
+class TestCdist:
+    def test_replicated(self):
+        a, _ = _blobs(20, 3)
+        b, _ = _blobs(15, 3, seed=1)
+        expected = _np_cdist(a, b)
+        d = ht.spatial.cdist(ht.array(a), ht.array(b))
+        np.testing.assert_allclose(d.numpy(), expected, rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("quad", [False, True])
+    def test_split0_replicated_y(self, quad):
+        a, _ = _blobs(26, 3)  # uneven: 26 over 8 devices
+        b, _ = _blobs(5, 3, seed=1)
+        d = ht.spatial.cdist(ht.array(a, split=0), ht.array(b), quadratic_expansion=quad)
+        assert d.split == 0
+        np.testing.assert_allclose(d.numpy(), _np_cdist(a, b), rtol=1e-3, atol=1e-3)
+
+    @pytest.mark.parametrize("quad", [False, True])
+    def test_ring_split0_split0(self, quad):
+        a, _ = _blobs(26, 3)
+        b, _ = _blobs(19, 3, seed=1)
+        d = ht.spatial.cdist(
+            ht.array(a, split=0), ht.array(b, split=0), quadratic_expansion=quad
+        )
+        assert d.split == 0
+        assert d.shape == (26, 19)
+        np.testing.assert_allclose(d.numpy(), _np_cdist(a, b), rtol=1e-3, atol=1e-3)
+
+    def test_ring_symmetric(self):
+        a, _ = _blobs(24, 4)
+        x = ht.array(a, split=0)
+        d = ht.spatial.cdist(x)
+        np.testing.assert_allclose(d.numpy(), _np_cdist(a, a), rtol=1e-3, atol=1e-3)
+
+    def test_manhattan_and_rbf(self):
+        a, _ = _blobs(10, 3)
+        b, _ = _blobs(7, 3, seed=2)
+        man = ht.spatial.manhattan(ht.array(a, split=0), ht.array(b, split=0))
+        expected = np.abs(a[:, None, :] - b[None, :, :]).sum(-1)
+        np.testing.assert_allclose(man.numpy(), expected, rtol=1e-4, atol=1e-4)
+        r = ht.spatial.rbf(ht.array(a, split=0), ht.array(b), sigma=2.0)
+        expected_r = np.exp(-(_np_cdist(a, b) ** 2) / 8.0)
+        np.testing.assert_allclose(r.numpy(), expected_r, rtol=1e-3, atol=1e-4)
+
+
+class TestKMeans:
+    def test_separated_blobs(self):
+        data, _ = _blobs(200, 4, k=4, seed=3)
+        x = ht.array(data, split=0)
+        km = ht.cluster.KMeans(n_clusters=4, init="kmeans++", max_iter=50, random_state=7)
+        km.fit(x)
+        assert km.cluster_centers_.shape == (4, 4)
+        assert km.labels_.shape == (200,)
+        # tight clusters: inertia should be small relative to data spread
+        assert km.inertia_ < 0.5 * ((data - data.mean(0)) ** 2).sum()
+        # predict is consistent with labels
+        np.testing.assert_array_equal(km.predict(x).numpy(), km.labels_.numpy())
+
+    def test_given_centroids(self):
+        data, _ = _blobs(50, 2, k=2, seed=5)
+        init = ht.array(data[:2].copy())
+        km = ht.cluster.KMeans(n_clusters=2, init=init, max_iter=20)
+        km.fit(ht.array(data, split=0))
+        assert km.n_iter_ >= 1
+
+    def test_kmedians_kmedoids(self):
+        data, _ = _blobs(60, 3, k=3, seed=11)
+        x = ht.array(data, split=0)
+        kmed = ht.cluster.KMedians(n_clusters=3, init="random", max_iter=20, random_state=1)
+        kmed.fit(x)
+        assert kmed.cluster_centers_.shape == (3, 3)
+        kmdo = ht.cluster.KMedoids(n_clusters=3, init="random", max_iter=20, random_state=1)
+        kmdo.fit(x)
+        # medoids are actual data points
+        cc = kmdo.cluster_centers_.numpy()
+        for c in cc:
+            assert np.min(np.abs(data - c).sum(1)) < 1e-5
+
+    def test_spectral_runs(self):
+        data, _ = _blobs(40, 3, k=2, seed=13)
+        sp = ht.cluster.Spectral(n_clusters=2, gamma=0.1, n_lanczos=20)
+        sp.fit(ht.array(data, split=0))
+        assert sp.labels_.shape == (40,)
+
+
+class TestEstimators:
+    def test_lasso(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(80, 5)).astype(np.float32)
+        w = np.array([2.0, 0.0, -3.0, 0.0, 1.0], dtype=np.float32)
+        y = X @ w + 0.01 * rng.normal(size=80).astype(np.float32)
+        lasso = ht.regression.Lasso(lam=0.01, max_iter=200)
+        lasso.fit(ht.array(X, split=0), ht.array(y, split=0))
+        coef = lasso.coef_.numpy().ravel()
+        np.testing.assert_allclose(coef, w, atol=0.15)
+        pred = lasso.predict(ht.array(X, split=0))
+        assert pred.shape == (80, 1)
+
+    def test_gaussian_nb(self):
+        data, labels = _blobs(120, 3, k=3, seed=21)
+        # relabel by blob identity: regenerate with known labels
+        rng = np.random.default_rng(2)
+        centers = rng.normal(0, 10, size=(3, 3))
+        y = rng.integers(0, 3, size=120)
+        X = (centers[y] + rng.normal(0, 0.3, size=(120, 3))).astype(np.float32)
+        nb = ht.naive_bayes.GaussianNB()
+        nb.fit(ht.array(X, split=0), ht.array(y, split=0))
+        pred = nb.predict(ht.array(X, split=0)).numpy()
+        assert (pred == y).mean() > 0.95
+        proba = nb.predict_proba(ht.array(X[:5], split=0)).numpy()
+        np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-5)
+
+    def test_knn(self):
+        rng = np.random.default_rng(3)
+        centers = rng.normal(0, 10, size=(2, 4))
+        y = rng.integers(0, 2, size=100)
+        X = (centers[y] + rng.normal(0, 0.5, size=(100, 4))).astype(np.float32)
+        knn = ht.classification.KNeighborsClassifier(n_neighbors=3)
+        knn.fit(ht.array(X[:80], split=0), ht.array(y[:80]))
+        pred = knn.predict(ht.array(X[80:], split=0)).numpy()
+        assert (pred == y[80:]).mean() > 0.9
+
+    def test_laplacian(self):
+        data, _ = _blobs(20, 3, seed=31)
+        lap = ht.graph.Laplacian(
+            lambda x: ht.spatial.rbf(x, sigma=5.0), definition="norm_sym"
+        )
+        L = lap.construct(ht.array(data, split=0))
+        Ln = L.numpy()
+        np.testing.assert_allclose(np.diag(Ln), np.ones(20), atol=1e-5)
+        np.testing.assert_allclose(Ln, Ln.T, atol=1e-5)
+
+    def test_get_set_params(self):
+        km = ht.cluster.KMeans(n_clusters=3)
+        params = km.get_params()
+        assert params["n_clusters"] == 3
+        km.set_params(n_clusters=5)
+        assert km.n_clusters == 5
