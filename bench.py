@@ -1,0 +1,92 @@
+"""Benchmark entrypoint for the driver: prints ONE JSON line.
+
+Workload: the reference's headline benchmark — KMeans Lloyd iterations on a
+synthetic ``(n, 64)`` float32 split DNDarray (reference
+``benchmarks/kmeans/heat-cpu.py:20-26``, k=8) — run on whatever backend JAX
+selects (the real TPU chip under the driver).
+
+``value`` is sustained Lloyd iterations/second of the fused jitted step
+(assignment GEMM + argmin + one-hot update GEMM + psum), measured after
+compilation. ``vs_baseline`` compares against the reference-equivalent
+single-process PyTorch CPU implementation of the same iteration (torch is
+the reference's local compute backend), linearly extrapolated from a smaller
+sample so the baseline finishes quickly; >1 means faster than the baseline.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def tpu_kmeans_iter_per_s(n: int, d: int = 64, k: int = 8, iters: int = 20) -> float:
+    import heat_tpu as ht
+    from heat_tpu.cluster.kmeans import _lloyd_multi_step_fn
+
+    import jax
+    import jax.numpy as jnp
+
+    ht.random.seed(0)
+    x = ht.random.rand(n, d, dtype=ht.float32, split=0)
+    comm = x.comm
+    xp = x.larray
+    centroids = jnp.asarray(np.random.default_rng(0).random((k, d), dtype=np.float32))
+    # the whole hot loop is one compiled program (dispatch amortized)
+    run = _lloyd_multi_step_fn(xp.shape, jnp.dtype(jnp.float32), k, n, comm, iters)
+
+    # warmup/compile
+    c, labels, inertia, shift = run(xp, centroids)
+    jax.block_until_ready(c)
+
+    t0 = time.perf_counter()
+    c, labels, inertia, shift = run(xp, centroids)
+    jax.block_until_ready(c)
+    t1 = time.perf_counter()
+    return (iters + 1) / (t1 - t0)
+
+
+def torch_kmeans_time_per_iter(n: int, d: int = 64, k: int = 8, iters: int = 3) -> float:
+    """Reference-equivalent local Lloyd iteration in PyTorch (CPU)."""
+    import torch
+
+    g = torch.Generator().manual_seed(0)
+    x = torch.rand((n, d), generator=g)
+    c = torch.rand((k, d), generator=g)
+    # warmup
+    for _ in range(1):
+        d2 = torch.cdist(x, c) ** 2
+        labels = torch.argmin(d2, dim=1)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        d2 = torch.cdist(x, c) ** 2
+        labels = torch.argmin(d2, dim=1)
+        onehot = torch.nn.functional.one_hot(labels, k).to(x.dtype)
+        counts = onehot.sum(0)
+        c = (onehot.T @ x) / counts.clamp(min=1.0).unsqueeze(1)
+    t1 = time.perf_counter()
+    return (t1 - t0) / iters
+
+
+def main() -> None:
+    n = 1 << 23  # 8.4M points × 64 features ≈ 2.1 GB float32
+    n_torch = 1 << 19  # small torch sample, extrapolated linearly
+
+    ips = tpu_kmeans_iter_per_s(n)
+    t_torch_small = torch_kmeans_time_per_iter(n_torch)
+    t_torch_full_est = t_torch_small * (n / n_torch)
+    baseline_ips = 1.0 / t_torch_full_est
+
+    print(
+        json.dumps(
+            {
+                "metric": "kmeans_lloyd_iterations_per_second_8.4M_x64_k8_f32",
+                "value": round(ips, 3),
+                "unit": "iter/s",
+                "vs_baseline": round(ips / baseline_ips, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

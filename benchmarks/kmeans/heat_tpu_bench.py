@@ -1,0 +1,50 @@
+"""KMeans benchmark driver (reference ``benchmarks/kmeans/heat-cpu.py:20-26``:
+10 trials of fit with k=8, 30 iterations, timed with perf_counter).
+
+Synthetic data stands in for the cityscapes H5 when no file is given; pass
+``--file`` / ``--dataset`` to reproduce the reference workload exactly.
+"""
+
+import argparse
+import json
+import time
+
+import heat_tpu as ht
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=1 << 20)
+    p.add_argument("--d", type=int, default=64)
+    p.add_argument("--k", type=int, default=8)
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--trials", type=int, default=10)
+    p.add_argument("--file", type=str, default=None)
+    p.add_argument("--dataset", type=str, default="data")
+    args = p.parse_args()
+
+    if args.file:
+        data = ht.load(args.file, dataset=args.dataset, split=0)
+    else:
+        ht.random.seed(0)
+        data = ht.random.rand(args.n, args.d, dtype=ht.float32, split=0)
+
+    times = []
+    for _ in range(args.trials):
+        kmeans = ht.cluster.KMeans(n_clusters=args.k, init="kmeans++", max_iter=args.iters, tol=-1.0)
+        t0 = time.perf_counter()
+        kmeans.fit(data)
+        t1 = time.perf_counter()
+        times.append(t1 - t0)
+
+    print(json.dumps({
+        "benchmark": "kmeans",
+        "n": data.shape[0], "d": data.shape[1], "k": args.k, "iters": args.iters,
+        "trial_seconds": times,
+        "mean_seconds": sum(times) / len(times),
+        "iters_per_second": args.iters / (sum(times) / len(times)),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Lasso benchmark driver (reference ``benchmarks/lasso/``: fit wall-time)."""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=100_000)
+    p.add_argument("--d", type=int, default=64)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--trials", type=int, default=3)
+    args = p.parse_args()
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(args.n, args.d)).astype(np.float32)
+    w = np.zeros(args.d, np.float32)
+    w[: args.d // 4] = rng.normal(size=args.d // 4)
+    y = X @ w + 0.01 * rng.normal(size=args.n).astype(np.float32)
+
+    xd = ht.array(X, split=0)
+    yd = ht.array(y, split=0)
+
+    times = []
+    for _ in range(args.trials):
+        lasso = ht.regression.Lasso(lam=0.01, max_iter=args.iters, tol=-1.0)
+        t0 = time.perf_counter()
+        lasso.fit(xd, yd)
+        times.append(time.perf_counter() - t0)
+
+    print(json.dumps({
+        "benchmark": "lasso",
+        "n": args.n, "d": args.d, "iters": args.iters,
+        "trial_seconds": times,
+        "mean_seconds": sum(times) / len(times),
+    }))
+
+
+if __name__ == "__main__":
+    main()

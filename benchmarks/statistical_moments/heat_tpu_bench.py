@@ -1,0 +1,48 @@
+"""Statistical-moments benchmark driver (reference
+``benchmarks/statistical_moments/heat-cpu.py:21-28``: mean and std over
+axes None/0/1 of a split array)."""
+
+import argparse
+import json
+import time
+
+import jax
+
+import heat_tpu as ht
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=1 << 22)
+    p.add_argument("--d", type=int, default=64)
+    p.add_argument("--trials", type=int, default=5)
+    p.add_argument("--file", type=str, default=None)
+    p.add_argument("--dataset", type=str, default="data")
+    args = p.parse_args()
+
+    if args.file:
+        data = ht.load(args.file, dataset=args.dataset, split=0)
+    else:
+        ht.random.seed(0)
+        data = ht.random.rand(args.n, args.d, dtype=ht.float32, split=0)
+
+    results = {}
+    for axis in (None, 0, 1):
+        for name, fn in (("mean", ht.mean), ("std", ht.std)):
+            out = fn(data, axis)  # warmup
+            jax.block_until_ready(out.larray)
+            t0 = time.perf_counter()
+            for _ in range(args.trials):
+                out = fn(data, axis)
+                jax.block_until_ready(out.larray)
+            results[f"{name}_axis_{axis}"] = (time.perf_counter() - t0) / args.trials
+
+    print(json.dumps({
+        "benchmark": "statistical_moments",
+        "n": data.shape[0], "d": data.shape[1],
+        "seconds_per_op": results,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""KMeans on synthetic blobs (reference ``examples/cluster/demo_kmeans.py``
+equivalent). Run: ``python examples/cluster/demo_kmeans.py``."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+def main():
+    rng = np.random.default_rng(0)
+    k, d, n = 4, 8, 10_000
+    centers = rng.normal(0, 10, size=(k, d))
+    labels = rng.integers(0, k, size=n)
+    data = (centers[labels] + rng.normal(0, 0.5, size=(n, d))).astype(np.float32)
+
+    x = ht.array(data, split=0)
+    kmeans = ht.cluster.KMeans(n_clusters=k, init="kmeans++", random_state=1)
+    kmeans.fit(x)
+    print("converged after", kmeans.n_iter_, "iterations; inertia", kmeans.inertia_)
+    print("centroids:\n", kmeans.cluster_centers_.numpy().round(2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Data-parallel MLP training (reference ``examples/nn/mnist.py`` pattern).
+
+The reference launches with ``mpirun -np N``; here the same script runs on
+any mesh — the batch is sharded over the devices and gradients are psum'd by
+GSPMD inside the fused train step. Uses synthetic data unless MNIST IDX
+files are available under ``--data-root``.
+"""
+
+import argparse
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+def get_data(root):
+    if root:
+        ds = ht.utils.data.MNISTDataset(root, train=True, split=0)
+        return ds, 784, 10
+    rng = np.random.default_rng(0)
+    n, d, k = 4096, 64, 10
+    w = rng.normal(size=(d, k))
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.argmax(X @ w, axis=1).astype(np.int32)
+    ds = ht.utils.data.Dataset([ht.array(X, split=0), ht.array(y, split=0)])
+    return ds, d, k
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-root", type=str, default=None)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--lr", type=float, default=0.1)
+    args = p.parse_args()
+
+    import flax.linen as fnn
+
+    dataset, d_in, k = get_data(args.data_root)
+
+    class Net(fnn.Module):
+        @fnn.compact
+        def __call__(self, x):
+            x = x.reshape((x.shape[0], -1))
+            x = fnn.Dense(128)(x)
+            x = fnn.relu(x)
+            x = fnn.Dense(64)(x)
+            x = fnn.relu(x)
+            return fnn.Dense(k)(x)
+
+    optimizer = ht.optim.DataParallelOptimizer(ht.optim.SGD(lr=args.lr))
+    net = ht.nn.DataParallel(Net(), optimizer=optimizer)
+    loader = ht.utils.data.DataLoader(dataset=dataset, batch_size=args.batch_size)
+
+    for epoch in range(args.epochs):
+        losses = []
+        for bx, by in loader:
+            losses.append(net.step(bx, by))
+        print(f"epoch {epoch}: loss {np.mean(losses):.4f}")
+
+
+if __name__ == "__main__":
+    main()

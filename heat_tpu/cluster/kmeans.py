@@ -28,31 +28,57 @@ __all__ = ["KMeans"]
 _STEP_CACHE: dict = {}
 
 
+def _make_step_body(phys_shape, jdt, k, n_valid):
+    def _step(xp, centroids):
+        # valid-row mask for canonical padding
+        row = jax.lax.broadcasted_iota(jnp.int32, (phys_shape[0], 1), 0)
+        valid = row < n_valid
+        x2 = jnp.sum(xp * xp, axis=1, keepdims=True)
+        c2 = jnp.sum(centroids * centroids, axis=1, keepdims=True).T
+        d2 = x2 + c2 - 2.0 * (xp @ centroids.T)  # (N_pad, k) GEMM tile
+        labels = jnp.argmin(d2, axis=1)
+        onehot = (labels[:, None] == jnp.arange(k)[None, :]) & valid
+        onehot_f = onehot.astype(xp.dtype)
+        counts = jnp.sum(onehot_f, axis=0)  # (k,)  — psum by GSPMD
+        sums = onehot_f.T @ xp  # (k, d) GEMM — psum by GSPMD
+        new_centroids = sums / jnp.maximum(counts, 1.0)[:, None]
+        # keep empty clusters where they are (reference keeps old centroid)
+        new_centroids = jnp.where((counts > 0)[:, None], new_centroids, centroids)
+        inertia = jnp.sum(jnp.where(valid[:, 0], jnp.min(d2, axis=1), 0.0))
+        shift = jnp.sum((new_centroids - centroids) ** 2)
+        return new_centroids, labels, inertia, shift
+
+    return _step
+
+
 def _lloyd_step_fn(phys_shape, jdt, k, n_valid, comm):
     key = (phys_shape, str(jdt), k, n_valid, comm.cache_key)
     fn = _STEP_CACHE.get(key)
     if fn is None:
+        fn = jax.jit(_make_step_body(phys_shape, jdt, k, n_valid))
+        _STEP_CACHE[key] = fn
+    return fn
 
-        def _step(xp, centroids):
-            # valid-row mask for canonical padding
-            row = jax.lax.broadcasted_iota(jnp.int32, (phys_shape[0], 1), 0)
-            valid = row < n_valid
-            x2 = jnp.sum(xp * xp, axis=1, keepdims=True)
-            c2 = jnp.sum(centroids * centroids, axis=1, keepdims=True).T
-            d2 = x2 + c2 - 2.0 * (xp @ centroids.T)  # (N_pad, k) GEMM tile
-            labels = jnp.argmin(d2, axis=1)
-            onehot = (labels[:, None] == jnp.arange(k)[None, :]) & valid
-            onehot_f = onehot.astype(xp.dtype)
-            counts = jnp.sum(onehot_f, axis=0)  # (k,)  — psum by GSPMD
-            sums = onehot_f.T @ xp  # (k, d) GEMM — psum by GSPMD
-            new_centroids = sums / jnp.maximum(counts, 1.0)[:, None]
-            # keep empty clusters where they are (reference keeps old centroid)
-            new_centroids = jnp.where((counts > 0)[:, None], new_centroids, centroids)
-            inertia = jnp.sum(jnp.where(valid[:, 0], jnp.min(d2, axis=1), 0.0))
-            shift = jnp.sum((new_centroids - centroids) ** 2)
-            return new_centroids, labels, inertia, shift
 
-        fn = jax.jit(_step)
+def _lloyd_multi_step_fn(phys_shape, jdt, k, n_valid, comm, iters: int):
+    """``iters`` fused Lloyd iterations in one XLA program (``lax.fori_loop``).
+
+    Amortizes dispatch latency: the whole hot loop stays on device, exactly
+    the compiled-epoch discipline SURVEY.md §7 calls for (hard part 5)."""
+    key = ("multi", phys_shape, str(jdt), k, n_valid, iters, comm.cache_key)
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        single = _make_step_body(phys_shape, jdt, k, n_valid)
+
+        def _run(xp, centroids):
+            def body(_, c):
+                new_c, _, _, _ = single(xp, c)
+                return new_c
+
+            c = jax.lax.fori_loop(0, iters, body, centroids)
+            return single(xp, c)
+
+        fn = jax.jit(_run)
         _STEP_CACHE[key] = fn
     return fn
 

@@ -1,1 +1,39 @@
-"""Placeholder — populated in subsequent milestones."""
+"""Neural network layer (reference ``heat/nn/``).
+
+The reference exposes ``torch.nn`` lazily via module ``__getattr__``
+(``heat/nn/__init__.py:19-48``) and adds only ``DataParallel`` /
+``DataParallelMultiGPU``. The TPU-native equivalent passes through
+``flax.linen`` the same way (``ht.nn.Dense``, ``ht.nn.Conv`` …, plus
+torch-style aliases) and adds :class:`DataParallel` — data-parallel training
+over the mesh with GSPMD gradient psum instead of per-parameter MPI hooks.
+"""
+
+from __future__ import annotations
+
+import flax.linen as _linen
+
+from .data_parallel import DataParallel, DataParallelMultiGPU
+from . import functional
+from . import functional as F
+
+__all__ = ["DataParallel", "DataParallelMultiGPU", "functional", "F"]
+
+# torch-style aliases onto flax.linen (parity with the reference's
+# torch.nn passthrough, ``heat/nn/__init__.py:19-48``)
+_ALIASES = {
+    "Linear": "Dense",
+    "Conv1d": "Conv",
+    "Conv2d": "Conv",
+    "BatchNorm1d": "BatchNorm",
+    "BatchNorm2d": "BatchNorm",
+    "Embedding": "Embed",
+}
+
+
+def __getattr__(name):
+    if name in _ALIASES:
+        return getattr(_linen, _ALIASES[name])
+    try:
+        return getattr(_linen, name)
+    except AttributeError:
+        raise AttributeError(f"module 'heat_tpu.nn' has no attribute {name!r}")

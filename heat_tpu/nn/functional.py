@@ -1,0 +1,42 @@
+"""Functional NN ops (reference ``heat/nn/functional.py:9-33`` passes through
+``torch.nn.functional``; here the passthrough target is ``jax.nn``)."""
+
+from __future__ import annotations
+
+import jax.nn as _jnn
+import jax.numpy as _jnp
+
+relu = _jnn.relu
+sigmoid = _jnn.sigmoid
+softmax = _jnn.softmax
+log_softmax = _jnn.log_softmax
+gelu = _jnn.gelu
+silu = _jnn.silu
+swish = _jnn.silu
+elu = _jnn.elu
+leaky_relu = _jnn.leaky_relu
+tanh = _jnp.tanh
+one_hot = _jnn.one_hot
+
+
+def cross_entropy(logits, labels, axis: int = -1):
+    """Mean cross-entropy of integer labels against logits."""
+    logp = _jnn.log_softmax(logits, axis=axis)
+    picked = _jnp.take_along_axis(logp, labels[..., None], axis=axis)[..., 0]
+    return -_jnp.mean(picked)
+
+
+def mse_loss(pred, target):
+    return _jnp.mean((pred - target) ** 2)
+
+
+def nll_loss(logp, labels, axis: int = -1):
+    picked = _jnp.take_along_axis(logp, labels[..., None], axis=axis)[..., 0]
+    return -_jnp.mean(picked)
+
+
+def __getattr__(name):
+    try:
+        return getattr(_jnn, name)
+    except AttributeError:
+        raise AttributeError(f"module 'heat_tpu.nn.functional' has no attribute {name!r}")

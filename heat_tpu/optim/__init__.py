@@ -1,1 +1,15 @@
-"""Placeholder — populated in subsequent milestones."""
+"""Optimizers (reference ``heat/optim/``): torch.optim-style constructors
+mapped onto optax, plus the data-parallel wrappers and DASO."""
+
+from .dp_optimizer import (
+    DASO,
+    Adadelta,
+    Adagrad,
+    Adam,
+    AdamW,
+    DataParallelOptimizer,
+    RMSprop,
+    SGD,
+)
+from . import utils
+from .utils import DetectMetricPlateau

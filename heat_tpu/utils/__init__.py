@@ -1,1 +1,4 @@
-"""Placeholder — populated in subsequent milestones."""
+"""Utility subpackages (reference ``heat/utils/``)."""
+
+from . import data
+from . import vision_transforms

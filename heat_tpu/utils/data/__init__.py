@@ -1,1 +1,6 @@
-"""Placeholder — populated in subsequent milestones."""
+"""Data tools (reference ``heat/utils/data/``)."""
+
+from .datatools import DataLoader, Dataset, dataset_ishuffle, dataset_shuffle
+from .partial_dataset import PartialH5Dataset, PartialH5DataLoaderIter
+from .mnist import MNISTDataset
+from . import matrixgallery

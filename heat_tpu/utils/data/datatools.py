@@ -1,0 +1,127 @@
+"""Dataset and DataLoader (reference ``heat/utils/data/datatools.py``).
+
+The reference's ``Dataset`` holds a DNDarray's local shard as torch data
+(``datatools.py:143-245``) and the ``DataLoader`` wraps torch's with a
+post-epoch global shuffle (``:16-141``, ``dataset_shuffle/ishuffle``
+``:246-360``). Here the global array stays sharded on the mesh; batching is
+slicing along the (sharded) sample axis, and the epoch shuffle is one
+permutation applied globally (an XLA gather the partitioner turns into an
+all-to-all) — same semantics, no Send/Irecv pairs.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core import random as ht_random
+from ...core.dndarray import DNDarray
+
+__all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle"]
+
+
+class Dataset:
+    """Dataset over one or more DNDarrays sharing the sample axis
+    (reference ``datatools.py:143``)."""
+
+    def __init__(self, array, transforms=None, ishuffle: bool = False, test_set: bool = False):
+        arrays = array if isinstance(array, (list, tuple)) else [array]
+        for a in arrays:
+            if not isinstance(a, DNDarray):
+                raise TypeError(f"Dataset requires DNDarrays, got {type(a)}")
+        n = arrays[0].shape[0]
+        for a in arrays[1:]:
+            if a.shape[0] != n:
+                raise ValueError("all arrays must share the sample axis length")
+        self.arrays = list(arrays)
+        self.transforms = (
+            transforms if isinstance(transforms, (list, tuple)) else
+            ([transforms] * len(self.arrays) if transforms else [None] * len(self.arrays))
+        )
+        self.ishuffle = ishuffle
+        self.test_set = test_set
+
+    def __len__(self) -> int:
+        return self.arrays[0].shape[0]
+
+    def __getitem__(self, index):
+        items = []
+        for a, t in zip(self.arrays, self.transforms):
+            item = a[index]
+            if t is not None:
+                item = t(item)
+            items.append(item)
+        return items[0] if len(items) == 1 else tuple(items)
+
+    def shuffle(self):
+        """Global in-place shuffle (reference ``dataset_shuffle``)."""
+        dataset_shuffle(self)
+
+
+class DataLoader:
+    """Batched iteration with epoch-end global shuffle
+    (reference ``datatools.py:16-141``).
+
+    Yields batches as tuples of ``jax.Array`` slices of the sharded global
+    arrays — each batch stays distributed over the mesh (dp axis).
+    """
+
+    def __init__(
+        self,
+        dataset=None,
+        data=None,
+        batch_size: int = 1,
+        drop_last: bool = True,
+        shuffle: bool = True,
+        ishuffle: bool = False,
+        transforms=None,
+    ):
+        if dataset is None:
+            if data is None:
+                raise TypeError("either dataset or data must be given")
+            dataset = Dataset(data, transforms=transforms, ishuffle=ishuffle)
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.ishuffle = ishuffle
+        self._last_epoch = False
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator:
+        if self.shuffle:
+            self.dataset.shuffle()
+        n = len(self.dataset)
+        bs = self.batch_size
+        nb = len(self)
+        for i in range(nb):
+            lo = i * bs
+            hi = min(lo + bs, n)
+            batch = [a._logical()[lo:hi] for a in self.dataset.arrays]
+            yield batch[0] if len(batch) == 1 else tuple(batch)
+
+
+def dataset_shuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
+    """Globally shuffle the sample axis of every array in the dataset
+    (reference ``datatools.py:246``: pairwise Send/Irecv of shard halves;
+    here one permutation gather scheduled by XLA)."""
+    n = len(dataset)
+    perm = ht_random.randperm(n, comm=dataset.arrays[0].comm)._logical()
+    for i, a in enumerate(dataset.arrays):
+        shuffled = a._logical()[perm]
+        dataset.arrays[i] = DNDarray.from_logical(shuffled, a.split, a.device, a.comm, dtype=a.dtype)
+
+
+def dataset_ishuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
+    """Non-blocking shuffle (reference ``datatools.py:310``): dispatch is
+    asynchronous on device by construction, so this is the same operation."""
+    dataset_shuffle(dataset, attrs)

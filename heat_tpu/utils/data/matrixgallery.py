@@ -1,0 +1,28 @@
+"""Test-matrix gallery (reference ``heat/utils/data/matrixgallery.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core import types
+from ...core.communication import sanitize_comm
+from ...core.dndarray import DNDarray
+
+__all__ = ["parter"]
+
+
+def parter(n: int, split: Optional[int] = None, device=None, comm=None, dtype=types.float32) -> DNDarray:
+    """The Parter matrix ``A[i,j] = 1 / (i - j + 0.5)`` — a Cauchy matrix
+    with singular values clustered at π (reference ``matrixgallery.py:15``)."""
+    comm = sanitize_comm(comm)
+    dtype = types.canonical_heat_type(dtype)
+    i = jnp.arange(n, dtype=dtype.jax_type())[:, None]
+    j = jnp.arange(n, dtype=dtype.jax_type())[None, :]
+    a = 1.0 / (i - j + 0.5)
+    from ...core import devices as _devices
+
+    return DNDarray.from_logical(a, split, _devices.sanitize_device(device), comm, dtype=dtype)

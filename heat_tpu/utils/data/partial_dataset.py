@@ -1,0 +1,99 @@
+"""Out-of-core HDF5 dataset with background prefetch
+(reference ``heat/utils/data/partial_dataset.py:20-330``).
+
+The reference trains on H5 files larger than memory by loading the next file
+chunk in daemon threads through a ``queue.Queue`` while the current chunk is
+training. Same design here: a prefetch thread reads the next slab from disk
+and stages it to device while the current slab's batches are consumed.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...core.communication import sanitize_comm
+
+__all__ = ["PartialH5Dataset", "PartialH5DataLoaderIter"]
+
+
+class PartialH5Dataset:
+    """Chunked HDF5 streaming dataset (reference ``partial_dataset.py:20``)."""
+
+    def __init__(
+        self,
+        file: str,
+        comm=None,
+        dataset_names: Optional[List[str]] = None,
+        initial_load: int = 7000,
+        load_length: int = 1000,
+        use_gpu: bool = True,
+        np_buffer: bool = True,
+    ):
+        import h5py
+
+        self.file = file
+        self.comm = sanitize_comm(comm)
+        self.dataset_names = dataset_names or ["data"]
+        self.initial_load = initial_load
+        self.load_length = load_length
+        with h5py.File(file, "r") as handle:
+            self.total_size = handle[self.dataset_names[0]].shape[0]
+
+    def __len__(self) -> int:
+        return self.total_size
+
+    def thread_replace_converted_batches(self):
+        """Parity hook (reference ``partial_dataset.py:200``): chunk rotation
+        happens inside the loader iterator here."""
+        return None
+
+
+class PartialH5DataLoaderIter:
+    """Iterator that streams slabs with one prefetch thread
+    (reference ``PartialH5DataLoaderIter``, ``partial_dataset.py:230-330``)."""
+
+    def __init__(self, dataset: PartialH5Dataset, batch_size: int = 64, shuffle: bool = True, seed: int = 0):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.rng = np.random.default_rng(seed)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=2)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+
+    def _producer(self):
+        import h5py
+
+        ds = self.dataset
+        with h5py.File(ds.file, "r") as handle:
+            handles = [handle[name] for name in ds.dataset_names]
+            pos = 0
+            while pos < ds.total_size and not self._stop.is_set():
+                length = ds.initial_load if pos == 0 else ds.load_length
+                hi = min(pos + length, ds.total_size)
+                slab = [np.asarray(h[pos:hi]) for h in handles]
+                self._queue.put(slab)
+                pos = hi
+        self._queue.put(None)
+
+    def __iter__(self) -> Iterator:
+        while True:
+            slab = self._queue.get()
+            if slab is None:
+                break
+            n = slab[0].shape[0]
+            order = self.rng.permutation(n) if self.shuffle else np.arange(n)
+            for lo in range(0, n - self.batch_size + 1, self.batch_size):
+                idx = order[lo : lo + self.batch_size]
+                batch = [jnp.asarray(s[idx]) for s in slab]
+                yield batch[0] if len(batch) == 1 else tuple(batch)
+
+    def close(self):
+        self._stop.set()

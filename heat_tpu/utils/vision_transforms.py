@@ -1,0 +1,61 @@
+"""Vision transforms (reference ``heat/utils/vision_transforms.py:12-34``
+passes through torchvision.transforms). Native minimal set here — each is a
+callable over jax arrays — plus a passthrough when torchvision exists."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["Compose", "Normalize", "ToTensor", "Lambda"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    def __init__(self, mean, std):
+        self.mean = jnp.asarray(mean)
+        self.std = jnp.asarray(std)
+
+    def __call__(self, x):
+        return (x - self.mean) / self.std
+
+
+class ToTensor:
+    """uint8 HWC → float CHW in [0, 1]."""
+
+    def __call__(self, x):
+        x = jnp.asarray(x)
+        if x.dtype == jnp.uint8:
+            x = x.astype(jnp.float32) / 255.0
+        if x.ndim == 3:
+            x = jnp.moveaxis(x, -1, 0)
+        return x
+
+
+class Lambda:
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, x):
+        return self.fn(x)
+
+
+def __getattr__(name):
+    try:
+        import torchvision.transforms as _tvt
+
+        return getattr(_tvt, name)
+    except ImportError:
+        raise AttributeError(
+            f"transform {name!r} is not in the native set and torchvision is unavailable"
+        )
