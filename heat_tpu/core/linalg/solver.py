@@ -98,6 +98,11 @@ def lanczos(
         alpha = dot(w, vi)
         w = arithmetics.sub(w, arithmetics.mul(alpha, vi))
         w = arithmetics.sub(w, arithmetics.mul(beta, vs[-1]))
+        # full reorthogonalization: plain Lanczos loses orthogonality in
+        # float32; m is small so the extra matvec-free projections are cheap
+        for v in vs:
+            proj = dot(w, v)
+            w = arithmetics.sub(w, arithmetics.mul(proj, v))
         alphas.append(float(alpha.item()))
         betas.append(beta)
         vs.append(vi)

@@ -11,8 +11,9 @@ Differences by design:
 * ``bfloat16`` is a **native first-class dtype** (the MXU's preferred input
   format) — the reference can only move it over MPI by bit-casting to int16
   (``communication.py:137-138``);
-* promotion follows NumPy semantics via ``jnp.promote_types`` so results
-  match the NumPy-comparison test idiom of the reference suite.
+* promotion follows the **JAX lattice** via ``jnp.promote_types`` — notably
+  int + float32 stays float32 instead of NumPy's widening to float64, the
+  deliberate TPU-first choice (f64 is emulated on TPU).
 """
 
 from __future__ import annotations

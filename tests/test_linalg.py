@@ -1,0 +1,148 @@
+"""Linalg tests (reference ``heat/core/linalg/tests``): matmul for every
+split combination, QR/TSQR reconstruction, solvers, norms."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import assert_array_equal
+
+
+class TestMatmul:
+    @pytest.mark.parametrize("sa", [None, 0, 1])
+    @pytest.mark.parametrize("sb", [None, 0, 1])
+    def test_all_split_combinations(self, sa, sb):
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(13, 9)).astype(np.float32)  # uneven everywhere
+        b = rng.normal(size=(9, 11)).astype(np.float32)
+        r = ht.matmul(ht.array(a, split=sa), ht.array(b, split=sb))
+        np.testing.assert_allclose(r.numpy(), a @ b, rtol=1e-4, atol=1e-4)
+
+    def test_operator(self):
+        a = ht.ones((4, 5), split=0)
+        b = ht.ones((5, 3), split=0)
+        r = a @ b
+        np.testing.assert_allclose(r.numpy(), np.full((4, 3), 5.0))
+
+    def test_vector_cases(self):
+        rng = np.random.default_rng(1)
+        a = rng.normal(size=(6, 4)).astype(np.float32)
+        v = rng.normal(size=4).astype(np.float32)
+        r = ht.matmul(ht.array(a, split=0), ht.array(v, split=0))
+        np.testing.assert_allclose(r.numpy(), a @ v, rtol=1e-4, atol=1e-5)
+
+    def test_dot(self):
+        a = np.arange(5, dtype=np.float32)
+        d = ht.dot(ht.array(a, split=0), ht.array(a, split=0))
+        assert float(d.item()) == pytest.approx(float(a @ a))
+
+
+class TestDecompositions:
+    def test_qr_tsqr_split0(self):
+        rng = np.random.default_rng(2)
+        a = rng.normal(size=(256, 8)).astype(np.float32)
+        q, r = ht.linalg.qr(ht.array(a, split=0))
+        assert q.split == 0
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-3, atol=1e-3)
+        # orthonormal columns
+        np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(8), atol=1e-4)
+        # R upper triangular up to sign conventions
+        np.testing.assert_allclose(np.tril(r.numpy(), -1), 0, atol=1e-4)
+
+    def test_qr_replicated_and_split1(self):
+        rng = np.random.default_rng(3)
+        a = rng.normal(size=(10, 6)).astype(np.float32)
+        for split in (None, 1):
+            q, r = ht.linalg.qr(ht.array(a, split=split))
+            np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-4, atol=1e-4)
+
+    def test_svd(self):
+        rng = np.random.default_rng(4)
+        a = rng.normal(size=(128, 6)).astype(np.float32)
+        u, s, v = ht.linalg.svd(ht.array(a, split=0))
+        recon = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+        np.testing.assert_allclose(recon, a, rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.sort(s.numpy())[::-1], s.numpy(), atol=1e-5)
+
+    def test_det_inv(self):
+        a = np.array([[2.0, 0.0], [1.0, 3.0]], dtype=np.float32)
+        d = ht.linalg.det(ht.array(a, split=0))
+        assert float(d.item()) == pytest.approx(6.0, rel=1e-5)
+        inv = ht.linalg.inv(ht.array(a, split=0))
+        np.testing.assert_allclose(inv.numpy() @ a, np.eye(2), atol=1e-5)
+
+
+class TestSolvers:
+    def test_cg(self):
+        rng = np.random.default_rng(5)
+        m = rng.normal(size=(12, 12)).astype(np.float32)
+        A = m @ m.T + 12 * np.eye(12, dtype=np.float32)  # SPD
+        x_true = rng.normal(size=12).astype(np.float32)
+        b = A @ x_true
+        x = ht.linalg.cg(ht.array(A, split=0), ht.array(b), ht.zeros(12))
+        np.testing.assert_allclose(x.numpy(), x_true, rtol=1e-2, atol=1e-2)
+
+    def test_lanczos(self):
+        rng = np.random.default_rng(6)
+        m = rng.normal(size=(20, 20)).astype(np.float32)
+        A = (m + m.T) / 2
+        V, T = ht.linalg.lanczos(ht.array(A), 20)
+        # V T V^T ≈ A for full iteration count
+        recon = V.numpy() @ T.numpy() @ V.numpy().T
+        np.testing.assert_allclose(recon, A, rtol=1e-1, atol=1e-1)
+
+
+class TestNormsEtc:
+    def test_norms(self):
+        data = np.arange(1, 7, dtype=np.float32).reshape(2, 3)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            assert float(ht.norm(x).item()) == pytest.approx(np.linalg.norm(data), rel=1e-5)
+        v = np.array([3.0, -4.0], dtype=np.float32)
+        assert float(ht.linalg.vector_norm(ht.array(v, split=0)).item()) == pytest.approx(5.0)
+        assert float(ht.linalg.vector_norm(ht.array(v), ord=1).item()) == pytest.approx(7.0)
+        assert float(ht.linalg.vector_norm(ht.array(v), ord=np.inf).item()) == pytest.approx(4.0)
+
+    def test_tri_ops(self):
+        data = np.arange(20, dtype=np.float32).reshape(4, 5)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            assert_array_equal(ht.tril(x), np.tril(data))
+            assert_array_equal(ht.triu(x, 1), np.triu(data, 1))
+
+    def test_trace_outer_cross(self):
+        data = np.arange(9, dtype=np.float32).reshape(3, 3)
+        assert float(ht.linalg.trace(ht.array(data, split=0)).item()) == pytest.approx(12.0)
+        a = np.arange(3, dtype=np.float32)
+        b = np.arange(4, dtype=np.float32)
+        o = ht.linalg.outer(ht.array(a, split=0), ht.array(b))
+        np.testing.assert_allclose(o.numpy(), np.outer(a, b))
+        u = np.array([1.0, 0, 0], np.float32)
+        v = np.array([0, 1.0, 0], np.float32)
+        c = ht.linalg.cross(ht.array(u), ht.array(v))
+        np.testing.assert_allclose(c.numpy(), [0, 0, 1.0])
+
+    def test_projection_vecdot(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([1.0, 0.0, 0.0], np.float32)
+        p = ht.linalg.projection(ht.array(a, split=0), ht.array(b, split=0))
+        np.testing.assert_allclose(p.numpy(), [1.0, 0, 0])
+        vd = ht.linalg.vecdot(ht.array(a), ht.array(a))
+        assert float(vd.item()) == pytest.approx(14.0)
+
+
+class TestTiling:
+    def test_split_tiles(self):
+        x = ht.arange(20, split=0).reshape((4, 5))
+        x.resplit_(0)
+        tiles = ht.tiling.SplitTiles(x)
+        dims = tiles.tile_dimensions
+        assert sum(dims[0]) == 4
+        assert tiles.tile_locations.shape[0] == x.comm.size
+
+    def test_square_diag_tiles(self):
+        x = ht.zeros((16, 16), split=0)
+        t = ht.tiling.SquareDiagTiles(x, tiles_per_proc=1)
+        assert t.tile_rows >= 1 and t.tile_columns >= 1
+        assert len(t.row_indices) == t.tile_rows

@@ -1,0 +1,178 @@
+"""Manipulation tests mirroring the reference suite's core idiom
+(``heat/core/tests/test_manipulations.py``): every op runs for every split
+and is compared against NumPy."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import assert_array_equal, assert_func_equal
+
+
+SHAPE_2D = (5, 7)  # uneven over 8 devices on purpose
+SHAPE_3D = (3, 4, 5)
+
+
+class TestReshapeFamily:
+    def test_reshape(self):
+        assert_func_equal(
+            (6, 4), lambda a, **kw: ht.reshape(a, (8, 3)),
+            lambda a, **kw: np.reshape(a, (8, 3)),
+        )
+
+    def test_flatten_ravel(self):
+        assert_func_equal(SHAPE_3D, ht.flatten, np.ravel)
+        assert_func_equal(SHAPE_2D, ht.ravel, np.ravel)
+
+    def test_squeeze(self):
+        data = np.arange(12, dtype=np.float32).reshape(3, 1, 4)
+        for split in (None, 0, 2):
+            x = ht.array(data, split=split)
+            assert_array_equal(ht.squeeze(x, 1), data.squeeze(1))
+
+    def test_expand_dims(self):
+        data = np.arange(10, dtype=np.float32).reshape(2, 5)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            r = ht.expand_dims(x, 1)
+            assert_array_equal(r, np.expand_dims(data, 1))
+            if split == 1:
+                assert r.split == 2
+
+
+class TestJoinSplit:
+    def test_concatenate_splits(self):
+        a = np.arange(12, dtype=np.float32).reshape(4, 3)
+        b = np.arange(6, dtype=np.float32).reshape(2, 3)
+        for sa in (None, 0, 1):
+            for sb in (None, 0, 1):
+                x = ht.array(a, split=sa)
+                y = ht.array(b, split=sb)
+                assert_array_equal(ht.concatenate([x, y], 0), np.concatenate([a, b], 0))
+
+    def test_stack(self):
+        a = np.ones((3, 4), np.float32)
+        b = np.zeros((3, 4), np.float32)
+        for split in (None, 0, 1):
+            r = ht.stack([ht.array(a, split=split), ht.array(b, split=split)], axis=0)
+            assert_array_equal(r, np.stack([a, b]))
+
+    def test_hvd_stack(self):
+        a = np.arange(6, dtype=np.float32)
+        assert_array_equal(ht.hstack([ht.array(a, split=0), ht.array(a, split=0)]), np.hstack([a, a]))
+        assert_array_equal(ht.vstack([ht.array(a, split=0), ht.array(a, split=0)]), np.vstack([a, a]))
+        assert_array_equal(ht.column_stack([ht.array(a, split=0), ht.array(a, split=0)]), np.column_stack([a, a]))
+        assert_array_equal(ht.dstack([ht.array(a, split=0), ht.array(a, split=0)]), np.dstack([a, a]))
+
+    def test_split_fns(self):
+        data = np.arange(24, dtype=np.float32).reshape(6, 4)
+        x = ht.array(data, split=0)
+        parts = ht.split(x, 3, axis=0)
+        for p, ref in zip(parts, np.split(data, 3, axis=0)):
+            assert_array_equal(p, ref)
+        parts = ht.vsplit(x, 2)
+        assert len(parts) == 2
+        parts = ht.hsplit(x, 2)
+        for p, ref in zip(parts, np.hsplit(data, 2)):
+            assert_array_equal(p, ref)
+
+
+class TestReorder:
+    def test_flip(self):
+        assert_func_equal(SHAPE_2D, ht.flip, np.flip, heat_args={"axis": 0}, numpy_args={"axis": 0})
+        assert_func_equal(SHAPE_2D, ht.flipud, np.flipud)
+        assert_func_equal(SHAPE_2D, ht.fliplr, np.fliplr)
+
+    def test_roll(self):
+        assert_func_equal(SHAPE_2D, ht.roll, np.roll, heat_args={"shift": 2, "axis": 0},
+                          numpy_args={"shift": 2, "axis": 0})
+        assert_func_equal(SHAPE_2D, ht.roll, np.roll, heat_args={"shift": 3}, numpy_args={"shift": 3})
+
+    def test_rot90(self):
+        assert_func_equal(SHAPE_2D, ht.rot90, np.rot90)
+
+    def test_moveaxis_swapaxes(self):
+        data = np.arange(24, dtype=np.float32).reshape(SHAPE_3D[:2] + (2,))
+        for split in (None, 0, 1, 2):
+            x = ht.array(data, split=split)
+            assert_array_equal(ht.moveaxis(x, 0, 2), np.moveaxis(data, 0, 2))
+            assert_array_equal(ht.swapaxes(x, 0, 1), np.swapaxes(data, 0, 1))
+
+    def test_transpose_no_comm(self):
+        data = np.arange(20, dtype=np.float32).reshape(4, 5)
+        x = ht.array(data, split=0)
+        t = x.T
+        assert t.split == 1
+        assert_array_equal(t, data.T)
+
+
+class TestContent:
+    def test_pad(self):
+        assert_func_equal(SHAPE_2D, ht.pad, np.pad,
+                          heat_args={"pad_width": ((1, 2), (0, 1))},
+                          numpy_args={"pad_width": ((1, 2), (0, 1))})
+
+    def test_repeat_tile(self):
+        assert_func_equal((4, 3), ht.repeat, np.repeat, heat_args={"repeats": 2},
+                          numpy_args={"repeats": 2})
+        assert_func_equal((4, 3), ht.tile, np.tile, heat_args={"reps": (2, 1)},
+                          numpy_args={"reps": (2, 1)})
+
+    def test_diag(self):
+        v = np.arange(5, dtype=np.float32)
+        assert_array_equal(ht.diag(ht.array(v, split=0)), np.diag(v))
+        m = np.arange(20, dtype=np.float32).reshape(4, 5)
+        for split in (None, 0, 1):
+            assert_array_equal(ht.diagonal(ht.array(m, split=split)), np.diagonal(m))
+
+    def test_broadcast_to(self):
+        data = np.arange(5, dtype=np.float32)
+        x = ht.array(data, split=0)
+        r = ht.broadcast_to(x, (3, 5))
+        assert_array_equal(r, np.broadcast_to(data, (3, 5)))
+
+
+class TestOrderStatistics:
+    @pytest.mark.parametrize("descending", [False, True])
+    def test_sort_all_splits(self, descending):
+        rng = np.random.default_rng(0)
+        data = rng.normal(size=(9, 6)).astype(np.float32)
+        for split in (None, 0, 1):
+            for axis in (0, 1):
+                x = ht.array(data, split=split)
+                v, idx = ht.sort(x, axis=axis, descending=descending)
+                expected = np.sort(data, axis=axis)
+                if descending:
+                    expected = np.flip(expected, axis=axis)
+                assert_array_equal(v, expected)
+
+    def test_unique(self):
+        data = np.array([3, 1, 4, 1, 5, 9, 2, 6, 5, 3], dtype=np.int64)
+        for split in (None, 0):
+            r = ht.unique(ht.array(data, split=split))
+            np.testing.assert_array_equal(np.sort(r.numpy()), np.unique(data))
+
+    def test_topk(self):
+        data = np.array([[5.0, 1.0, 4.0, 2.0], [0.0, 3.0, 9.0, 7.0]], dtype=np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            v, i = ht.topk(x, 2)
+            np.testing.assert_array_equal(v.numpy(), np.sort(data, axis=1)[:, ::-1][:, :2])
+
+
+class TestResplit:
+    def test_out_of_place(self):
+        data = np.arange(35, dtype=np.float32).reshape(5, 7)
+        x = ht.array(data, split=0)
+        y = ht.resplit(x, 1)
+        assert x.split == 0 and y.split == 1
+        assert_array_equal(y, data)
+
+    def test_balance_redistribute(self):
+        x = ht.arange(10, split=0)
+        assert x.is_balanced()
+        b = ht.balance(x, copy=True)
+        assert_array_equal(b, np.arange(10))
+        r = ht.redistribute(x, target_map=x.lshape_map())
+        assert_array_equal(r, np.arange(10))

@@ -1,0 +1,83 @@
+"""RNG tests (reference ``heat/core/tests/test_random.py:9-60``: seed-reset
+reproducibility and cross-split equality of the counter-based streams)."""
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+class TestDeterminism:
+    def test_seed_reset_reproducibility(self):
+        ht.random.seed(42)
+        a = ht.random.rand(5, 7, split=0).numpy()
+        ht.random.seed(42)
+        b = ht.random.rand(5, 7, split=0).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_cross_split_equality(self):
+        # the defining property of the counter-based design: identical values
+        # regardless of distribution (reference test_random.py:9-60)
+        ht.random.seed(7)
+        a = ht.random.rand(6, 10, split=0).numpy()
+        ht.random.seed(7)
+        b = ht.random.rand(6, 10, split=1).numpy()
+        ht.random.seed(7)
+        c = ht.random.rand(6, 10, split=None).numpy()
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(a, c)
+
+    def test_get_set_state(self):
+        ht.random.seed(123)
+        ht.random.rand(4)
+        state = ht.random.get_state()
+        x = ht.random.rand(4).numpy()
+        ht.random.set_state(state)
+        y = ht.random.rand(4).numpy()
+        np.testing.assert_array_equal(x, y)
+        assert state[0] == "Threefry"
+
+    def test_streams_differ(self):
+        ht.random.seed(0)
+        a = ht.random.rand(100).numpy()
+        b = ht.random.rand(100).numpy()
+        assert not np.array_equal(a, b)
+
+
+class TestDistributions:
+    def test_rand_range(self):
+        ht.random.seed(1)
+        x = ht.random.rand(1000, split=0).numpy()
+        assert (x >= 0).all() and (x < 1).all()
+        assert abs(x.mean() - 0.5) < 0.05
+
+    def test_randn_moments(self):
+        ht.random.seed(2)
+        x = ht.random.randn(10000, split=0).numpy()
+        assert abs(x.mean()) < 0.05
+        assert abs(x.std() - 1.0) < 0.05
+
+    def test_randint(self):
+        ht.random.seed(3)
+        x = ht.random.randint(5, 15, (1000,), split=0)
+        v = x.numpy()
+        assert (v >= 5).all() and (v < 15).all()
+        assert x.dtype in (ht.int32, ht.int64)
+
+    def test_normal_uniform(self):
+        ht.random.seed(4)
+        x = ht.random.normal(3.0, 2.0, (5000,), split=0).numpy()
+        assert abs(x.mean() - 3.0) < 0.15
+        u = ht.random.uniform(-2.0, 2.0, (5000,), split=0).numpy()
+        assert (u >= -2).all() and (u < 2).all()
+
+    def test_randperm(self):
+        ht.random.seed(5)
+        p = ht.random.randperm(20, split=0).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(20))
+
+    def test_permutation_array(self):
+        ht.random.seed(6)
+        x = ht.arange(12, split=0)
+        p = ht.random.permutation(x)
+        np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(12))
+        assert p.split == 0

@@ -1,0 +1,152 @@
+"""Statistics tests (reference ``heat/core/tests/test_statistics.py``):
+every op over every split vs NumPy."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import assert_array_equal, assert_func_equal
+
+SHAPE = (7, 9)  # uneven over 8 devices
+
+
+class TestArgReductions:
+    def test_argmax_argmin(self):
+        rng = np.random.default_rng(0)
+        data = rng.normal(size=SHAPE).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            assert int(x.argmax().item()) == int(data.argmax())
+            assert int(x.argmin().item()) == int(data.argmin())
+            for axis in (0, 1):
+                assert_array_equal(x.argmax(axis), data.argmax(axis))
+                assert_array_equal(x.argmin(axis), data.argmin(axis))
+
+    def test_max_min(self):
+        assert_func_equal(SHAPE, ht.max, np.max)
+        assert_func_equal(SHAPE, ht.min, np.min)
+        assert_func_equal(SHAPE, ht.max, np.max, heat_args={"axis": 0}, numpy_args={"axis": 0})
+        assert_func_equal(SHAPE, ht.min, np.min, heat_args={"axis": 1}, numpy_args={"axis": 1})
+
+    def test_maximum_minimum(self):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        b = np.flip(a).copy()
+        for split in (None, 0, 1):
+            r = ht.maximum(ht.array(a, split=split), ht.array(b, split=split))
+            assert_array_equal(r, np.maximum(a, b))
+            r = ht.minimum(ht.array(a, split=split), ht.array(b, split=split))
+            assert_array_equal(r, np.minimum(a, b))
+
+
+class TestMoments:
+    def test_mean_var_std(self):
+        assert_func_equal(SHAPE, ht.mean, np.mean)
+        assert_func_equal(SHAPE, ht.var, np.var)
+        assert_func_equal(SHAPE, ht.std, np.std)
+        for axis in (0, 1):
+            assert_func_equal(SHAPE, ht.mean, np.mean, heat_args={"axis": axis}, numpy_args={"axis": axis})
+            assert_func_equal(SHAPE, ht.var, np.var, heat_args={"axis": axis}, numpy_args={"axis": axis})
+            assert_func_equal(SHAPE, ht.std, np.std, heat_args={"axis": axis}, numpy_args={"axis": axis})
+
+    def test_var_ddof(self):
+        data = np.random.default_rng(1).normal(size=20).astype(np.float32)
+        x = ht.array(data, split=0)
+        assert float(ht.var(x, ddof=1).item()) == pytest.approx(data.var(ddof=1), rel=1e-4)
+
+    def test_average_weighted(self):
+        data = np.arange(6, dtype=np.float32)
+        w = np.array([1, 1, 1, 1, 1, 5], dtype=np.float32)
+        r = ht.average(ht.array(data, split=0), weights=ht.array(w, split=0))
+        assert float(r.item()) == pytest.approx(np.average(data, weights=w), rel=1e-5)
+        r, s = ht.average(ht.array(data, split=0), returned=True)
+        assert float(s.item()) == 6.0
+
+    def test_skew_kurtosis(self):
+        rng = np.random.default_rng(2)
+        data = rng.normal(size=1000).astype(np.float32)
+        x = ht.array(data, split=0)
+        # normal data: skew ≈ 0, excess kurtosis ≈ 0
+        assert abs(float(ht.statistics.skew(x, unbiased=False).item())) < 0.3
+        assert abs(float(ht.statistics.kurtosis(x, unbiased=False).item())) < 0.5
+
+    def test_cov(self):
+        rng = np.random.default_rng(3)
+        data = rng.normal(size=(4, 50)).astype(np.float32)
+        c = ht.cov(ht.array(data, split=1))
+        np.testing.assert_allclose(c.numpy(), np.cov(data), rtol=1e-3, atol=1e-3)
+
+
+class TestOrderStats:
+    def test_median_percentile(self):
+        data = np.random.default_rng(4).normal(size=101).astype(np.float32)
+        x = ht.array(data, split=0)
+        assert float(ht.median(x).item()) == pytest.approx(float(np.median(data)), rel=1e-4)
+        assert float(ht.percentile(x, 25).item()) == pytest.approx(
+            float(np.percentile(data, 25)), rel=1e-3
+        )
+
+    def test_histogram_bincount(self):
+        data = np.random.default_rng(5).integers(0, 10, size=100)
+        x = ht.array(data, split=0)
+        b = ht.bincount(x)
+        np.testing.assert_array_equal(b.numpy(), np.bincount(data))
+        fdata = data.astype(np.float32)
+        h, edges = ht.histogram(ht.array(fdata, split=0), bins=5)
+        hn, en = np.histogram(fdata, bins=5)
+        np.testing.assert_array_equal(h.numpy(), hn)
+        np.testing.assert_allclose(edges.numpy(), en, rtol=1e-5)
+
+    def test_digitize_bucketize(self):
+        data = np.array([0.2, 6.4, 3.0, 1.6], dtype=np.float32)
+        bins = np.array([0.0, 1.0, 2.5, 4.0, 10.0], dtype=np.float32)
+        r = ht.statistics.digitize(ht.array(data, split=0), bins)
+        np.testing.assert_array_equal(r.numpy(), np.digitize(data, bins))
+
+
+class TestCumOps:
+    def test_cumsum_cumprod(self):
+        data = np.arange(1, 13, dtype=np.float32).reshape(3, 4)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            for axis in (0, 1):
+                assert_array_equal(ht.cumsum(x, axis), np.cumsum(data, axis=axis))
+                assert_array_equal(ht.cumprod(x, axis), np.cumprod(data, axis=axis))
+
+    def test_diff(self):
+        data = np.array([1.0, 4.0, 9.0, 16.0], dtype=np.float32)
+        r = ht.diff(ht.array(data, split=0))
+        np.testing.assert_array_equal(r.numpy(), np.diff(data))
+
+
+class TestLogical:
+    def test_all_any(self):
+        data = np.array([[1, 0, 1], [1, 1, 1]], dtype=np.int32)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            assert bool(ht.all(x).item()) == bool(data.all())
+            assert bool(ht.any(x).item()) == bool(data.any())
+            assert_array_equal(ht.all(x, axis=0), data.all(axis=0))
+            assert_array_equal(ht.any(x, axis=1), data.any(axis=1))
+
+    def test_allclose_isclose(self):
+        a = ht.ones((3, 3), split=0)
+        b = a + 1e-9
+        assert ht.allclose(a, b)
+        assert not ht.allclose(a, a + 1.0)
+        r = ht.isclose(a, a + 1e-9)
+        assert bool(r.all().item())
+
+    def test_isfinite_family(self):
+        data = np.array([1.0, np.inf, -np.inf, np.nan], dtype=np.float32)
+        x = ht.array(data, split=0)
+        np.testing.assert_array_equal(ht.isfinite(x).numpy(), np.isfinite(data))
+        np.testing.assert_array_equal(ht.isinf(x).numpy(), np.isinf(data))
+        np.testing.assert_array_equal(ht.isnan(x).numpy(), np.isnan(data))
+        np.testing.assert_array_equal(ht.isposinf(x).numpy(), np.isposinf(data))
+        np.testing.assert_array_equal(ht.isneginf(x).numpy(), np.isneginf(data))
+
+    def test_equal_global(self):
+        a = ht.arange(10, split=0)
+        assert ht.equal(a, a)
+        assert not ht.equal(a, a + 1)

@@ -1,0 +1,86 @@
+"""Type-system tests (reference ``heat/core/tests/test_types.py``)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+class TestTypeLattice:
+    def test_canonical(self):
+        assert ht.types.canonical_heat_type(np.float32) is ht.float32
+        assert ht.types.canonical_heat_type("f4") is ht.float32
+        assert ht.types.canonical_heat_type(float) is ht.float32
+        assert ht.types.canonical_heat_type(int) is ht.int64
+        assert ht.types.canonical_heat_type(bool) is ht.bool
+        assert ht.types.canonical_heat_type(ht.bfloat16) is ht.bfloat16
+        with pytest.raises(TypeError):
+            ht.types.canonical_heat_type("no-such-type")
+
+    def test_hierarchy(self):
+        assert issubclass(ht.float32, ht.floating)
+        assert issubclass(ht.bfloat16, ht.floating)
+        assert issubclass(ht.int32, ht.signedinteger)
+        assert issubclass(ht.uint8, ht.unsignedinteger)
+        assert issubclass(ht.complex64, ht.complexfloating)
+        assert ht.issubdtype(ht.float32, ht.floating)
+        assert ht.issubdtype(ht.int16, ht.number)
+        assert not ht.issubdtype(ht.float32, ht.integer)
+
+    def test_promote(self):
+        # JAX promotion lattice: int + float32 stays float32 (TPU-first —
+        # NumPy would widen to float64)
+        assert ht.promote_types(ht.int32, ht.float32) is ht.float32
+        assert ht.promote_types(ht.uint8, ht.int8) is ht.int16
+        assert ht.promote_types(ht.float32, ht.float64) is ht.float64
+        assert ht.promote_types(ht.bool, ht.uint8) is ht.uint8
+        assert ht.promote_types(ht.bfloat16, ht.float16) is ht.float32
+
+    def test_result_type(self):
+        a = ht.ones(3, dtype=ht.float32)
+        assert ht.result_type(a, ht.float64) is ht.float64
+
+    def test_finfo_iinfo(self):
+        fi = ht.finfo(ht.float32)
+        assert fi.bits == 32 and fi.eps == np.finfo(np.float32).eps
+        bf = ht.finfo(ht.bfloat16)
+        assert bf.bits == 16
+        ii = ht.iinfo(ht.int16)
+        assert ii.min == -32768 and ii.max == 32767
+        with pytest.raises(TypeError):
+            ht.finfo(ht.int32)
+        with pytest.raises(TypeError):
+            ht.iinfo(ht.float32)
+
+    def test_can_cast(self):
+        assert ht.can_cast(ht.int32, ht.int64)
+        assert ht.can_cast(ht.int64, ht.float32, casting="intuitive")
+        assert not ht.can_cast(ht.float32, ht.int32, casting="safe")
+
+    def test_type_call_creates_array(self):
+        x = ht.float32([1, 2, 3])
+        assert isinstance(x, ht.DNDarray)
+        assert x.dtype is ht.float32
+
+    def test_heat_type_of(self):
+        assert ht.heat_type_of([1, 2]) is ht.int64
+        assert ht.heat_type_of(np.zeros(3, np.uint8)) is ht.uint8
+        assert ht.heat_type_of(ht.ones(2, dtype=ht.int8)) is ht.int8
+
+    def test_exact_inexact(self):
+        assert ht.types.heat_type_is_exact(ht.int32)
+        assert ht.types.heat_type_is_inexact(ht.bfloat16)
+        assert not ht.types.heat_type_is_exact(ht.float64)
+
+    def test_astype(self):
+        x = ht.arange(5, split=0)
+        y = x.astype(ht.float32)
+        assert y.dtype is ht.float32
+        assert x.dtype is not ht.float32
+        np.testing.assert_array_equal(y.numpy(), np.arange(5, dtype=np.float32))
+
+    def test_bfloat16_native(self):
+        x = ht.ones((4, 4), dtype=ht.bfloat16, split=0)
+        s = x.sum()
+        assert float(s.item()) == 16.0
+        assert x.dtype is ht.bfloat16
