@@ -1,0 +1,153 @@
+"""DNDarray behavior tests (reference ``heat/core/tests/test_dndarray.py``)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import assert_array_equal
+
+
+class TestProperties:
+    def test_basic_props(self):
+        x = ht.zeros((10, 6), split=0)
+        assert x.shape == (10, 6)
+        assert x.gshape == (10, 6)
+        assert x.ndim == 2
+        assert x.size == 60
+        assert x.gnumel == 60
+        assert x.split == 0
+        assert x.dtype is ht.float32
+        assert x.itemsize == 4
+        assert x.nbytes == 240
+        assert x.balanced
+
+    def test_lshape_map(self):
+        x = ht.zeros((10,), split=0)
+        lmap = x.lshape_map()
+        assert lmap.shape == (8, 1)
+        assert lmap.sum() == 10
+        # ceil chunks: first devices get 2, tail gets 0
+        assert lmap[0, 0] == 2
+
+    def test_scalar_conversions(self):
+        x = ht.array(3.5)
+        assert float(x) == 3.5
+        assert int(ht.array(3)) == 3
+        assert bool(ht.array(True))
+        with pytest.raises(ValueError):
+            ht.arange(5).item()
+
+    def test_len_iteration(self):
+        x = ht.arange(12, split=0)
+        assert len(x) == 12
+
+    def test_numpy_and_array_protocol(self):
+        data = np.arange(6, dtype=np.float32).reshape(2, 3)
+        x = ht.array(data, split=1)
+        np.testing.assert_array_equal(np.asarray(x), data)
+        assert x.tolist() == data.tolist()
+
+
+class TestIndexing:
+    def test_basic_slicing(self):
+        data = np.arange(40, dtype=np.float32).reshape(8, 5)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            assert_array_equal(x[2], data[2])
+            assert_array_equal(x[1:5], data[1:5])
+            assert_array_equal(x[:, 2], data[:, 2])
+            assert_array_equal(x[2:6, 1:3], data[2:6, 1:3])
+            assert_array_equal(x[..., 0], data[..., 0])
+            assert float(x[3, 4].item()) == data[3, 4]
+
+    def test_negative_and_strided(self):
+        data = np.arange(20, dtype=np.float32)
+        x = ht.array(data, split=0)
+        assert_array_equal(x[-5:], data[-5:])
+        assert_array_equal(x[::2], data[::2])
+        assert_array_equal(x[::-1], data[::-1])
+
+    def test_boolean_mask(self):
+        data = np.arange(10, dtype=np.float32)
+        x = ht.array(data, split=0)
+        mask = x > 4
+        r = x[mask]
+        np.testing.assert_array_equal(r.numpy(), data[data > 4])
+        assert r.split == 0
+
+    def test_setitem(self):
+        data = np.zeros((6, 4), dtype=np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(data, split=split)
+            x[2] = 5.0
+            x[:, 1] = 7.0
+            expected = data.copy()
+            expected[2] = 5.0
+            expected[:, 1] = 7.0
+            assert_array_equal(x, expected)
+
+    def test_setitem_array_value(self):
+        x = ht.zeros((5, 3), split=0)
+        x[1:3] = ht.ones((2, 3))
+        assert x.numpy()[1:3].sum() == 6.0
+
+    def test_newaxis(self):
+        data = np.arange(6, dtype=np.float32)
+        x = ht.array(data, split=0)
+        assert x[None].shape == (1, 6)
+        assert x[:, None].shape == (6, 1)
+
+
+class TestHalo:
+    def test_array_with_halos(self):
+        data = np.arange(16, dtype=np.float32)
+        x = ht.array(data, split=0)
+        h = x.array_with_halos(1)
+        # every local block of 2 becomes 4 (1+2+1)
+        assert h.shape[0] == 8 * 4
+        # reconstruct: device 1's center must be rows 2..3, halos 1 and 4
+        blocks = np.asarray(h).reshape(8, 4)
+        np.testing.assert_array_equal(blocks[1], [1, 2, 3, 4])
+        # boundary zeros
+        assert blocks[0, 0] == 0.0
+        assert blocks[7, 3] == 0.0
+
+    def test_halo_validation(self):
+        x = ht.arange(16, split=0)
+        with pytest.raises(TypeError):
+            x.array_with_halos(-1)
+        with pytest.raises(ValueError):
+            x.array_with_halos(5)
+
+
+class TestMisc:
+    def test_copy(self):
+        x = ht.arange(5, split=0)
+        y = x.copy()
+        y[0] = 99
+        assert int(x[0].item()) == 0
+
+    def test_fill_diagonal(self):
+        x = ht.zeros((4, 4), split=0)
+        x.fill_diagonal(3.0)
+        np.testing.assert_array_equal(x.numpy(), np.eye(4) * 3.0)
+
+    def test_repr(self):
+        r = repr(ht.arange(3))
+        assert "DNDarray" in r and "split" in r
+        ht.local_printing()
+        r2 = repr(ht.arange(16, split=0))
+        assert "shards" in r2
+        ht.global_printing()
+
+    def test_cast_methods(self):
+        x = ht.arange(4, split=0)
+        assert (-x).numpy().tolist() == [0, -1, -2, -3]
+        assert abs(ht.array([-2.0, 3.0])).numpy().tolist() == [2.0, 3.0]
+        assert (~ht.array([0, -1])).numpy().tolist() == [-1, 0]
+
+    def test_comparison_chain(self):
+        x = ht.arange(5, split=0)
+        np.testing.assert_array_equal((x >= 2).numpy(), np.arange(5) >= 2)
+        np.testing.assert_array_equal((x != 3).numpy(), np.arange(5) != 3)

@@ -1,0 +1,149 @@
+"""I/O, printing, and communication-facade tests (reference
+``heat/core/tests/test_io.py``, ``test_communication.py``)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+class TestIO:
+    def test_hdf5_roundtrip(self, tmp_path):
+        data = np.random.default_rng(0).random((26, 5)).astype(np.float32)
+        path = str(tmp_path / "t.h5")
+        x = ht.array(data, split=0)
+        ht.save_hdf5(x, path, "data")
+        for split in (None, 0, 1):
+            y = ht.load_hdf5(path, "data", split=split)
+            np.testing.assert_allclose(y.numpy(), data, rtol=1e-6)
+            assert y.split == split
+
+    def test_load_dispatch(self, tmp_path):
+        data = np.arange(12, dtype=np.float32).reshape(4, 3)
+        p_h5 = str(tmp_path / "d.h5")
+        ht.save(ht.array(data), p_h5, "data")
+        y = ht.load(p_h5, dataset="data", split=0)
+        np.testing.assert_allclose(y.numpy(), data)
+        with pytest.raises(ValueError):
+            ht.load("nope.xyz")
+        with pytest.raises(TypeError):
+            ht.load(123)
+
+    def test_csv_roundtrip(self, tmp_path):
+        data = np.random.default_rng(1).random((9, 4)).astype(np.float32)
+        path = str(tmp_path / "t.csv")
+        ht.save_csv(ht.array(data, split=0), path)
+        y = ht.load_csv(path, split=0)
+        np.testing.assert_allclose(y.numpy(), data, rtol=1e-4, atol=1e-5)
+
+    def test_csv_header(self, tmp_path):
+        path = str(tmp_path / "h.csv")
+        with open(path, "w") as f:
+            f.write("a,b\n1.0,2.0\n3.0,4.0\n")
+        y = ht.load_csv(path, header_lines=1)
+        np.testing.assert_allclose(y.numpy(), [[1.0, 2.0], [3.0, 4.0]])
+
+    def test_netcdf_gated(self):
+        if not ht.io.supports_netcdf():
+            with pytest.raises(RuntimeError):
+                ht.io.load_netcdf("x.nc", "v")
+
+    def test_npy_dir(self, tmp_path):
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        b = np.arange(6, 12, dtype=np.float32).reshape(2, 3)
+        np.save(tmp_path / "a.npy", a)
+        np.save(tmp_path / "b.npy", b)
+        y = ht.io.load_npy_from_path(str(tmp_path), split=0)
+        np.testing.assert_allclose(y.numpy(), np.concatenate([a, b]))
+
+
+class TestCommFacade:
+    def test_chunk(self):
+        comm = ht.get_comm()
+        off, lshape, slices = comm.chunk((10, 4), 0, rank=0)
+        assert off == 0 and lshape == (2, 4)
+        off, lshape, _ = comm.chunk((10, 4), 0, rank=7)
+        assert lshape[0] == 0  # ceil-chunk tail can be empty
+        counts, displs = comm.counts_displs(10)
+        assert sum(counts) == 10
+        assert len(displs) == comm.size
+
+    def test_collectives_in_shard_map(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+
+        comm = ht.get_comm()
+        x = ht.arange(16, dtype=ht.float32, split=0)
+        spec = comm.spec(1, 0)
+
+        def body(blk):
+            s = comm.psum(jnp.sum(blk))
+            return jnp.broadcast_to(s, blk.shape)
+
+        fn = shard_map(body, mesh=comm.mesh, in_specs=spec, out_specs=spec, check_vma=False)
+        out = jax.jit(fn)(x.larray)
+        np.testing.assert_allclose(np.asarray(out), 120.0)
+
+    def test_ring_shift(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+
+        comm = ht.get_comm()
+        x = ht.arange(8, dtype=ht.float32, split=0)
+        spec = comm.spec(1, 0)
+
+        fn = shard_map(
+            lambda b: comm.ring_shift(b), mesh=comm.mesh, in_specs=spec, out_specs=spec,
+            check_vma=False,
+        )
+        out = np.asarray(jax.jit(fn)(x.larray))
+        np.testing.assert_array_equal(out, np.roll(np.arange(8), 1))
+
+    def test_exscan(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+
+        comm = ht.get_comm()
+        x = ht.ones(8, split=0)
+        spec = comm.spec(1, 0)
+        fn = shard_map(
+            lambda b: comm.exscan(jnp.sum(b)).reshape(1),
+            mesh=comm.mesh, in_specs=spec, out_specs=spec, check_vma=False,
+        )
+        out = np.asarray(jax.jit(fn)(x.larray))
+        np.testing.assert_array_equal(out, np.arange(8))
+
+    def test_split_subcomm(self):
+        comm = ht.get_comm()
+        sub = comm.Split([0, 1, 2, 3])
+        assert sub.size == 4
+        x = ht.arange(8, split=0, comm=sub)
+        assert int(x.sum().item()) == 28
+
+    def test_use_comm(self):
+        default = ht.get_comm()
+        sub = default.Split([0, 1])
+        ht.use_comm(sub)
+        try:
+            assert ht.get_comm().size == 2
+        finally:
+            ht.use_comm(default)
+        with pytest.raises(TypeError):
+            ht.use_comm("nope")
+
+
+class TestPrinting:
+    def test_printoptions(self):
+        ht.set_printoptions(precision=2)
+        assert ht.get_printoptions()["precision"] == 2
+        ht.set_printoptions(profile="default")
+        assert ht.get_printoptions()["precision"] == 4
+
+    def test_print0(self, capsys):
+        ht.print0("hello")
+        assert "hello" in capsys.readouterr().out
