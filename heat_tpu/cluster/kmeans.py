@@ -71,11 +71,11 @@ def _lloyd_multi_step_fn(phys_shape, jdt, k, n_valid, comm, iters: int):
         single = _make_step_body(phys_shape, jdt, k, n_valid)
 
         def _run(xp, centroids):
-            def body(_, c):
-                new_c, _, _, _ = single(xp, c)
-                return new_c
-
-            c = jax.lax.fori_loop(0, iters, body, centroids)
+            # statically unrolled: modest HLO growth for typical iteration
+            # counts, and avoids While-loop lowering entirely
+            c = centroids
+            for _ in range(iters):
+                c, _, _, _ = single(xp, c)
             return single(xp, c)
 
         fn = jax.jit(_run)

@@ -1,4 +1,13 @@
-"""Utility subpackages (reference ``heat/utils/``)."""
+"""Utility subpackages (reference ``heat/utils/``), plus checkpoint/resume
+and profiling subsystems the reference lacks (SURVEY.md §5)."""
 
 from . import data
 from . import vision_transforms
+from . import checkpointing
+from . import profiling
+from .checkpointing import (
+    checkpoint_estimator,
+    load_checkpoint,
+    restore_estimator,
+    save_checkpoint,
+)

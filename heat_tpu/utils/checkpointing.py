@@ -1,0 +1,153 @@
+"""Checkpoint / resume subsystem.
+
+The reference has **no unified checkpoint subsystem** (SURVEY.md §5): tensor
+save/load goes through ``ht.save``/``ht.load`` and optimizer state through
+``DetectMetricPlateau.get_state/set_state``; model checkpointing is left to
+user scripts. This module exceeds that: one API that checkpoints DNDarrays,
+arbitrary JAX pytrees (flax params / optax states), and estimator state,
+with atomic writes for crash safety.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import factories, types
+from ..core.dndarray import DNDarray
+
+__all__ = ["save_checkpoint", "load_checkpoint", "checkpoint_estimator", "restore_estimator"]
+
+_MANIFEST = "manifest.json"
+
+
+def _flatten(tree, prefix=""):
+    """Flatten nested dicts/lists of arrays into path → leaf."""
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}/{k}" if prefix else str(k)))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}/{i}" if prefix else str(i)))
+    else:
+        out[prefix] = tree
+    return out
+
+
+def save_checkpoint(path: str, state: Dict[str, Any], step: Optional[int] = None) -> None:
+    """Write ``state`` (a dict of DNDarrays, pytrees, or scalars) atomically.
+
+    Layout: ``<path>/manifest.json`` plus one ``.npz`` holding every array
+    leaf. DNDarray split/dtype metadata is preserved for exact restore.
+    """
+    os.makedirs(path, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    manifest: Dict[str, Any] = {"step": step, "entries": {}}
+
+    for name, value in state.items():
+        if isinstance(value, DNDarray):
+            arrays[name] = value.numpy()
+            manifest["entries"][name] = {
+                "kind": "dndarray",
+                "split": value.split,
+                "dtype": value.dtype.__name__,
+            }
+        elif isinstance(value, (int, float, str, bool)) or value is None:
+            manifest["entries"][name] = {"kind": "scalar", "value": value}
+        else:
+            # arbitrary pytree (flax params, optax state)
+            leaves = _flatten(value)
+            keys = []
+            for leaf_path, leaf in leaves.items():
+                arr_key = f"{name}::{leaf_path}"
+                arrays[arr_key] = np.asarray(leaf)
+                keys.append(leaf_path)
+            manifest["entries"][name] = {"kind": "pytree", "leaves": keys}
+
+    tmp_fd, tmp_npz = tempfile.mkstemp(dir=path, suffix=".tmp.npz")
+    os.close(tmp_fd)
+    np.savez(tmp_npz, **arrays)
+    os.replace(tmp_npz, os.path.join(path, "arrays.npz"))
+
+    tmp_fd, tmp_json = tempfile.mkstemp(dir=path, suffix=".json.tmp")
+    with os.fdopen(tmp_fd, "w") as f:
+        json.dump(manifest, f)
+    os.replace(tmp_json, os.path.join(path, _MANIFEST))
+
+
+def _unflatten(leaves: Dict[str, np.ndarray]):
+    """Rebuild the nested dict structure from path → leaf."""
+    root: Dict[str, Any] = {}
+    for path, leaf in leaves.items():
+        parts = path.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(leaf)
+    return root
+
+
+def load_checkpoint(path: str) -> Dict[str, Any]:
+    """Restore a checkpoint written by :func:`save_checkpoint`."""
+    with open(os.path.join(path, _MANIFEST)) as f:
+        manifest = json.load(f)
+    with np.load(os.path.join(path, "arrays.npz")) as npz:
+        arrays = {k: npz[k] for k in npz.files}
+
+    state: Dict[str, Any] = {"__step__": manifest.get("step")}
+    for name, meta in manifest["entries"].items():
+        if meta["kind"] == "dndarray":
+            state[name] = factories.array(
+                arrays[name],
+                dtype=getattr(types, meta["dtype"]),
+                split=meta["split"],
+            )
+        elif meta["kind"] == "scalar":
+            state[name] = meta["value"]
+        else:
+            leaves = {
+                leaf_path: arrays[f"{name}::{leaf_path}"] for leaf_path in meta["leaves"]
+            }
+            state[name] = _unflatten(leaves)
+    return state
+
+
+def checkpoint_estimator(path: str, estimator, step: Optional[int] = None) -> None:
+    """Checkpoint an sklearn-style estimator's params + learned state."""
+    state: Dict[str, Any] = {}
+    for key, value in vars(estimator).items():
+        clean = key.split("__")[-1] if "__" in key else key
+        if isinstance(value, DNDarray):
+            state[f"attr:{clean}"] = value
+        elif isinstance(value, (int, float, str, bool)) or value is None:
+            state[f"attr:{clean}"] = value
+    state["__class__"] = type(estimator).__name__
+    save_checkpoint(path, state, step=step)
+
+
+def restore_estimator(path: str, estimator):
+    """Restore attributes saved by :func:`checkpoint_estimator` in place."""
+    state = load_checkpoint(path)
+    cls = state.pop("__class__", None)
+    if cls is not None and cls != type(estimator).__name__:
+        raise TypeError(f"checkpoint holds a {cls}, not a {type(estimator).__name__}")
+    state.pop("__step__", None)
+    for key, value in state.items():
+        if key.startswith("attr:"):
+            name = key[len("attr:"):]
+            # find the matching (possibly name-mangled) attribute
+            for attr in vars(estimator):
+                if attr == name or attr.endswith("__" + name):
+                    setattr(estimator, attr, value)
+                    break
+            else:
+                setattr(estimator, name, value)
+    return estimator

@@ -1,0 +1,71 @@
+"""Profiling hooks.
+
+The reference has **no tracing/profiling support** (SURVEY.md §5 —
+benchmarks use bare ``time.perf_counter``). On TPU, ``jax.profiler`` traces
+are nearly free, so this module exposes them first-class: TensorBoard-format
+device traces, named annotation scopes, and a simple wall-time timer that
+syncs properly (``block_until_ready``) so users don't time dispatch instead
+of compute.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+__all__ = ["trace", "annotate", "Timer", "start_trace", "stop_trace"]
+
+
+def start_trace(logdir: str) -> None:
+    """Begin a device trace viewable in TensorBoard/XProf."""
+    jax.profiler.start_trace(logdir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Context manager around a device trace."""
+    start_trace(logdir)
+    try:
+        yield
+    finally:
+        stop_trace()
+
+
+def annotate(name: str):
+    """Named scope that shows up on the trace timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class Timer:
+    """Device-synchronized wall timer.
+
+    >>> with Timer("kmeans-epoch") as t:
+    ...     result = step(x, c)
+    ...     t.sync(result)
+    >>> t.seconds
+    """
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.seconds: Optional[float] = None
+        self._sync_target = None
+
+    def sync(self, value) -> None:
+        self._sync_target = value
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._sync_target is not None:
+            jax.block_until_ready(self._sync_target)
+        self.seconds = time.perf_counter() - self._t0
+        return False
