@@ -19,7 +19,9 @@ from .dndarray import DNDarray
 
 __all__ = [
     "sanitize_in",
+    "sanitize_in_tensor",
     "sanitize_infinity",
+    "sanitize_lshape",
     "sanitize_sequence",
     "sanitize_out",
     "sanitize_distribution",
@@ -31,6 +33,34 @@ def sanitize_in(x) -> None:
     """Verify ``x`` is a DNDarray (reference ``sanitation.py:14``)."""
     if not isinstance(x, DNDarray):
         raise TypeError(f"input must be a DNDarray, got {type(x)}")
+
+
+def sanitize_in_tensor(x) -> None:
+    """Verify ``x`` is a backend array (reference checks torch.Tensor,
+    ``sanitation.py:200``; the backend tensor here is ``jax.Array``)."""
+    import jax
+
+    if not isinstance(x, (jax.Array,)):
+        raise TypeError(f"input must be a jax.Array, got {type(x)}")
+
+
+def sanitize_lshape(array, tensor) -> None:
+    """Verify a local tensor fits the array's shard layout
+    (reference ``sanitation.py:220``)."""
+    import numpy as np_
+
+    tshape = tuple(tensor.shape)
+    gshape = tuple(array.gshape)
+    if array.split is None:
+        if tshape != gshape:
+            raise ValueError(f"local tensor shape {tshape} does not match global shape {gshape}")
+        return
+    expected = list(gshape)
+    expected[array.split] = array.larray.shape[array.split]
+    if tshape != tuple(expected):
+        raise ValueError(
+            f"local tensor shape {tshape} inconsistent with canonical physical shape {tuple(expected)}"
+        )
 
 
 def sanitize_infinity(x):

@@ -59,6 +59,7 @@ __all__ = [
     "double",
     "complex64",
     "cfloat",
+    "csingle",
     "complex128",
     "cdouble",
     "canonical_heat_type",
@@ -225,6 +226,7 @@ float = float32  # noqa: A001
 float_ = float32
 double = float64
 cfloat = complex64
+csingle = complex64
 cdouble = complex128
 
 
