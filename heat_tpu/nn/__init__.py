@@ -15,8 +15,19 @@ import flax.linen as _linen
 from .data_parallel import DataParallel, DataParallelMultiGPU
 from . import functional
 from . import functional as F
+from . import attention
+from .attention import local_attention, ring_attention, ulysses_attention
 
-__all__ = ["DataParallel", "DataParallelMultiGPU", "functional", "F"]
+__all__ = [
+    "DataParallel",
+    "DataParallelMultiGPU",
+    "functional",
+    "F",
+    "attention",
+    "local_attention",
+    "ring_attention",
+    "ulysses_attention",
+]
 
 # torch-style aliases onto flax.linen (parity with the reference's
 # torch.nn passthrough, ``heat/nn/__init__.py:19-48``)

@@ -1,0 +1,185 @@
+"""Sequence/context-parallel attention over the mesh.
+
+The reference contains no attention code; what it has are the three
+communication primitives long-sequence parallelism is built from
+(SURVEY.md §5): the halo exchange (``heat/core/dndarray.py:360-433``), the
+systolic ring of ``cdist`` (``heat/spatial/distance.py:280-362``), and the
+axis-swap all-to-all (``heat/core/communication.py:1199-1341``). This module
+completes them into the two standard long-context attention schemes, TPU
+native:
+
+* :func:`ring_attention` — blockwise attention with online (flash-style)
+  softmax statistics; K/V blocks circulate the ring via ``ppermute`` while
+  each device keeps its Q shard. Communication overlaps with the tile GEMMs.
+  O(seq/devices) memory per device; exact (not approximate).
+* :func:`ulysses_attention` — the all-to-all scheme: swap the sharded axis
+  from sequence to heads (``lax.all_to_all``), run dense local attention per
+  head group, swap back. Cheaper for many-head models when seq/heads ratios
+  allow.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+
+from ..core.communication import TPUCommunication, sanitize_comm
+from ..core.dndarray import DNDarray
+
+__all__ = ["ring_attention", "ulysses_attention", "local_attention"]
+
+_ATTN_CACHE: dict = {}
+
+
+def local_attention(q, k, v, scale: Optional[float] = None, causal: bool = False):
+    """Plain dense attention on local arrays (the single-device tile)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if causal:
+        qn, kn = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((qn, kn), bool), kn - qn)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", weights, v)
+
+
+def _ring_body(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float):
+    """Per-device ring attention with online softmax accumulation.
+
+    q_blk: (B, Sq_local, H, D); k/v blk circulate. Accumulates
+    (numerator, denominator, running max) so the result is exactly softmax
+    over the full global key axis.
+    """
+    size = comm.size
+    axis = comm.axis_name
+    perm = [(j, (j + 1) % size) for j in range(size)]
+
+    B, Sq, H, D = q_blk.shape
+    q_heads = jnp.moveaxis(q_blk, 2, 1)  # (B, H, Sq, D)
+
+    acc = jnp.zeros((B, H, Sq, D), jnp.float32)
+    denom = jnp.zeros((B, H, Sq), jnp.float32)
+    run_max = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
+
+    k_cur, v_cur = k_blk, v_blk
+    for step in range(size):
+        k_heads = jnp.moveaxis(k_cur, 2, 1)
+        v_heads = jnp.moveaxis(v_cur, 2, 1)
+        logits = (
+            jnp.einsum("bhqd,bhkd->bhqk", q_heads.astype(jnp.float32), k_heads.astype(jnp.float32))
+            * scale
+        )
+        blk_max = jnp.max(logits, axis=-1)
+        new_max = jnp.maximum(run_max, blk_max)
+        correction = jnp.exp(run_max - new_max)
+        p = jnp.exp(logits - new_max[..., None])
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_heads.astype(jnp.float32)
+        )
+        denom = denom * correction + jnp.sum(p, axis=-1)
+        run_max = new_max
+        if step != size - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+
+    out = acc / jnp.maximum(denom[..., None], 1e-30)
+    return jnp.moveaxis(out, 1, 2).astype(q_blk.dtype)  # (B, Sq, H, D)
+
+
+def ring_attention(q, k, v, comm=None, scale: Optional[float] = None):
+    """Exact attention over a sequence sharded across the mesh.
+
+    Inputs: ``(batch, seq, heads, head_dim)`` jax arrays (or DNDarrays split
+    along the sequence axis, axis 1). The K/V blocks circulate the ring —
+    the reference's cdist systolic skeleton (``distance.py:280-362``) with
+    flash-attention accumulation in place of the distance tile.
+    """
+    wrapped = isinstance(q, DNDarray)
+    if wrapped:
+        comm = q.comm
+        if q.split != 1:
+            raise ValueError("ring_attention expects sequence-split (split=1) inputs")
+        qa, ka, va = q.larray, k.larray, v.larray
+    else:
+        comm = sanitize_comm(comm)
+        qa, ka, va = q, k, v
+    if scale is None:
+        scale = 1.0 / math.sqrt(qa.shape[-1])
+
+    key = ("ring_attn", qa.shape, ka.shape, str(qa.dtype), float(scale), comm.cache_key)
+    fn = _ATTN_CACHE.get(key)
+    if fn is None:
+        spec = comm.spec(4, 1)  # (batch, seq✂, heads, dim)
+        body = partial(_ring_body, comm=comm, scale=scale)
+        sm = shard_map(
+            body, mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+        )
+        fn = jax.jit(sm)
+        _ATTN_CACHE[key] = fn
+    out = fn(qa, ka, va)
+    if wrapped:
+        return DNDarray(out, q.gshape, q.dtype, 1, q.device, comm)
+    return out
+
+
+def ulysses_attention(q, k, v, comm=None, scale: Optional[float] = None):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Sequence-sharded ``(B, S✂, H, D)`` → all_to_all → head-sharded
+    ``(B, S, H/size✂, D)`` → dense local attention → all_to_all back. The
+    axis swap is the reference's ``Alltoallw`` resplit primitive
+    (``communication.py:1199-1341``) as one XLA collective. Requires
+    ``heads % mesh_size == 0``.
+    """
+    wrapped = isinstance(q, DNDarray)
+    if wrapped:
+        comm = q.comm
+        if q.split != 1:
+            raise ValueError("ulysses_attention expects sequence-split (split=1) inputs")
+        qa, ka, va = q.larray, k.larray, v.larray
+    else:
+        comm = sanitize_comm(comm)
+        qa, ka, va = q, k, v
+    size = comm.size
+    H = qa.shape[2]
+    if H % size != 0:
+        raise ValueError(f"heads ({H}) must be divisible by mesh size ({size})")
+    if scale is None:
+        scale = 1.0 / math.sqrt(qa.shape[-1])
+
+    key = ("ulysses", qa.shape, str(qa.dtype), float(scale), comm.cache_key)
+    fn = _ATTN_CACHE.get(key)
+    if fn is None:
+        spec = comm.spec(4, 1)
+        axis = comm.axis_name
+
+        def body(qb, kb, vb):
+            # (B, s, H, D) local → heads sharded: (B, S, H/size, D)
+            def seq2head(x):
+                return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+            def head2seq(x):
+                return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+            qh, kh, vh = seq2head(qb), seq2head(kb), seq2head(vb)
+            out = local_attention(
+                jnp.moveaxis(qh, 2, 1), jnp.moveaxis(kh, 2, 1), jnp.moveaxis(vh, 2, 1), scale
+            )
+            out = jnp.moveaxis(out, 1, 2)  # back to (B, S, h, D)
+            return head2seq(out)
+
+        sm = shard_map(
+            body, mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+        )
+        fn = jax.jit(sm)
+        _ATTN_CACHE[key] = fn
+    out = fn(qa, ka, va)
+    if wrapped:
+        return DNDarray(out, q.gshape, q.dtype, 1, q.device, comm)
+    return out
