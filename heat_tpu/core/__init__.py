@@ -53,3 +53,5 @@ from .base import *
 from . import base
 from .linalg import *
 from . import linalg
+from .pallas_kernels import pallas_enabled, set_pallas
+from . import pallas_kernels

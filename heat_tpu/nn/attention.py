@@ -30,6 +30,7 @@ from jax import shard_map
 
 from ..core.communication import TPUCommunication, sanitize_comm
 from ..core.dndarray import DNDarray
+from ..core.pallas_kernels import flash_attention, pallas_enabled
 
 __all__ = ["ring_attention", "ulysses_attention", "local_attention"]
 
@@ -40,6 +41,9 @@ def local_attention(q, k, v, scale: Optional[float] = None, causal: bool = False
     """Plain dense attention on local arrays (the single-device tile)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if pallas_enabled() and q.ndim == 4:
+        # blockwise online-softmax kernel (Pallas, VMEM tiles)
+        return flash_attention(q, k, v, scale=float(scale), causal=causal)
     logits = jnp.einsum("...qd,...kd->...qk", q, k) * scale
     if causal:
         qn, kn = logits.shape[-2], logits.shape[-1]
@@ -62,6 +66,28 @@ def _ring_body(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float):
 
     B, Sq, H, D = q_blk.shape
     q_heads = jnp.moveaxis(q_blk, 2, 1)  # (B, H, Sq, D)
+
+    if pallas_enabled():
+        # per-step flash kernel on the resident K/V block; fold (out, lse)
+        acc = jnp.zeros((B, H, Sq, D), jnp.float32)
+        lse = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
+        k_cur, v_cur = k_blk, v_blk
+        for step in range(size):
+            k_heads = jnp.moveaxis(k_cur, 2, 1)
+            v_heads = jnp.moveaxis(v_cur, 2, 1)
+            out_i, lse_i = flash_attention(
+                q_heads, k_heads, v_heads, scale=float(scale), return_lse=True
+            )
+            lse_new = jnp.logaddexp(lse, lse_i)
+            acc = (
+                acc * jnp.exp(lse - lse_new)[..., None]
+                + out_i.astype(jnp.float32) * jnp.exp(lse_i - lse_new)[..., None]
+            )
+            lse = lse_new
+            if step != size - 1:
+                k_cur = jax.lax.ppermute(k_cur, axis, perm)
+                v_cur = jax.lax.ppermute(v_cur, axis, perm)
+        return jnp.moveaxis(acc, 1, 2).astype(q_blk.dtype)
 
     acc = jnp.zeros((B, H, Sq, D), jnp.float32)
     denom = jnp.zeros((B, H, Sq), jnp.float32)
@@ -112,7 +138,10 @@ def ring_attention(q, k, v, comm=None, scale: Optional[float] = None):
     if scale is None:
         scale = 1.0 / math.sqrt(qa.shape[-1])
 
-    key = ("ring_attn", qa.shape, ka.shape, str(qa.dtype), float(scale), comm.cache_key)
+    key = (
+        "ring_attn", qa.shape, ka.shape, str(qa.dtype), float(scale), comm.cache_key,
+        pallas_enabled(),
+    )
     fn = _ATTN_CACHE.get(key)
     if fn is None:
         spec = comm.spec(4, 1)  # (batch, seq✂, heads, dim)
@@ -153,7 +182,7 @@ def ulysses_attention(q, k, v, comm=None, scale: Optional[float] = None):
     if scale is None:
         scale = 1.0 / math.sqrt(qa.shape[-1])
 
-    key = ("ulysses", qa.shape, str(qa.dtype), float(scale), comm.cache_key)
+    key = ("ulysses", qa.shape, str(qa.dtype), float(scale), comm.cache_key, pallas_enabled())
     fn = _ATTN_CACHE.get(key)
     if fn is None:
         spec = comm.spec(4, 1)

@@ -25,6 +25,7 @@ from jax import shard_map
 
 from ..core import types
 from ..core.dndarray import DNDarray
+from ..core.pallas_kernels import cdist_tile, pallas_enabled
 
 __all__ = ["cdist", "manhattan", "rbf"]
 
@@ -35,6 +36,9 @@ _RING_CACHE: dict = {}
 def _euclidean_tile(x, y, expand: bool):
     """One (tile_x, tile_y) block of pairwise L2 distances."""
     if expand:
+        if pallas_enabled():
+            # fused Pallas tile: norms + MXU GEMM + sqrt in one VMEM pass
+            return cdist_tile(x, y, sqrt=True)
         # |x-y|² = |x|² + |y|² - 2·x·yᵀ — the GEMM form (MXU)
         x2 = jnp.sum(x * x, axis=1, keepdims=True)
         y2 = jnp.sum(y * y, axis=1, keepdims=True).T
@@ -44,6 +48,18 @@ def _euclidean_tile(x, y, expand: bool):
     return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
 
 
+def _euclidean_sq_tile(x, y, expand: bool):
+    """Squared-distance block — skips the sqrt the rbf kernel would undo."""
+    if expand:
+        if pallas_enabled():
+            return cdist_tile(x, y, sqrt=False)
+        x2 = jnp.sum(x * x, axis=1, keepdims=True)
+        y2 = jnp.sum(y * y, axis=1, keepdims=True).T
+        return jnp.maximum(x2 + y2 - 2.0 * (x @ y.T), 0.0)
+    diff = x[:, None, :] - y[None, :, :]
+    return jnp.sum(diff * diff, axis=-1)
+
+
 def _manhattan_tile(x, y, expand: bool):
     diff = jnp.abs(x[:, None, :] - y[None, :, :])
     return jnp.sum(diff, axis=-1)
@@ -51,8 +67,8 @@ def _manhattan_tile(x, y, expand: bool):
 
 def _gaussian_tile(sigma: float):
     def tile(x, y, expand: bool):
-        d = _euclidean_tile(x, y, expand)
-        return jnp.exp(-(d * d) / (2.0 * sigma * sigma))
+        d2 = _euclidean_sq_tile(x, y, expand)
+        return jnp.exp(-d2 / (2.0 * sigma * sigma))
 
     return tile
 
@@ -107,7 +123,10 @@ def _dist(X: DNDarray, Y: Optional[DNDarray], tile_fn: Callable, expand: bool, m
 
 
 def _local_kernel(X, Y, tile_fn, expand, jdt, comm, metric_key):
-    key = ("local", X.larray.shape, Y.larray.shape, str(jdt), metric_key, expand, comm.cache_key)
+    key = (
+        "local", X.larray.shape, Y.larray.shape, str(jdt), metric_key, expand,
+        comm.cache_key, pallas_enabled(),
+    )
     fn = _RING_CACHE.get(key)
     if fn is None:
         out_sharding = comm.sharding(2, 0)
@@ -127,7 +146,8 @@ def _ring_kernel(X, Y, tile_fn, expand, jdt, comm, metric_key):
     c_y = Y.larray.shape[0] // size
     m_pad = Y.larray.shape[0]
     key = (
-        "ring", X.larray.shape, Y.larray.shape, str(jdt), metric_key, expand, comm.cache_key
+        "ring", X.larray.shape, Y.larray.shape, str(jdt), metric_key, expand,
+        comm.cache_key, pallas_enabled(),
     )
     fn = _RING_CACHE.get(key)
     if fn is None:
