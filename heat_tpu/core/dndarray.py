@@ -119,6 +119,9 @@ class DNDarray:
         self.__device = device
         self.__comm = comm
         self.__balanced = True
+        # halo caches, populated by get_halo (reference ``dndarray.py:237-258``)
+        self.halo_prev = None
+        self.halo_next = None
 
     # ------------------------------------------------------------------ #
     # construction helpers                                               #
@@ -396,6 +399,13 @@ class DNDarray:
         self.halo_prev = halos
         self.halo_next = halos
         return None
+
+    def counts_displs(self) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+        """Per-device element counts and displacements along the split axis
+        (reference ``counts_displs``, ``dndarray.py:546-571``)."""
+        if self.__split is None:
+            raise ValueError("Non-distributed DNDarray has no counts and displacements")
+        return self.__comm.counts_displs(self.__gshape[self.__split])
 
     # ------------------------------------------------------------------ #
     # conversion                                                         #
@@ -763,6 +773,103 @@ class DNDarray:
         from . import memory
 
         return memory.copy(self)
+
+    def exp2(self, out=None):
+        from . import exponential
+
+        return exponential.exp2(self, out)
+
+    def expm1(self, out=None):
+        from . import exponential
+
+        return exponential.expm1(self, out)
+
+    def log2(self, out=None):
+        from . import exponential
+
+        return exponential.log2(self, out)
+
+    def log10(self, out=None):
+        from . import exponential
+
+        return exponential.log10(self, out)
+
+    def log1p(self, out=None):
+        from . import exponential
+
+        return exponential.log1p(self, out)
+
+    def square(self, out=None):
+        from . import exponential
+
+        return exponential.square(self, out)
+
+    def conj(self, out=None):
+        from . import complex_math
+
+        return complex_math.conjugate(self, out)
+
+    def balance(self) -> "DNDarray":
+        """Out-of-place balance (reference ``manipulations.py:69``): the
+        canonical layout is always balanced, so this is a copy."""
+        from . import memory
+
+        return memory.copy(self)
+
+    def redistribute(self, lshape_map=None, target_map=None) -> "DNDarray":
+        from . import manipulations
+
+        return manipulations.redistribute(self, lshape_map=lshape_map, target_map=target_map)
+
+    def rot90(self, k: int = 1, axes=(0, 1)) -> "DNDarray":
+        from . import manipulations
+
+        return manipulations.rot90(self, k, axes)
+
+    def swapaxes(self, axis1: int, axis2: int) -> "DNDarray":
+        from . import manipulations
+
+        return manipulations.swapaxes(self, axis1, axis2)
+
+    def cpu(self) -> "DNDarray":
+        """Parity shim (reference ``dndarray.py:520``): under a single
+        controller the array is already addressable; returns self."""
+        return self
+
+    @property
+    def lnumel(self) -> int:
+        """Number of elements in the device-0 shard (reference ``:186``)."""
+        return int(np.prod(self.lshape)) if self.lshape else 1
+
+    def stride(self) -> Tuple[int, ...]:
+        """Row-major element strides of the local shard (reference ``:272``)."""
+        lshape = self.lshape
+        st = []
+        acc = 1
+        for s in reversed(lshape):
+            st.append(acc)
+            acc *= max(s, 1)
+        return tuple(reversed(st))
+
+    @property
+    def strides(self) -> Tuple[int, ...]:
+        """NumPy-style byte strides of the local shard (reference ``:279``)."""
+        return tuple(s * self.itemsize for s in self.stride())
+
+    def save(self, path: str, *args, **kwargs) -> None:
+        from . import io
+
+        return io.save(self, path, *args, **kwargs)
+
+    def save_hdf5(self, path: str, dataset: str = "data", **kwargs) -> None:
+        from . import io
+
+        return io.save_hdf5(self, path, dataset, **kwargs)
+
+    def save_netcdf(self, path: str, variable: str = "data", **kwargs) -> None:
+        from . import io
+
+        return io.save_netcdf(self, path, variable, **kwargs)
 
     def fill_diagonal(self, value) -> "DNDarray":
         n = min(self.__gshape) if self.ndim >= 2 else 0

@@ -119,10 +119,15 @@ def cdist_tile(x, y, sqrt: bool = True, block_m: int = 256, block_n: int = 256):
     """
     m, d = x.shape
     n = y.shape[0]
-    out_dtype = jnp.promote_types(x.dtype, jnp.float32)
+    # preserve the callers' (promoted) floating dtype — a bf16 input must
+    # yield a bf16 distance block, not silently upcast to f32
+    out_dtype = jnp.promote_types(x.dtype, y.dtype)
+    if not jnp.issubdtype(out_dtype, jnp.floating):
+        out_dtype = jnp.dtype(jnp.float32)
     acc_dtype = jnp.float64 if out_dtype == jnp.float64 else jnp.float32
-    bm = min(block_m, _round_up(m, 8))
-    bn = min(block_n, _round_up(n, 128))
+    # Mosaic tiling: sublane block multiple of 8, lane block multiple of 128
+    bm = min(_round_up(block_m, 8), _round_up(m, 8))
+    bn = min(_round_up(block_n, 128), _round_up(n, 128))
     mp, np_, dp = _round_up(m, bm), _round_up(n, bn), _round_up(d, 128)
     xp = _pad_axis(_pad_axis(x, 0, mp), 1, dp)
     yp = _pad_axis(_pad_axis(y, 0, np_), 1, dp)
@@ -199,7 +204,10 @@ def _flash_kernel(
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        # mask p explicitly: on a fully-masked row m_new is still _NEG_BIG and
+        # exp(s - m_new) would be 1 at masked positions, silently yielding
+        # mean(V) instead of the dense path's NaN
+        p = jnp.where(mask, jnp.exp(s - m_new), jnp.zeros((), acc_dtype))
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p, v, dimension_numbers=(((1,), (0,)), ((), ())), preferred_element_type=acc_dtype,
             precision=jax.lax.Precision.HIGHEST,
@@ -216,11 +224,18 @@ def _flash_kernel(
 
     @pl.when(kb == num_kb - 1)
     def _finalize():
-        l_safe = jnp.maximum(l_ref[...], jnp.asarray(1e-30, l_ref.dtype))
-        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        # rows with no unmasked keys (l == 0) produce NaN output and -inf
+        # lse, matching softmax-over-all--inf in the dense fallback
+        l = l_ref[...]
+        empty = l == 0
+        l_safe = jnp.where(empty, jnp.ones((), l.dtype), l)
+        o = acc_ref[...] / l_safe
+        o = jnp.where(empty, jnp.asarray(jnp.nan, o.dtype), o)
+        o_ref[0] = o.astype(o_ref.dtype)
         # lse block is (1, bq, 8): the 8-lane tail exists only to satisfy the
         # Mosaic block-shape constraint; callers read lane 0
-        lse = (m_ref[...] + jnp.log(l_safe)).astype(lse_ref.dtype)  # (bq, 1)
+        lse = jnp.where(empty, jnp.asarray(-jnp.inf, l.dtype), m_ref[...] + jnp.log(l_safe))
+        lse = lse.astype(lse_ref.dtype)  # (bq, 1)
         lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], 8))
 
 
@@ -248,10 +263,11 @@ def flash_attention(
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     acc_dtype = jnp.float64 if jnp.promote_types(q.dtype, jnp.float32) == jnp.float64 else jnp.float32
-    # bq must be a multiple of 128: the (1, bq) lse output block's lane dim
-    # has to be 128-divisible for the Mosaic lowering
-    bq = min(block_q, _round_up(Sq, 128))
-    bk = min(block_k, _round_up(Sk, 128))
+    # bq must be a multiple of 128 (the (1, bq) lse output block's lane dim),
+    # and bk is the lane dim of the (bq, bk) score block — round user-supplied
+    # block sizes up rather than trusting them
+    bq = min(_round_up(block_q, 128), _round_up(Sq, 128))
+    bk = min(_round_up(block_k, 128), _round_up(Sk, 128))
     sqp, skp, dp = _round_up(Sq, bq), _round_up(Sk, bk), _round_up(D, 128)
 
     qf = _pad_axis(_pad_axis(q.reshape(B * H, Sq, D), 1, sqp), 2, dp)

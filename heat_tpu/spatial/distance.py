@@ -33,31 +33,29 @@ __all__ = ["cdist", "manhattan", "rbf"]
 _RING_CACHE: dict = {}
 
 
-def _euclidean_tile(x, y, expand: bool):
-    """One (tile_x, tile_y) block of pairwise L2 distances."""
+def _l2_tile(x, y, expand: bool, sqrt: bool):
+    """One (tile_x, tile_y) block of pairwise L2 distances (squared when
+    ``sqrt=False`` — the KMeans/rbf form that skips the root)."""
     if expand:
         if pallas_enabled():
-            # fused Pallas tile: norms + MXU GEMM + sqrt in one VMEM pass
-            return cdist_tile(x, y, sqrt=True)
+            # fused Pallas tile: norms + MXU GEMM (+ sqrt) in one VMEM pass
+            return cdist_tile(x, y, sqrt=sqrt)
         # |x-y|² = |x|² + |y|² - 2·x·yᵀ — the GEMM form (MXU)
         x2 = jnp.sum(x * x, axis=1, keepdims=True)
         y2 = jnp.sum(y * y, axis=1, keepdims=True).T
-        d2 = x2 + y2 - 2.0 * (x @ y.T)
-        return jnp.sqrt(jnp.maximum(d2, 0.0))
+        d2 = jnp.maximum(x2 + y2 - 2.0 * (x @ y.T), 0.0)
+        return jnp.sqrt(d2) if sqrt else d2
     diff = x[:, None, :] - y[None, :, :]
-    return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+    d2 = jnp.sum(diff * diff, axis=-1)
+    return jnp.sqrt(d2) if sqrt else d2
+
+
+def _euclidean_tile(x, y, expand: bool):
+    return _l2_tile(x, y, expand, sqrt=True)
 
 
 def _euclidean_sq_tile(x, y, expand: bool):
-    """Squared-distance block — skips the sqrt the rbf kernel would undo."""
-    if expand:
-        if pallas_enabled():
-            return cdist_tile(x, y, sqrt=False)
-        x2 = jnp.sum(x * x, axis=1, keepdims=True)
-        y2 = jnp.sum(y * y, axis=1, keepdims=True).T
-        return jnp.maximum(x2 + y2 - 2.0 * (x @ y.T), 0.0)
-    diff = x[:, None, :] - y[None, :, :]
-    return jnp.sum(diff * diff, axis=-1)
+    return _l2_tile(x, y, expand, sqrt=False)
 
 
 def _manhattan_tile(x, y, expand: bool):

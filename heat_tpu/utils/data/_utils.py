@@ -1,0 +1,68 @@
+"""Standalone data-preparation utilities (parity with the reference's
+``heat/utils/data/_utils.py:13-279``, which the reference itself marks as
+untested, unsupported helpers).
+
+The tfrecord index walker is pure Python (no TensorFlow needed): a TFRecord
+file is a sequence of ``(u64 length, u32 crc, proto bytes, u32 crc)`` frames,
+so indexing only needs ``struct``. The ImageNet tfrecord→HDF5 merger in the
+reference additionally requires TensorFlow to decode the protos; that
+dependency is not available here, so the merge entry point is gated.
+"""
+
+import os
+import struct
+
+__all__ = ["dali_tfrecord2idx", "merge_files_imagenet_tfrecord"]
+
+
+def tfrecord_index(path):
+    """Return ``[(offset, nbytes), ...]`` for every record frame in a
+    TFRecord file (the DALI index format, one frame per line)."""
+    entries = []
+    with open(path, "rb") as f:
+        while True:
+            current = f.tell()
+            byte_len = f.read(8)
+            if len(byte_len) == 0:
+                break
+            if len(byte_len) < 8:
+                raise ValueError(f"{path}: truncated TFRecord length header")
+            (proto_len,) = struct.unpack("<q", byte_len)
+            if proto_len < 0:
+                raise ValueError(f"{path}: negative TFRecord length (not a TFRecord file)")
+            f.read(4)  # length crc
+            body = f.read(proto_len)
+            if len(body) < proto_len:
+                raise ValueError(f"{path}: truncated TFRecord body")
+            f.read(4)  # body crc
+            entries.append((current, f.tell() - current))
+    return entries
+
+
+def dali_tfrecord2idx(train_dir, train_idx_dir, val_dir, val_idx_dir):
+    """Write DALI-style ``offset nbytes`` index files for every TFRecord in
+    ``train_dir`` / ``val_dir`` (reference ``_utils.py:13-44``)."""
+    for src_dir, out_dir in ((train_dir, train_idx_dir), (val_dir, val_idx_dir)):
+        os.makedirs(out_dir, exist_ok=True)
+        for name in sorted(os.listdir(src_dir)):
+            src = os.path.join(src_dir, name)
+            if not os.path.isfile(src):
+                continue
+            try:
+                entries = tfrecord_index(src)
+            except ValueError:
+                print(f"Not a valid TFRecord file: {src}")
+                continue
+            with open(os.path.join(out_dir, name), "w") as idx:
+                for offset, nbytes in entries:
+                    idx.write(f"{offset} {nbytes}\n")
+
+
+def merge_files_imagenet_tfrecord(folder_name, output_folder=None):
+    """Merge preprocessed ImageNet TFRecords into one HDF5 file
+    (reference ``_utils.py:46-279``). Decoding the image protos requires
+    TensorFlow, which is not part of this framework's dependency set."""
+    raise NotImplementedError(
+        "merge_files_imagenet_tfrecord requires TensorFlow to decode ImageNet "
+        "protos; install tensorflow and use tfrecord_index() for the framing"
+    )

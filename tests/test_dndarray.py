@@ -151,3 +151,81 @@ class TestMisc:
         x = ht.arange(5, split=0)
         np.testing.assert_array_equal((x >= 2).numpy(), np.arange(5) >= 2)
         np.testing.assert_array_equal((x != 3).numpy(), np.arange(5) != 3)
+
+
+class TestMethodParity:
+    """Method sugar added for parity with reference DNDarray members
+    (``heat/core/dndarray.py`` module-bottom attachments)."""
+
+    def test_elementwise_method_aliases(self):
+        x = ht.arange(12, dtype=ht.float32, split=0).reshape((3, 4)) + 1.0
+        ref = np.arange(12, dtype=np.float32).reshape(3, 4) + 1.0
+        np.testing.assert_allclose(x.exp2().numpy(), np.exp2(ref), rtol=1e-6)
+        np.testing.assert_allclose(x.expm1().numpy(), np.expm1(ref), rtol=1e-6)
+        np.testing.assert_allclose(x.log2().numpy(), np.log2(ref), rtol=1e-6)
+        np.testing.assert_allclose(x.log10().numpy(), np.log10(ref), rtol=1e-6)
+        np.testing.assert_allclose(x.log1p().numpy(), np.log1p(ref), rtol=1e-6)
+        np.testing.assert_allclose(x.square().numpy(), np.square(ref), rtol=1e-6)
+        np.testing.assert_allclose(x.conj().numpy(), np.conj(ref), rtol=1e-6)
+
+    def test_manipulation_method_aliases(self):
+        x = ht.arange(24, split=0).reshape((4, 6))
+        ref = np.arange(24).reshape(4, 6)
+        np.testing.assert_array_equal(x.swapaxes(0, 1).numpy(), ref.swapaxes(0, 1))
+        np.testing.assert_array_equal(x.rot90().numpy(), np.rot90(ref))
+        np.testing.assert_array_equal(x.balance().numpy(), ref)
+        np.testing.assert_array_equal(x.redistribute().numpy(), ref)
+
+    def test_counts_displs(self):
+        y = ht.arange(10, split=0)
+        counts, displs = y.counts_displs()
+        assert sum(counts) == 10
+        assert displs[0] == 0
+        assert len(counts) == len(displs) == y.comm.size
+        with pytest.raises(ValueError):
+            ht.arange(4).counts_displs()
+
+    def test_local_shape_introspection(self):
+        x = ht.zeros((16, 3), split=0)
+        assert x.lnumel == int(np.prod(x.lshape))
+        assert x.stride() == (3, 1)
+        assert x.strides == (12, 4)
+        assert x.cpu() is x
+
+    def test_halo_cache_attrs(self):
+        z = ht.zeros((8,), split=0)
+        assert z.halo_prev is None and z.halo_next is None
+        z.get_halo(1)
+        assert z.halo_prev is not None
+
+    def test_save_method(self, tmp_path):
+        x = ht.arange(20, dtype=ht.float32, split=0)
+        p = str(tmp_path / "x.h5")
+        x.save(p, "data")
+        back = ht.load_hdf5(p, "data", split=0)
+        np.testing.assert_array_equal(back.numpy(), x.numpy())
+
+
+class TestDataPrepUtils:
+    def test_tfrecord_index_roundtrip(self, tmp_path):
+        import struct
+        from heat_tpu.utils.data._utils import tfrecord_index, dali_tfrecord2idx
+
+        # write a synthetic 3-record TFRecord file
+        src_dir = tmp_path / "train"
+        src_dir.mkdir()
+        p = src_dir / "a.tfrecord"
+        with open(p, "wb") as f:
+            for body in (b"abc", b"defghij", b"k"):
+                f.write(struct.pack("<q", len(body)))
+                f.write(b"\0" * 4)
+                f.write(body)
+                f.write(b"\0" * 4)
+        entries = tfrecord_index(str(p))
+        assert len(entries) == 3
+        assert entries[0][0] == 0
+        assert entries[0][1] == 8 + 4 + 3 + 4
+        out_dir = tmp_path / "idx"
+        dali_tfrecord2idx(str(src_dir), str(out_dir), str(src_dir), str(out_dir))
+        lines = (out_dir / "a.tfrecord").read_text().strip().splitlines()
+        assert len(lines) == 3

@@ -128,3 +128,42 @@ class TestFlashAttention:
         vh = jnp.moveaxis(jnp.asarray(v), 2, 1)
         expected = _ref_attention(qh, kh, vh).transpose(0, 2, 1, 3)
         np.testing.assert_allclose(out.numpy(), expected, rtol=1e-4, atol=1e-4)
+
+
+class TestKernelEdgeCases:
+    def test_flash_fully_masked_rows_match_dense(self):
+        # causal with Sq > Sk: end-aligned diagonal leaves the first
+        # Sq - Sk query rows with zero allowed keys; dense softmax yields
+        # NaN there and the kernel must agree (regression: it used to
+        # emit mean(V) because exp(-BIG - (-BIG)) == 1)
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.normal(size=(1, 1, 6, 8)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(1, 1, 4, 8)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(1, 1, 4, 8)).astype(np.float32))
+        out, lse = pk.flash_attention(q, k, v, causal=True, return_lse=True)
+        expected = _ref_attention(q, k, v, causal=True)
+        assert np.isnan(np.asarray(out)[0, 0, :2]).all()
+        assert np.isneginf(np.asarray(lse)[0, 0, :2]).all()
+        np.testing.assert_allclose(
+            np.asarray(out)[0, 0, 2:], expected[0, 0, 2:], rtol=1e-4, atol=1e-4
+        )
+
+    def test_cdist_tile_preserves_bf16(self):
+        x = jnp.ones((8, 4), jnp.bfloat16)
+        assert pk.cdist_tile(x, x).dtype == jnp.bfloat16
+        xi = jnp.ones((8, 4), jnp.int32)
+        assert pk.cdist_tile(xi, xi).dtype == jnp.float32
+
+    def test_non_multiple_block_sizes_rounded(self):
+        # user-supplied block sizes that violate Mosaic's 8/128 tiling
+        # multiples must be rounded up, producing the same result as the
+        # default blocks (block-size invariance)
+        rng = np.random.default_rng(8)
+        x = rng.standard_normal((40, 9)).astype(np.float32)
+        base = np.asarray(pk.cdist_tile(jnp.asarray(x), jnp.asarray(x)))
+        out = np.asarray(pk.cdist_tile(jnp.asarray(x), jnp.asarray(x), block_m=100, block_n=100))
+        np.testing.assert_allclose(out, base, rtol=1e-6, atol=1e-6)
+        q = jnp.asarray(rng.normal(size=(1, 1, 40, 8)).astype(np.float32))
+        base_o = np.asarray(pk.flash_attention(q, q, q))
+        o = np.asarray(pk.flash_attention(q, q, q, block_q=100, block_k=100))
+        np.testing.assert_allclose(o, base_o, rtol=1e-6, atol=1e-6)
