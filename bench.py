@@ -6,11 +6,20 @@ synthetic ``(n, 64)`` float32 split DNDarray (reference
 selects (the real TPU chip under the driver).
 
 ``value`` is sustained Lloyd iterations/second of the fused jitted step
-(assignment GEMM + argmin + one-hot update GEMM + psum), measured after
-compilation. ``vs_baseline`` compares against the reference-equivalent
-single-process PyTorch CPU implementation of the same iteration (torch is
-the reference's local compute backend), linearly extrapolated from a smaller
-sample so the baseline finishes quickly; >1 means faster than the baseline.
+(assignment GEMM + argmin + one-hot update GEMM + psum).
+
+Timing methodology (important on the remote-tunnel TPU backend):
+``jax.block_until_ready`` can return before remote execution completes, so
+every timed run is terminated by a scalar device-to-host fetch, which cannot
+complete early. The constant per-call overhead (dispatch + tunnel roundtrip +
+fetch latency) is cancelled by timing the SAME compiled executable
+(``lax.fori_loop`` with a runtime trip count — one compile) at two trip
+counts and differencing.
+
+``vs_baseline`` compares against the reference-equivalent single-process
+PyTorch CPU implementation of the same iteration (torch is the reference's
+local compute backend), linearly extrapolated from a smaller sample so the
+baseline finishes quickly; >1 means faster than the baseline.
 """
 
 import json
@@ -19,11 +28,10 @@ import time
 import numpy as np
 
 
-def tpu_kmeans_iter_per_s(n: int, d: int = 64, k: int = 8, iters: int = 20) -> float:
+def tpu_kmeans_iter_per_s(n: int, d: int = 64, k: int = 8) -> float:
     import heat_tpu as ht
-    from heat_tpu.cluster.kmeans import _lloyd_multi_step_fn
+    from heat_tpu.cluster.kmeans import _lloyd_fori_fn
 
-    import jax
     import jax.numpy as jnp
 
     ht.random.seed(0)
@@ -31,18 +39,24 @@ def tpu_kmeans_iter_per_s(n: int, d: int = 64, k: int = 8, iters: int = 20) -> f
     comm = x.comm
     xp = x.larray
     centroids = jnp.asarray(np.random.default_rng(0).random((k, d), dtype=np.float32))
-    # the whole hot loop is one compiled program (dispatch amortized)
-    run = _lloyd_multi_step_fn(xp.shape, jnp.dtype(jnp.float32), k, n, comm, iters)
+    run = _lloyd_fori_fn(xp.shape, jnp.dtype(jnp.float32), k, n, comm)
 
-    # warmup/compile
-    c, labels, inertia, shift = run(xp, centroids)
-    jax.block_until_ready(c)
+    def timed(iters: int) -> float:
+        t0 = time.perf_counter()
+        c, inertia, shift = run(xp, centroids, iters)
+        float(np.asarray(inertia))  # forces real completion on remote backends
+        return time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    c, labels, inertia, shift = run(xp, centroids)
-    jax.block_until_ready(c)
-    t1 = time.perf_counter()
-    return (iters + 1) / (t1 - t0)
+    timed(1)  # compile + warm
+    lo, hi = 2, 22
+    t_lo = min(timed(lo) for _ in range(3))
+    t_hi = min(timed(hi) for _ in range(3))
+    per_iter = (t_hi - t_lo) / (hi - lo)
+    if per_iter <= 0:
+        # jitter exceeded the compute delta; fall back to the conservative
+        # upper bound (whole-call time over the larger trip count)
+        per_iter = t_hi / hi
+    return 1.0 / per_iter
 
 
 def torch_kmeans_time_per_iter(n: int, d: int = 64, k: int = 8, iters: int = 3) -> float:

@@ -83,6 +83,34 @@ def _lloyd_multi_step_fn(phys_shape, jdt, k, n_valid, comm, iters: int):
     return fn
 
 
+def _lloyd_fori_fn(phys_shape, jdt, k, n_valid, comm):
+    """Lloyd iterations with a *runtime* trip count (``lax.fori_loop``).
+
+    Compiled once and reused for any iteration count, unlike
+    :func:`_lloyd_multi_step_fn` whose unrolled program is specialized to
+    ``iters``. Used by the benchmark driver, which times two different trip
+    counts with the same executable and differences them to cancel constant
+    dispatch/transfer overhead."""
+    key = ("fori", phys_shape, str(jdt), k, n_valid, comm.cache_key)
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+        single = _make_step_body(phys_shape, jdt, k, n_valid)
+
+        def _run(xp, centroids, iters):
+            def body(_, carry):
+                c, _, _ = carry
+                c2, _, inertia, shift = single(xp, c)
+                return c2, inertia, shift
+
+            z = jnp.zeros((), jdt)
+            c, inertia, shift = jax.lax.fori_loop(0, iters, body, (centroids, z, z))
+            return c, inertia, shift
+
+        fn = jax.jit(_run)
+        _STEP_CACHE[key] = fn
+    return fn
+
+
 class KMeans(_KCluster):
     """K-Means with Lloyd's algorithm (reference ``kmeans.py:12``).
 

@@ -1,0 +1,9 @@
+"""Bundled demo datasets (synthetic stand-ins for the reference's
+``heat/datasets/``; see ``_generate.py``)."""
+
+import os
+
+
+def path(name: str) -> str:
+    """Absolute path of a bundled dataset file, e.g. ``path("iris.h5")``."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), name)

@@ -4,3 +4,4 @@ from .datatools import DataLoader, Dataset, dataset_ishuffle, dataset_shuffle
 from .partial_dataset import PartialH5Dataset, PartialH5DataLoaderIter
 from .mnist import MNISTDataset
 from . import matrixgallery
+from .matrixgallery import parter

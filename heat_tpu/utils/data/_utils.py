@@ -12,7 +12,7 @@ dependency is not available here, so the merge entry point is gated.
 import os
 import struct
 
-__all__ = ["dali_tfrecord2idx", "merge_files_imagenet_tfrecord"]
+__all__ = ["tfrecord_index", "dali_tfrecord2idx", "merge_files_imagenet_tfrecord"]
 
 
 def tfrecord_index(path):
@@ -30,11 +30,13 @@ def tfrecord_index(path):
             (proto_len,) = struct.unpack("<q", byte_len)
             if proto_len < 0:
                 raise ValueError(f"{path}: negative TFRecord length (not a TFRecord file)")
-            f.read(4)  # length crc
+            if len(f.read(4)) < 4:
+                raise ValueError(f"{path}: truncated TFRecord length crc")
             body = f.read(proto_len)
             if len(body) < proto_len:
                 raise ValueError(f"{path}: truncated TFRecord body")
-            f.read(4)  # body crc
+            if len(f.read(4)) < 4:
+                raise ValueError(f"{path}: truncated TFRecord body crc")
             entries.append((current, f.tell() - current))
     return entries
 
