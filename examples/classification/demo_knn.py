@@ -27,7 +27,6 @@ def calculate_accuracy(new_y, verification_y) -> float:
 def main() -> None:
     x = ht.load_hdf5(datasets.path("iris.h5"), dataset="data", split=0)
     labels = np.repeat(np.arange(3), 50)  # 3 classes of 50, like iris
-    y = ht.array(labels, split=0)
 
     # 5-fold cross-validation over a fixed permutation
     rng = np.random.default_rng(0)

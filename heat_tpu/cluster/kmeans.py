@@ -60,37 +60,14 @@ def _lloyd_step_fn(phys_shape, jdt, k, n_valid, comm):
     return fn
 
 
-def _lloyd_multi_step_fn(phys_shape, jdt, k, n_valid, comm, iters: int):
-    """``iters`` fused Lloyd iterations in one XLA program (``lax.fori_loop``).
-
-    Amortizes dispatch latency: the whole hot loop stays on device, exactly
-    the compiled-epoch discipline SURVEY.md §7 calls for (hard part 5)."""
-    key = ("multi", phys_shape, str(jdt), k, n_valid, iters, comm.cache_key)
-    fn = _STEP_CACHE.get(key)
-    if fn is None:
-        single = _make_step_body(phys_shape, jdt, k, n_valid)
-
-        def _run(xp, centroids):
-            # statically unrolled: modest HLO growth for typical iteration
-            # counts, and avoids While-loop lowering entirely
-            c = centroids
-            for _ in range(iters):
-                c, _, _, _ = single(xp, c)
-            return single(xp, c)
-
-        fn = jax.jit(_run)
-        _STEP_CACHE[key] = fn
-    return fn
-
-
 def _lloyd_fori_fn(phys_shape, jdt, k, n_valid, comm):
     """Lloyd iterations with a *runtime* trip count (``lax.fori_loop``).
 
-    Compiled once and reused for any iteration count, unlike
-    :func:`_lloyd_multi_step_fn` whose unrolled program is specialized to
-    ``iters``. Used by the benchmark driver, which times two different trip
-    counts with the same executable and differences them to cancel constant
-    dispatch/transfer overhead."""
+    The whole hot loop is one XLA program compiled once and reused for any
+    iteration count (the compiled-epoch discipline SURVEY.md §7 calls for,
+    hard part 5). Used by the benchmark driver, which times two different
+    trip counts with the same executable and differences them to cancel
+    constant dispatch/transfer overhead."""
     key = ("fori", phys_shape, str(jdt), k, n_valid, comm.cache_key)
     fn = _STEP_CACHE.get(key)
     if fn is None:

@@ -9,7 +9,10 @@ import jax.numpy as jnp
 from . import _operations, types
 from .dndarray import DNDarray
 
-__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "round", "sgn", "sign", "trunc"]
+__all__ = [
+    "abs", "absolute", "ceil", "clip", "copysign", "fabs", "floor", "modf",
+    "round", "sgn", "sign", "trunc",
+]
 
 
 def abs(x, out=None, dtype=None) -> DNDarray:  # noqa: A001
@@ -37,6 +40,12 @@ def clip(x: DNDarray, min=None, max=None, out=None) -> DNDarray:
     mn = min.larray if isinstance(min, DNDarray) else min
     mx = max.larray if isinstance(max, DNDarray) else max
     return _operations._local_op(lambda a: jnp.clip(a, mn, mx), x, out)
+
+
+def copysign(t1, t2) -> DNDarray:
+    """Magnitude of ``t1`` with the sign of ``t2``, element-wise (NumPy-parity
+    extra; the reference has no copysign)."""
+    return _operations._binary_op(jnp.copysign, t1, t2)
 
 
 def fabs(x: DNDarray, out=None) -> DNDarray:

@@ -9,7 +9,7 @@ from .dndarray import DNDarray
 
 __all__ = [
     "arccos", "acos", "arccosh", "acosh", "arcsin", "asin", "arcsinh", "asinh",
-    "arctan", "atan", "arctanh", "atanh", "arctan2", "atan2",
+    "arctan", "atan", "arctanh", "atanh", "arctan2", "atan2", "hypot",
     "cos", "cosh", "deg2rad", "degrees", "rad2deg", "radians",
     "sin", "sinh", "tan", "tanh",
 ]
@@ -56,6 +56,12 @@ def arctanh(x: DNDarray, out=None) -> DNDarray:
 
 
 atanh = arctanh
+
+
+def hypot(t1, t2) -> DNDarray:
+    """Element-wise ``sqrt(t1**2 + t2**2)`` (NumPy-parity extra; the
+    reference has no hypot)."""
+    return _operations._binary_op(jnp.hypot, t1, t2)
 
 
 def arctan2(t1, t2) -> DNDarray:

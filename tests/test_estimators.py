@@ -1,0 +1,152 @@
+"""Estimator-layer behavior tests (reference test model: cluster/,
+classification/, naive_bayes/, regression/ test dirs).
+
+Covers the sklearn-style base API contract (``base.py:13-220``),
+GaussianNB partial_fit equivalence, KNN correctness vs a NumPy
+reference, Lasso convergence on a known sparse model, and estimator
+behavior across splits.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.classification import KNeighborsClassifier
+from heat_tpu.cluster import KMeans, KMedians, KMedoids, Spectral
+from heat_tpu.naive_bayes import GaussianNB
+from heat_tpu.regression import Lasso
+
+
+def _blobs(n_per=40, d=5, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=8.0, size=(k, d))
+    x = np.concatenate(
+        [rng.normal(c, 1.0, size=(n_per, d)) for c in centers]
+    ).astype(np.float32)
+    y = np.repeat(np.arange(k), n_per)
+    perm = rng.permutation(len(y))
+    return x[perm], y[perm]
+
+
+class TestBaseAPI:
+    @pytest.mark.parametrize(
+        "est",
+        [
+            KMeans(n_clusters=4),
+            KMedians(n_clusters=3),
+            KMedoids(n_clusters=3),
+            Spectral(n_clusters=2),
+            KNeighborsClassifier(n_neighbors=3),
+            GaussianNB(),
+            Lasso(max_iter=10),
+        ],
+        ids=lambda e: type(e).__name__,
+    )
+    def test_get_set_params_roundtrip(self, est):
+        params = est.get_params()
+        assert isinstance(params, dict) and params
+        est.set_params(**params)
+        assert est.get_params() == params
+
+    def test_set_params_unknown_raises(self):
+        with pytest.raises(ValueError):
+            KMeans().set_params(definitely_not_a_param=1)
+
+    def test_repr_contains_params(self):
+        r = repr(KMeans(n_clusters=5))
+        assert "KMeans" in r and "n_clusters" in r
+
+
+class TestGaussianNB:
+    def test_fit_predict_accuracy(self):
+        x, y = _blobs()
+        nb = GaussianNB()
+        nb.fit(ht.array(x, split=0), ht.array(y, split=0))
+        pred = nb.predict(ht.array(x, split=0)).numpy().flatten()
+        assert (pred == y).mean() > 0.95
+
+    def test_partial_fit_matches_fit(self):
+        x, y = _blobs(seed=3)
+        full = GaussianNB()
+        full.fit(ht.array(x, split=0), ht.array(y, split=0))
+
+        part = GaussianNB()
+        half = len(y) // 2
+        part.partial_fit(
+            ht.array(x[:half], split=0), ht.array(y[:half], split=0), classes=np.unique(y)
+        )
+        part.partial_fit(ht.array(x[half:], split=0), ht.array(y[half:], split=0))
+
+        np.testing.assert_allclose(full.theta_.numpy(), part.theta_.numpy(), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(full.var_.numpy(), part.var_.numpy(), rtol=1e-3, atol=1e-4)
+        pf = part.predict(ht.array(x, split=0)).numpy().flatten()
+        ff = full.predict(ht.array(x, split=0)).numpy().flatten()
+        assert (pf == ff).mean() > 0.99
+
+    def test_predict_proba_rows_sum_to_one(self):
+        x, y = _blobs(seed=5)
+        nb = GaussianNB().fit(ht.array(x, split=0), ht.array(y, split=0))
+        proba = nb.predict_proba(ht.array(x, split=0)).numpy()
+        np.testing.assert_allclose(proba.sum(axis=1), np.ones(len(y)), rtol=1e-4)
+
+
+class TestKNN:
+    def test_matches_numpy_reference(self):
+        x, y = _blobs(n_per=30, seed=7)
+        xq = x[:25] + 0.01
+        knn = KNeighborsClassifier(n_neighbors=5)
+        knn.fit(ht.array(x, split=0), ht.array(y, split=0))
+        got = knn.predict(ht.array(xq, split=0)).numpy().flatten()
+
+        d = ((xq[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        idx = np.argsort(d, axis=1)[:, :5]
+        votes = y[idx]
+        want = np.array([np.bincount(v, minlength=3).argmax() for v in votes])
+        assert (got == want).mean() > 0.95
+
+
+class TestLasso:
+    def test_recovers_sparse_model(self):
+        rng = np.random.default_rng(11)
+        n, d = 200, 8
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        beta = np.array([0.0, 4.0, 0.0, -3.0, 0.0, 0.0, 2.0, 0.0], np.float32)
+        y = (x @ beta + 0.01 * rng.normal(size=n).astype(np.float32))[:, None]
+        est = Lasso(lam=0.1, max_iter=200)
+        est.fit(ht.array(x, split=0), ht.array(y, split=0))
+        coefs = est.theta.numpy().flatten()[1:]  # drop intercept
+        assert np.abs(coefs[[1, 3, 6]] - beta[[1, 3, 6]]).max() < 0.3
+        assert np.abs(coefs[[0, 2, 4, 5, 7]]).max() < 0.15
+
+
+class TestClusterAcrossSplits:
+    def test_kmeans_split_invariance(self):
+        x, _ = _blobs(seed=13)
+        inertias = []
+        for split in (None, 0):
+            km = KMeans(n_clusters=3, max_iter=50, random_state=0)
+            km.fit(ht.array(x, split=split))
+            inertias.append(float(km.inertia_))
+        assert abs(inertias[0] - inertias[1]) / abs(inertias[0]) < 1e-3
+
+    def test_kmeans_predict_labels_match_fit(self):
+        x, _ = _blobs(seed=17)
+        km = KMeans(n_clusters=3, max_iter=50, random_state=1).fit(ht.array(x, split=0))
+        pred = km.predict(ht.array(x, split=0)).numpy().flatten()
+        assert pred.shape == (len(x),)
+        # predicted labels must agree with nearest-centroid assignment
+        c = km.cluster_centers_.numpy()
+        want = ((x[:, None, :] - c[None]) ** 2).sum(-1).argmin(1)
+        assert (pred == want).all()
+
+    def test_spectral_separates_two_blobs(self):
+        rng = np.random.default_rng(19)
+        a = rng.normal((-5, -5), 0.5, size=(30, 2)).astype(np.float32)
+        b = rng.normal((5, 5), 0.5, size=(30, 2)).astype(np.float32)
+        x = np.concatenate([a, b])
+        sp = Spectral(n_clusters=2, gamma=0.1, n_lanczos=30)
+        labels = sp.fit_predict(ht.array(x, split=0)).numpy().flatten()
+        # all of blob a one label, all of blob b the other
+        assert len(set(labels[:30])) == 1
+        assert len(set(labels[30:])) == 1
+        assert labels[0] != labels[-1]

@@ -1,0 +1,297 @@
+"""Elementwise/unary/binary op namespaces vs NumPy for every split.
+
+The reference's core correctness idiom (``basic_test.py:142-307``: run every
+op under every split, compare to NumPy) applied to the full ops surface of
+SURVEY.md §2.2: arithmetics, relational, rounding, exponential,
+trigonometrics, complex_math, logical, indexing.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import all_splits, assert_array_equal, assert_func_equal
+
+
+UNARY_FLOAT = [
+    ("exp", np.exp),
+    ("expm1", np.expm1),
+    ("exp2", np.exp2),
+    ("sqrt", lambda x: np.sqrt(np.abs(x))),
+    ("square", np.square),
+    ("sin", np.sin),
+    ("cos", np.cos),
+    ("tan", np.tan),
+    ("sinh", np.sinh),
+    ("cosh", np.cosh),
+    ("tanh", np.tanh),
+    ("arctan", np.arctan),
+    ("arcsinh", np.arcsinh),
+    ("floor", np.floor),
+    ("ceil", np.ceil),
+    ("trunc", np.trunc),
+    ("round", np.round),
+    ("abs", np.abs),
+    ("fabs", np.fabs),
+    ("sign", np.sign),
+    ("negative", np.negative),
+    ("positive", np.positive),
+    ("deg2rad", np.deg2rad),
+    ("rad2deg", np.rad2deg),
+]
+
+
+@pytest.mark.parametrize("name,npf", UNARY_FLOAT, ids=[n for n, _ in UNARY_FLOAT])
+def test_unary_float(name, npf):
+    htf = getattr(ht, name)
+    if name == "sqrt":
+        assert_func_equal((5, 6), lambda a: htf(ht.abs(a)), npf)
+    else:
+        assert_func_equal((5, 6), htf, npf)
+
+
+UNARY_UNIT = [  # domain (-1, 1)
+    ("arcsin", np.arcsin),
+    ("arccos", np.arccos),
+    ("arctanh", np.arctanh),
+]
+
+
+@pytest.mark.parametrize("name,npf", UNARY_UNIT, ids=[n for n, _ in UNARY_UNIT])
+def test_unary_unit_domain(name, npf):
+    assert_func_equal((4, 7), getattr(ht, name), npf, low=-0.99, high=0.99)
+
+
+UNARY_POS = [  # domain (0, inf)
+    ("log", np.log),
+    ("log2", np.log2),
+    ("log10", np.log10),
+    ("log1p", np.log1p),
+    ("arccosh", lambda x: np.arccosh(x + 1.5)),
+]
+
+
+@pytest.mark.parametrize("name,npf", UNARY_POS, ids=[n for n, _ in UNARY_POS])
+def test_unary_positive_domain(name, npf):
+    htf = getattr(ht, name)
+    if name == "arccosh":
+        assert_func_equal((6, 3), lambda a: htf(a + 1.5), npf, low=0.01, high=9)
+    else:
+        assert_func_equal((6, 3), htf, npf, low=0.01, high=9)
+
+
+BINARY = [
+    ("add", np.add),
+    ("sub", np.subtract),
+    ("mul", np.multiply),
+    ("div", np.divide),
+    ("fmod", np.fmod),
+    ("pow", lambda a, b: np.power(np.abs(a) + 0.5, b)),
+    ("atan2", np.arctan2),
+    ("hypot", np.hypot),
+    ("copysign", np.copysign),
+    ("maximum", np.maximum),
+    ("minimum", np.minimum),
+    ("logaddexp", np.logaddexp),
+    ("logaddexp2", np.logaddexp2),
+]
+
+
+@pytest.mark.parametrize("name,npf", BINARY, ids=[n for n, _ in BINARY])
+def test_binary_same_split(name, npf):
+    rng = np.random.default_rng(7)
+    x = (rng.random((6, 5)) * 4 - 2).astype(np.float32)
+    y = (rng.random((6, 5)) * 4 + 0.5).astype(np.float32)
+    htf = getattr(ht, name)
+    if name == "pow":
+        expected = npf(x, y)
+        for split in all_splits(2):
+            got = htf(ht.abs(ht.array(x, split=split)) + 0.5, ht.array(y, split=split))
+            assert_array_equal(got, expected, rtol=1e-4, atol=1e-5)
+    else:
+        expected = npf(x, y)
+        for split in all_splits(2):
+            got = htf(ht.array(x, split=split), ht.array(y, split=split))
+            assert_array_equal(got, expected, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name,npf", [("add", np.add), ("mul", np.multiply), ("div", np.divide)])
+def test_binary_mixed_split_and_scalar(name, npf):
+    rng = np.random.default_rng(3)
+    x = rng.random((8, 6)).astype(np.float32) + 0.5
+    y = rng.random((8, 6)).astype(np.float32) + 0.5
+    htf = getattr(ht, name)
+    # every (split_a, split_b) combination
+    for sa in all_splits(2):
+        for sb in all_splits(2):
+            got = htf(ht.array(x, split=sa), ht.array(y, split=sb))
+            assert_array_equal(got, npf(x, y), rtol=1e-5, atol=1e-6)
+    # scalars on either side
+    for split in all_splits(2):
+        a = ht.array(x, split=split)
+        assert_array_equal(htf(a, 2.5), npf(x, np.float32(2.5)), rtol=1e-5, atol=1e-6)
+        assert_array_equal(htf(2.5, a), npf(np.float32(2.5), x), rtol=1e-5, atol=1e-6)
+
+
+def test_binary_broadcasting():
+    rng = np.random.default_rng(5)
+    x = rng.random((6, 5)).astype(np.float32)
+    row = rng.random((1, 5)).astype(np.float32)
+    col = rng.random((6, 1)).astype(np.float32)
+    for split in all_splits(2):
+        a = ht.array(x, split=split)
+        assert_array_equal(a + ht.array(row), x + row, rtol=1e-6, atol=1e-6)
+        assert_array_equal(a * ht.array(col, split=split), x * col, rtol=1e-6, atol=1e-6)
+    v = rng.random((5,)).astype(np.float32)
+    for split in all_splits(2):
+        assert_array_equal(ht.array(x, split=split) - ht.array(v), x - v, rtol=1e-6, atol=1e-6)
+
+
+INT_BINARY = [
+    ("bitwise_and", np.bitwise_and),
+    ("bitwise_or", np.bitwise_or),
+    ("bitwise_xor", np.bitwise_xor),
+    ("left_shift", np.left_shift),
+    ("right_shift", np.right_shift),
+    ("floordiv", np.floor_divide),
+    ("mod", np.mod),
+]
+
+
+@pytest.mark.parametrize("name,npf", INT_BINARY, ids=[n for n, _ in INT_BINARY])
+def test_int_binary(name, npf):
+    rng = np.random.default_rng(11)
+    x = rng.integers(1, 30, size=(5, 8)).astype(np.int32)
+    y = rng.integers(1, 5, size=(5, 8)).astype(np.int32)
+    htf = getattr(ht, name)
+    for split in all_splits(2):
+        got = htf(ht.array(x, split=split), ht.array(y, split=split))
+        assert_array_equal(got, npf(x, y))
+
+
+def test_invert():
+    x = np.array([[0, 1, 2], [7, -3, 100]], np.int32)
+    for split in all_splits(2):
+        assert_array_equal(ht.invert(ht.array(x, split=split)), np.invert(x))
+    b = np.array([True, False, True])
+    assert_array_equal(ht.invert(ht.array(b)), np.invert(b))
+
+
+RELATIONAL = [
+    ("eq", np.equal),
+    ("ne", np.not_equal),
+    ("lt", np.less),
+    ("le", np.less_equal),
+    ("gt", np.greater),
+    ("ge", np.greater_equal),
+]
+
+
+@pytest.mark.parametrize("name,npf", RELATIONAL, ids=[n for n, _ in RELATIONAL])
+def test_relational(name, npf):
+    rng = np.random.default_rng(13)
+    x = rng.integers(0, 4, size=(6, 6)).astype(np.float32)
+    y = rng.integers(0, 4, size=(6, 6)).astype(np.float32)
+    htf = getattr(ht, name)
+    for split in all_splits(2):
+        got = htf(ht.array(x, split=split), ht.array(y, split=split))
+        assert_array_equal(got, npf(x, y))
+
+
+def test_logical_ops():
+    rng = np.random.default_rng(17)
+    x = rng.integers(0, 2, size=(7, 4)).astype(bool)
+    y = rng.integers(0, 2, size=(7, 4)).astype(bool)
+    for split in all_splits(2):
+        a, b = ht.array(x, split=split), ht.array(y, split=split)
+        assert_array_equal(ht.logical_and(a, b), np.logical_and(x, y))
+        assert_array_equal(ht.logical_or(a, b), np.logical_or(x, y))
+        assert_array_equal(ht.logical_xor(a, b), np.logical_xor(x, y))
+        assert_array_equal(ht.logical_not(a), np.logical_not(x))
+
+
+def test_signbit_modf():
+    x = np.array([[-1.5, 0.0, 2.25], [3.75, -0.5, -0.0]], np.float32)
+    for split in all_splits(2):
+        a = ht.array(x, split=split)
+        assert_array_equal(ht.signbit(a), np.signbit(x))
+        frac, integ = ht.modf(a)
+        nf, ni = np.modf(x)
+        assert_array_equal(frac, nf, rtol=1e-6, atol=1e-7)
+        assert_array_equal(integ, ni, rtol=1e-6, atol=1e-7)
+
+
+def test_clip():
+    rng = np.random.default_rng(19)
+    x = (rng.random((9, 5)) * 20 - 10).astype(np.float32)
+    for split in all_splits(2):
+        a = ht.array(x, split=split)
+        assert_array_equal(ht.clip(a, -2.0, 3.0), np.clip(x, -2.0, 3.0), rtol=1e-6, atol=1e-7)
+
+
+def test_complex_math():
+    z = np.array([[1 + 2j, -3 + 0.5j], [0 - 1j, 2.5 + 0j]], np.complex64)
+    for split in all_splits(2):
+        a = ht.array(z, split=split)
+        assert_array_equal(ht.real(a), z.real, rtol=1e-6, atol=1e-7)
+        assert_array_equal(ht.imag(a), z.imag, rtol=1e-6, atol=1e-7)
+        assert_array_equal(ht.angle(a), np.angle(z), rtol=1e-5, atol=1e-6)
+        got = ht.conj(a).numpy()
+        np.testing.assert_allclose(got, np.conj(z), rtol=1e-6)
+
+
+def test_where_nonzero():
+    rng = np.random.default_rng(23)
+    x = rng.integers(-3, 3, size=(6, 7)).astype(np.int32)
+    for split in all_splits(2):
+        a = ht.array(x, split=split)
+        w = ht.where(a > 0, a, 0)
+        assert_array_equal(w, np.where(x > 0, x, 0))
+        nz = ht.nonzero(a)
+        expected = np.stack(np.nonzero(x), axis=1)
+        np.testing.assert_array_equal(np.asarray(nz.numpy()), expected)
+
+
+def test_out_kwarg():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    for split in all_splits(2):
+        a = ht.array(x, split=split)
+        out = ht.zeros_like(a)
+        r = ht.add(a, a, out=out)
+        assert r is out
+        assert_array_equal(out, x + x)
+
+
+def test_where_kwarg():
+    x = np.arange(8, dtype=np.float32)
+    y = np.full(8, 10.0, np.float32)
+    mask = x > 3
+    a, b = ht.array(x, split=0), ht.array(y, split=0)
+    out = ht.zeros_like(a)
+    got = ht.add(a, b, out=out, where=ht.array(mask, split=0)).numpy()
+    np.testing.assert_allclose(got[mask], (x + y)[mask], rtol=1e-6)
+    np.testing.assert_allclose(got[~mask], np.zeros(np.sum(~mask), np.float32))
+
+
+def test_prod_cumops():
+    rng = np.random.default_rng(29)
+    x = (rng.random((5, 6)) + 0.5).astype(np.float32)
+    for split in all_splits(2):
+        a = ht.array(x, split=split)
+        assert_array_equal(ht.prod(a, axis=0), np.prod(x, axis=0), rtol=1e-4, atol=1e-5)
+        assert_array_equal(ht.prod(a, axis=1), np.prod(x, axis=1), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(ht.prod(a).item()), np.prod(x), rtol=1e-3)
+        assert_array_equal(ht.cumsum(a, axis=0), np.cumsum(x, axis=0), rtol=1e-5, atol=1e-5)
+        assert_array_equal(ht.cumprod(a, axis=1), np.cumprod(x, axis=1), rtol=1e-4, atol=1e-5)
+
+
+def test_nan_propagation_logical():
+    x = np.array([np.nan, 1.0, np.inf, -np.inf, 0.0], np.float32)
+    for split in all_splits(1):
+        a = ht.array(x, split=split)
+        assert_array_equal(ht.isnan(a), np.isnan(x))
+        assert_array_equal(ht.isinf(a), np.isinf(x))
+        assert_array_equal(ht.isfinite(a), np.isfinite(x))
+        assert_array_equal(ht.isposinf(a), np.isposinf(x))
+        assert_array_equal(ht.isneginf(a), np.isneginf(x))
