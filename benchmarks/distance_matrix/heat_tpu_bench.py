@@ -10,17 +10,25 @@ import argparse
 import json
 import time
 
+import sys
+
 import jax
+
+if "--distributed" in sys.argv:
+    # must run before heat_tpu builds its default mesh from jax.devices()
+    jax.distributed.initialize()  # topology from the TPU pod environment
 
 import heat_tpu as ht
 
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--distributed", action="store_true",
+                   help="multi-host pod (jax.distributed.initialize() ran at import)")
     p.add_argument("--n", type=int, default=40_000)
     p.add_argument("--d", type=int, default=18)  # SUSY has 18 features
     p.add_argument("--trials", type=int, default=3)
-    p.add_argument("--quadratic-expansion", action="store_true", default=True)
+    p.add_argument("--quadratic-expansion", action=argparse.BooleanOptionalAction, default=True)
     p.add_argument("--file", type=str, default=None)
     p.add_argument("--dataset", type=str, default="data")
     args = p.parse_args()

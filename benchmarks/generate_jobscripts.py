@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Generate TPU launch scripts for the benchmark suite.
+
+TPU-native analog of the reference's SLURM jobscript generator
+(``benchmarks/generate_jobscripts.py:11-61``): instead of ``srun`` over MPI
+ranks, it emits
+
+* **single-host** scripts (one process drives all local chips through the
+  device mesh — the v5e-1/-4/-8 cases), and
+* **multi-host pod** scripts (``gcloud compute tpus tpu-vm ssh --worker=all``
+  running the same SPMD program on every host; ``jax.distributed.initialize``
+  picks up the pod topology from the TPU environment — the v5e-16+ cases),
+
+for every (benchmark × topology × strong/weak) combination in
+``benchmarks/config.json``. Weak scaling sizes are ``weak_per_chip × chips``.
+
+Usage::
+
+    python benchmarks/generate_jobscripts.py --out jobscripts \
+        [--tpu-name NAME --zone ZONE --project PROJECT] [--benchmark kmeans]
+"""
+
+import argparse
+import json
+import os
+import stat
+
+SINGLE_HOST_TEMPLATE = """#!/bin/bash -x
+# {name}: single-host TPU ({topology}, {chips} chip(s))
+cd "$(dirname "$0")/{bench_rel}"
+
+python -u {script} {parameters} 2>&1 | tee {output}
+"""
+
+MULTI_HOST_TEMPLATE = """#!/bin/bash -x
+# {name}: multi-host TPU pod ({topology}, {chips} chips)
+# Requires: gcloud auth + a provisioned TPU pod slice; the repo present at
+# the same path on every worker (use `gcloud ... scp --recurse` or NFS).
+TPU_NAME=${{TPU_NAME:-{tpu_name}}}
+ZONE=${{ZONE:-{zone}}}
+PROJECT=${{PROJECT:-{project}}}
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" \\
+  --zone "$ZONE" --project "$PROJECT" --worker=all \\
+  --command "cd {remote_dir} && python -u {script} --distributed {parameters}" \\
+  2>&1 | tee {output}
+"""
+
+# chips per topology label
+def chips_of(topology: str) -> int:
+    return int(topology.rsplit("-", 1)[1])
+
+
+def parameters_for(bench: str, cfg: dict, n: int):
+    """Yield ``(variant_suffix, cli_parameters)`` for every sweep variant."""
+    if bench == "kmeans":
+        yield "", (
+            f"--n {n} --d {cfg['features']} --k {cfg['clusters']} "
+            f"--iters {cfg['iterations']} --trials {cfg['trials']}"
+        )
+    elif bench == "distance_matrix":
+        for quad in cfg.get("quadratic_expansion", [True]):
+            flag = "--quadratic-expansion" if quad else "--no-quadratic-expansion"
+            yield ("-quad" if quad else "-noquad"), f"--n {n} --d {cfg['features']} {flag}"
+    elif bench == "statistical_moments":
+        # the driver itself sweeps axes None/0/1 in one run
+        yield "", f"--n {n} --d {cfg['features']}"
+    elif bench == "lasso":
+        yield "", f"--n {n} --iters {cfg['iterations']}"
+    else:
+        raise ValueError(f"unknown benchmark {bench}")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default=os.path.join(os.path.dirname(__file__), "config.json"))
+    p.add_argument("--out", default="jobscripts")
+    p.add_argument("--benchmark", default=None, help="only this benchmark")
+    p.add_argument("--tpu-name", default="heat-tpu-pod")
+    p.add_argument("--zone", default="us-central1-a")
+    p.add_argument("--project", default="my-project")
+    p.add_argument("--remote-dir", default="~/heat_tpu/benchmarks")
+    args = p.parse_args()
+
+    with open(args.config) as f:
+        config = json.load(f)
+
+    os.makedirs(args.out, exist_ok=True)
+    # single-host scripts cd from the output dir to the benchmarks dir
+    bench_dir = os.path.dirname(os.path.abspath(args.config))
+    bench_rel = os.path.relpath(bench_dir, os.path.abspath(args.out))
+    generated = []
+    for bench, cfg in config.items():
+        if args.benchmark and bench != args.benchmark:
+            continue
+        for topology in cfg["topologies"]:
+            chips = chips_of(topology)
+            for kind in ("strong", "weak"):
+                if kind == "strong":
+                    n = cfg["size"]["strong"]
+                else:
+                    n = cfg["size"]["weak_per_chip"] * chips
+                for suffix, params in parameters_for(bench, cfg, n):
+                    name = f"{bench}{suffix}-{kind}-scale-{topology}"
+                    multi_host = chips > 8
+                    template = MULTI_HOST_TEMPLATE if multi_host else SINGLE_HOST_TEMPLATE
+                    body = template.format(
+                        name=name,
+                        topology=topology,
+                        chips=chips,
+                        script=cfg["script"],
+                        parameters=params,
+                        output=f"{name}.out",
+                        bench_rel=bench_rel,
+                        tpu_name=args.tpu_name,
+                        zone=args.zone,
+                        project=args.project,
+                        remote_dir=args.remote_dir,
+                    )
+                    path = os.path.join(args.out, name + ".sh")
+                    with open(path, "w") as f:
+                        f.write(body)
+                    os.chmod(path, os.stat(path).st_mode | stat.S_IXUSR | stat.S_IXGRP)
+                    generated.append(path)
+    print(f"generated {len(generated)} jobscripts in {args.out}/")
+
+
+if __name__ == "__main__":
+    main()

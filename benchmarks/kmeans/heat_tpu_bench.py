@@ -9,11 +9,21 @@ import argparse
 import json
 import time
 
+import sys
+
+import jax
+
+if "--distributed" in sys.argv:
+    # must run before heat_tpu builds its default mesh from jax.devices()
+    jax.distributed.initialize()  # topology from the TPU pod environment
+
 import heat_tpu as ht
 
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--distributed", action="store_true",
+                   help="multi-host pod (jax.distributed.initialize() ran at import)")
     p.add_argument("--n", type=int, default=1 << 20)
     p.add_argument("--d", type=int, default=64)
     p.add_argument("--k", type=int, default=8)

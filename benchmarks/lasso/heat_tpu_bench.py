@@ -6,11 +6,21 @@ import time
 
 import numpy as np
 
+import sys
+
+import jax
+
+if "--distributed" in sys.argv:
+    # must run before heat_tpu builds its default mesh from jax.devices()
+    jax.distributed.initialize()  # topology from the TPU pod environment
+
 import heat_tpu as ht
 
 
 def main():
     p = argparse.ArgumentParser()
+    p.add_argument("--distributed", action="store_true",
+                   help="multi-host pod (jax.distributed.initialize() ran at import)")
     p.add_argument("--n", type=int, default=100_000)
     p.add_argument("--d", type=int, default=64)
     p.add_argument("--iters", type=int, default=20)

@@ -21,9 +21,12 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
-if jax.default_backend() != "cpu" or len(jax.devices()) < 8:
+if jax.default_backend() != "cpu":
     raise RuntimeError(
-        "tests require an 8-device CPU mesh; run with "
+        "tests require a virtual CPU mesh; run with "
         "PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu "
         "XLA_FLAGS=--xla_force_host_platform_device_count=8 python -m pytest tests/"
     )
+# Like the reference's `mpirun -n 1…8` CI ladder, the suite runs at ANY
+# device count (1, 2, 4, 8, …): tests read the size from the communicator
+# rather than assuming 8.

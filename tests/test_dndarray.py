@@ -23,12 +23,14 @@ class TestProperties:
         assert x.balanced
 
     def test_lshape_map(self):
-        x = ht.zeros((10,), split=0)
+        size = ht.get_comm().size
+        n = 10
+        x = ht.zeros((n,), split=0)
         lmap = x.lshape_map()
-        assert lmap.shape == (8, 1)
-        assert lmap.sum() == 10
-        # ceil chunks: first devices get 2, tail gets 0
-        assert lmap[0, 0] == 2
+        assert lmap.shape == (size, 1)
+        assert lmap.sum() == n
+        # ceil chunks: first devices get ceil(n/size)
+        assert lmap[0, 0] == -(-n // size)
 
     def test_scalar_conversions(self):
         x = ht.array(3.5)
@@ -101,24 +103,33 @@ class TestIndexing:
 
 class TestHalo:
     def test_array_with_halos(self):
-        data = np.arange(16, dtype=np.float32)
+        size = ht.get_comm().size
+        chunk = 16 // size if size <= 16 else 1
+        n = chunk * size
+        data = np.arange(n, dtype=np.float32)
         x = ht.array(data, split=0)
         h = x.array_with_halos(1)
-        # every local block of 2 becomes 4 (1+2+1)
-        assert h.shape[0] == 8 * 4
-        # reconstruct: device 1's center must be rows 2..3, halos 1 and 4
-        blocks = np.asarray(h).reshape(8, 4)
-        np.testing.assert_array_equal(blocks[1], [1, 2, 3, 4])
-        # boundary zeros
-        assert blocks[0, 0] == 0.0
-        assert blocks[7, 3] == 0.0
+        if size == 1:
+            # single device: no halo exchange, array unchanged
+            assert h.shape[0] == n
+            return
+        # every local block of `chunk` rows gains a halo row on each side
+        assert h.shape[0] == size * (chunk + 2)
+        blocks = np.asarray(h).reshape(size, chunk + 2)
+        for i in range(size):
+            prev = data[i * chunk - 1] if i > 0 else 0.0
+            nxt = data[(i + 1) * chunk] if i < size - 1 else 0.0
+            want = np.concatenate([[prev], data[i * chunk : (i + 1) * chunk], [nxt]])
+            np.testing.assert_array_equal(blocks[i], want)
 
     def test_halo_validation(self):
         x = ht.arange(16, split=0)
         with pytest.raises(TypeError):
             x.array_with_halos(-1)
-        with pytest.raises(ValueError):
-            x.array_with_halos(5)
+        if ht.get_comm().size > 1:
+            # halo bigger than the (padded) per-device chunk is rejected
+            with pytest.raises(ValueError):
+                x.array_with_halos(-(-16 // ht.get_comm().size) + 1)
 
 
 class TestMisc:

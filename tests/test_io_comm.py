@@ -62,12 +62,14 @@ class TestIO:
 class TestCommFacade:
     def test_chunk(self):
         comm = ht.get_comm()
-        off, lshape, slices = comm.chunk((10, 4), 0, rank=0)
-        assert off == 0 and lshape == (2, 4)
-        off, lshape, _ = comm.chunk((10, 4), 0, rank=7)
-        assert lshape[0] == 0  # ceil-chunk tail can be empty
-        counts, displs = comm.counts_displs(10)
-        assert sum(counts) == 10
+        n = 10
+        per = -(-n // comm.size)
+        off, lshape, slices = comm.chunk((n, 4), 0, rank=0)
+        assert off == 0 and lshape == (min(per, n), 4)
+        off, lshape, _ = comm.chunk((n, 4), 0, rank=comm.size - 1)
+        assert lshape[0] == max(0, n - per * (comm.size - 1))  # ceil-chunk tail
+        counts, displs = comm.counts_displs(n)
+        assert sum(counts) == n
         assert len(displs) == comm.size
 
     def test_collectives_in_shard_map(self):
@@ -93,7 +95,8 @@ class TestCommFacade:
         from jax import shard_map
 
         comm = ht.get_comm()
-        x = ht.arange(8, dtype=ht.float32, split=0)
+        n = comm.size
+        x = ht.arange(n, dtype=ht.float32, split=0)
         spec = comm.spec(1, 0)
 
         fn = shard_map(
@@ -101,7 +104,7 @@ class TestCommFacade:
             check_vma=False,
         )
         out = np.asarray(jax.jit(fn)(x.larray))
-        np.testing.assert_array_equal(out, np.roll(np.arange(8), 1))
+        np.testing.assert_array_equal(out, np.roll(np.arange(n), 1))
 
     def test_exscan(self):
         import jax
@@ -109,24 +112,30 @@ class TestCommFacade:
         from jax import shard_map
 
         comm = ht.get_comm()
-        x = ht.ones(8, split=0)
+        n = comm.size
+        x = ht.ones(n, split=0)
         spec = comm.spec(1, 0)
         fn = shard_map(
             lambda b: comm.exscan(jnp.sum(b)).reshape(1),
             mesh=comm.mesh, in_specs=spec, out_specs=spec, check_vma=False,
         )
         out = np.asarray(jax.jit(fn)(x.larray))
-        np.testing.assert_array_equal(out, np.arange(8))
+        np.testing.assert_array_equal(out, np.arange(n))
 
     def test_split_subcomm(self):
         comm = ht.get_comm()
-        sub = comm.Split([0, 1, 2, 3])
-        assert sub.size == 4
+        if comm.size < 2:
+            pytest.skip("needs >=2 devices")
+        half = comm.size // 2
+        sub = comm.Split(list(range(half)))
+        assert sub.size == half
         x = ht.arange(8, split=0, comm=sub)
         assert int(x.sum().item()) == 28
 
     def test_use_comm(self):
         default = ht.get_comm()
+        if default.size < 2:
+            pytest.skip("needs >=2 devices")
         sub = default.Split([0, 1])
         ht.use_comm(sub)
         try:

@@ -24,7 +24,7 @@ def test_arange_sum_uneven():
 
 
 def test_mesh_size():
-    assert ht.get_comm().size == 8
+    assert ht.get_comm().size == len(__import__('jax').devices())
 
 
 def test_factories_values():
