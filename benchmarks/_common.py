@@ -1,0 +1,31 @@
+"""Shared helpers for the benchmark drivers.
+
+Import this BEFORE ``heat_tpu``:
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from _common import maybe_init_distributed
+    maybe_init_distributed()        # must precede the heat_tpu import
+
+    import heat_tpu as ht
+
+``maybe_init_distributed`` must run before heat_tpu builds its default mesh
+from ``jax.devices()`` — on a multi-host pod the mesh has to span every host.
+"""
+
+import sys
+
+
+def maybe_init_distributed() -> None:
+    """Call ``jax.distributed.initialize()`` when ``--distributed`` is given."""
+    if "--distributed" in sys.argv:
+        import jax
+
+        jax.distributed.initialize()  # topology from the TPU pod environment
+
+
+def add_common_args(parser) -> None:
+    parser.add_argument(
+        "--distributed",
+        action="store_true",
+        help="multi-host pod (jax.distributed.initialize() ran at import)",
+    )

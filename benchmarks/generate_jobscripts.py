@@ -27,9 +27,10 @@ import stat
 
 SINGLE_HOST_TEMPLATE = """#!/bin/bash -x
 # {name}: single-host TPU ({topology}, {chips} chip(s))
+OUT="$(cd "$(dirname "$0")" && pwd)/{output}"
 cd "$(dirname "$0")/{bench_rel}"
 
-python -u {script} {parameters} 2>&1 | tee {output}
+python -u {script} {parameters} 2>&1 | tee "$OUT"
 """
 
 MULTI_HOST_TEMPLATE = """#!/bin/bash -x
@@ -98,6 +99,10 @@ def main() -> None:
             for kind in ("strong", "weak"):
                 if kind == "strong":
                     n = cfg["size"]["strong"]
+                elif cfg["size"].get("weak_scaling") == "sqrt":
+                    # quadratic-cost workloads (n×n output): constant
+                    # per-chip memory needs n ∝ sqrt(chips)
+                    n = int(cfg["size"]["weak_per_chip"] * chips**0.5)
                 else:
                     n = cfg["size"]["weak_per_chip"] * chips
                 for suffix, params in parameters_for(bench, cfg, n):

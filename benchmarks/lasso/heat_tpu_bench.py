@@ -6,21 +6,22 @@ import time
 
 import numpy as np
 
+import os
 import sys
 
-import jax
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _common import add_common_args, maybe_init_distributed
 
-if "--distributed" in sys.argv:
-    # must run before heat_tpu builds its default mesh from jax.devices()
-    jax.distributed.initialize()  # topology from the TPU pod environment
+maybe_init_distributed()  # must precede the heat_tpu import (mesh creation)
+
+import jax  # noqa: F401  (re-exported for drivers that sync on results)
 
 import heat_tpu as ht
 
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--distributed", action="store_true",
-                   help="multi-host pod (jax.distributed.initialize() ran at import)")
+    add_common_args(p)
     p.add_argument("--n", type=int, default=100_000)
     p.add_argument("--d", type=int, default=64)
     p.add_argument("--iters", type=int, default=20)

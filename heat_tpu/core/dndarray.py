@@ -908,6 +908,14 @@ def _normalize_key(x, key):
             return k._logical()
         if isinstance(k, (np.ndarray, jnp.ndarray)):
             return jnp.asarray(k)
+        if isinstance(k, list):
+            # NumPy semantics: a list index is an advanced (array) index;
+            # an empty list selects nothing (needs an integer dtype — a bare
+            # np.asarray([]) would be float64 and jax rejects float indexers)
+            arr = np.asarray(k)
+            if arr.size == 0:
+                arr = arr.astype(np.intp)
+            return jnp.asarray(arr)
         return k
 
     if isinstance(key, tuple):

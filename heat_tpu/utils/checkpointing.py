@@ -63,13 +63,22 @@ def save_checkpoint(path: str, state: Dict[str, Any], step: Optional[int] = None
         elif isinstance(value, (int, float, str, bool)) or value is None:
             manifest["entries"][name] = {"kind": "scalar", "value": value}
         else:
-            # arbitrary pytree (flax params, optax state)
+            # arbitrary pytree (flax params, optax state); DNDarray leaves
+            # keep their split/dtype metadata so they restore as DNDarrays
             leaves = _flatten(value)
-            keys = []
+            keys = {}
             for leaf_path, leaf in leaves.items():
                 arr_key = f"{name}::{leaf_path}"
-                arrays[arr_key] = np.asarray(leaf)
-                keys.append(leaf_path)
+                if isinstance(leaf, DNDarray):
+                    arrays[arr_key] = leaf.numpy()
+                    keys[leaf_path] = {
+                        "kind": "dndarray",
+                        "split": leaf.split,
+                        "dtype": leaf.dtype.__name__,
+                    }
+                else:
+                    arrays[arr_key] = np.asarray(leaf)
+                    keys[leaf_path] = {"kind": "array"}
             manifest["entries"][name] = {"kind": "pytree", "leaves": keys}
 
     tmp_fd, tmp_npz = tempfile.mkstemp(dir=path, suffix=".tmp.npz")
@@ -83,15 +92,15 @@ def save_checkpoint(path: str, state: Dict[str, Any], step: Optional[int] = None
     os.replace(tmp_json, os.path.join(path, _MANIFEST))
 
 
-def _unflatten(leaves: Dict[str, np.ndarray]):
-    """Rebuild the nested dict structure from path → leaf."""
+def _unflatten(leaves: Dict[str, Any]):
+    """Rebuild the nested dict structure from path → restored leaf."""
     root: Dict[str, Any] = {}
     for path, leaf in leaves.items():
         parts = path.split("/")
         node = root
         for p in parts[:-1]:
             node = node.setdefault(p, {})
-        node[parts[-1]] = jnp.asarray(leaf)
+        node[parts[-1]] = leaf
     return root
 
 
@@ -113,9 +122,18 @@ def load_checkpoint(path: str) -> Dict[str, Any]:
         elif meta["kind"] == "scalar":
             state[name] = meta["value"]
         else:
-            leaves = {
-                leaf_path: arrays[f"{name}::{leaf_path}"] for leaf_path in meta["leaves"]
-            }
+            leaf_meta = meta["leaves"]
+            if isinstance(leaf_meta, list):  # legacy manifests: plain arrays
+                leaf_meta = {p: {"kind": "array"} for p in leaf_meta}
+            leaves: Dict[str, Any] = {}
+            for leaf_path, lm in leaf_meta.items():
+                raw = arrays[f"{name}::{leaf_path}"]
+                if lm["kind"] == "dndarray":
+                    leaves[leaf_path] = factories.array(
+                        raw, dtype=getattr(types, lm["dtype"]), split=lm["split"]
+                    )
+                else:
+                    leaves[leaf_path] = jnp.asarray(raw)
             state[name] = _unflatten(leaves)
     return state
 

@@ -70,6 +70,20 @@ class TestIndexing:
         assert_array_equal(x[::2], data[::2])
         assert_array_equal(x[::-1], data[::-1])
 
+    def test_list_and_array_fancy_indexing(self):
+        data = np.arange(60, dtype=np.float32).reshape(10, 6)
+        for split in (None, 0, 1):
+            a = ht.array(data, split=split)
+            assert_array_equal(a[[7, 1, 3]], data[[7, 1, 3]])
+            assert_array_equal(a[[-1, 0, -3]], data[[-1, 0, -3]])
+            assert_array_equal(a[[1, 2], [3, 4]], data[[1, 2], [3, 4]])
+            assert_array_equal(a[[0, 9], 1:4], data[[0, 9], 1:4])
+            b = ht.array(data, split=split)
+            b[[2, 5]] = -1.0
+            want = data.copy()
+            want[[2, 5]] = -1.0
+            assert_array_equal(b, want)
+
     def test_boolean_mask(self):
         data = np.arange(10, dtype=np.float32)
         x = ht.array(data, split=0)
