@@ -12,7 +12,9 @@ ranks, it emits
   picks up the pod topology from the TPU environment — the v5e-16+ cases),
 
 for every (benchmark × topology × strong/weak) combination in
-``benchmarks/config.json``. Weak scaling sizes are ``weak_per_chip × chips``.
+``benchmarks/config.json``. Weak scaling sizes are ``weak_per_chip × chips``,
+or ``weak_per_chip × sqrt(chips)`` for workloads marked
+``"weak_scaling": "sqrt"`` (quadratic-memory outputs like distance_matrix).
 
 Usage::
 

@@ -50,6 +50,7 @@ __all__ = [
     "row_stack",
     "shape",
     "sort",
+    "array_split",
     "split",
     "squeeze",
     "stack",
@@ -378,6 +379,23 @@ def _sort_sentinel(a: DNDarray, descending: bool):
     return statistics._min_neutral(a)
 
 
+def array_split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
+    """Split into sub-arrays, allowing unequal sections (NumPy-parity extra;
+    the reference ships only the exact-division ``split`` family)."""
+    axis = sanitize_axis(x.shape, axis)
+    if isinstance(indices_or_sections, DNDarray):
+        indices_or_sections = indices_or_sections.numpy().tolist()
+    elif isinstance(indices_or_sections, (np.ndarray, jnp.ndarray)):
+        indices_or_sections = np.asarray(indices_or_sections).tolist()
+    if isinstance(indices_or_sections, (int, np.integer)):
+        n, k = x.shape[axis], int(indices_or_sections)
+        if k <= 0:
+            raise ValueError("number sections must be larger than 0")
+        sizes = [n // k + 1] * (n % k) + [n // k] * (k - n % k)
+        indices_or_sections = list(np.cumsum(sizes[:-1]))
+    return split(x, indices_or_sections, axis=axis)
+
+
 def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
     """Split into sub-arrays (reference ``:2450``)."""
     axis = sanitize_axis(x.shape, axis)
@@ -387,8 +405,7 @@ def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
         indices_or_sections = np.asarray(indices_or_sections).tolist()
     logical = x._logical()
     parts = jnp.split(logical, indices_or_sections, axis=axis)
-    out_split = x.split
-    return [_wrap_logical(p, out_split if out_split != axis else x.split, x) for p in parts]
+    return [_wrap_logical(p, x.split, x) for p in parts]
 
 
 def squeeze(x: DNDarray, axis=None) -> DNDarray:

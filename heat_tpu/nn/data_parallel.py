@@ -155,3 +155,11 @@ class DataParallelMultiGPU(DataParallel):
     def __init__(self, module, optimizer, comm=None, **kwargs):
         super().__init__(module, comm=comm, optimizer=getattr(optimizer, "local_optimizer", optimizer), **kwargs)
         self.daso = optimizer if hasattr(optimizer, "global_skip") else None
+
+    def step(self, x, y) -> float:
+        """Fused local step, then the DASO slow-tier schedule (the reference
+        drives the global sync from DASO's ``step``, ``dp_optimizer.py:730``)."""
+        loss = super().step(x, y)
+        if self.daso is not None:
+            self.params = self.daso.step(self.params)
+        return loss

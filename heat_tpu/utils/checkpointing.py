@@ -29,17 +29,32 @@ _MANIFEST = "manifest.json"
 
 
 def _flatten(tree, prefix=""):
-    """Flatten nested dicts/lists of arrays into path → leaf."""
+    """Flatten nested dicts/lists/tuples of arrays into (path → leaf, spec).
+
+    ``spec`` is a JSON-serializable structure descriptor so containers
+    round-trip with their exact types (optax states are tuples)."""
     out = {}
     if isinstance(tree, dict):
+        spec = {"kind": "dict", "items": {}}
         for k, v in tree.items():
-            out.update(_flatten(v, f"{prefix}/{k}" if prefix else str(k)))
+            sub, subspec = _flatten(v, f"{prefix}/{k}" if prefix else str(k))
+            out.update(sub)
+            spec["items"][k] = subspec
     elif isinstance(tree, (list, tuple)):
+        if isinstance(tree, tuple) and hasattr(tree, "_fields"):
+            # namedtuple (optax/flax states): record the class for rebuild
+            cls = type(tree)
+            spec = {"kind": "namedtuple", "cls": [cls.__module__, cls.__qualname__], "items": []}
+        else:
+            spec = {"kind": "tuple" if isinstance(tree, tuple) else "list", "items": []}
         for i, v in enumerate(tree):
-            out.update(_flatten(v, f"{prefix}/{i}" if prefix else str(i)))
+            sub, subspec = _flatten(v, f"{prefix}/{i}" if prefix else str(i))
+            out.update(sub)
+            spec["items"].append(subspec)
     else:
+        spec = {"kind": "leaf", "path": prefix}
         out[prefix] = tree
-    return out
+    return out, spec
 
 
 def save_checkpoint(path: str, state: Dict[str, Any], step: Optional[int] = None) -> None:
@@ -65,7 +80,7 @@ def save_checkpoint(path: str, state: Dict[str, Any], step: Optional[int] = None
         else:
             # arbitrary pytree (flax params, optax state); DNDarray leaves
             # keep their split/dtype metadata so they restore as DNDarrays
-            leaves = _flatten(value)
+            leaves, spec = _flatten(value)
             keys = {}
             for leaf_path, leaf in leaves.items():
                 arr_key = f"{name}::{leaf_path}"
@@ -79,7 +94,7 @@ def save_checkpoint(path: str, state: Dict[str, Any], step: Optional[int] = None
                 else:
                     arrays[arr_key] = np.asarray(leaf)
                     keys[leaf_path] = {"kind": "array"}
-            manifest["entries"][name] = {"kind": "pytree", "leaves": keys}
+            manifest["entries"][name] = {"kind": "pytree", "leaves": keys, "spec": spec}
 
     tmp_fd, tmp_npz = tempfile.mkstemp(dir=path, suffix=".tmp.npz")
     os.close(tmp_fd)
@@ -92,8 +107,30 @@ def save_checkpoint(path: str, state: Dict[str, Any], step: Optional[int] = None
     os.replace(tmp_json, os.path.join(path, _MANIFEST))
 
 
-def _unflatten(leaves: Dict[str, Any]):
-    """Rebuild the nested dict structure from path → restored leaf."""
+def _unflatten(leaves: Dict[str, Any], spec=None):
+    """Rebuild the container structure from path → restored leaf.
+
+    With a ``spec`` (new manifests), container types (dict/list/tuple) are
+    reconstructed exactly; without one (legacy manifests) nested dicts with
+    string keys are returned."""
+    if spec is not None:
+        if spec["kind"] == "leaf":
+            return leaves[spec["path"]]
+        if spec["kind"] == "dict":
+            return {k: _unflatten(leaves, s) for k, s in spec["items"].items()}
+        rebuilt = [_unflatten(leaves, s) for s in spec["items"]]
+        if spec["kind"] == "namedtuple":
+            import importlib
+
+            try:
+                mod, qualname = spec["cls"]
+                cls = importlib.import_module(mod)
+                for part in qualname.split("."):
+                    cls = getattr(cls, part)
+                return cls(*rebuilt)
+            except (ImportError, AttributeError):
+                return tuple(rebuilt)  # class no longer importable
+        return tuple(rebuilt) if spec["kind"] == "tuple" else rebuilt
     root: Dict[str, Any] = {}
     for path, leaf in leaves.items():
         parts = path.split("/")
@@ -134,7 +171,7 @@ def load_checkpoint(path: str) -> Dict[str, Any]:
                     )
                 else:
                     leaves[leaf_path] = jnp.asarray(raw)
-            state[name] = _unflatten(leaves)
+            state[name] = _unflatten(leaves, meta.get("spec"))
     return state
 
 

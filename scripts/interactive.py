@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Interactive distributed console (reference ``scripts/interactive.py``).
+
+The reference needs ``mpirun -stdin all`` plus a rank-aware InteractiveConsole
+so every MPI process replays the typed line. Under the single-controller SPMD
+model there is nothing to synchronize — one Python process drives the whole
+mesh — so this is a plain REPL with heat_tpu preloaded and a mesh banner:
+
+    python scripts/interactive.py
+"""
+
+import code
+import sys
+
+
+def main() -> None:
+    import heat_tpu as ht
+
+    comm = ht.get_comm()
+    banner = (
+        f"heat_tpu {ht.__version__} interactive console\n"
+        f"mesh: {comm.size} device(s) — "
+        f"{', '.join(str(d) for d in comm.devices[:4])}"
+        f"{' …' if comm.size > 4 else ''}\n"
+        f"`ht` is heat_tpu; try: ht.arange(10, split=0).sum()"
+    )
+    console = code.InteractiveConsole(locals={"ht": ht})
+    console.interact(banner=banner, exitmsg="")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,3 +87,29 @@ class TestProfiling:
     def test_annotate(self):
         with ht.utils.profiling.annotate("scope"):
             _ = ht.arange(4).sum()
+
+
+class TestPytreeStructureRoundTrip:
+    def test_optax_state_namedtuples(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        tx = optax.adam(1e-3)
+        params = {"w": jnp.ones((3, 2)), "b": jnp.zeros(2)}
+        state = tx.init(params)
+        ht.utils.save_checkpoint(str(tmp_path / "ck"), {"opt": state, "params": params})
+        st = ht.utils.load_checkpoint(str(tmp_path / "ck"))
+        assert jax.tree_util.tree_structure(st["opt"]) == jax.tree_util.tree_structure(state)
+        # a further update step must accept the restored state
+        tx.update(jax.tree_util.tree_map(jnp.zeros_like, params), st["opt"], st["params"])
+
+    def test_list_tuple_and_nested_dndarray(self, tmp_path):
+        import jax.numpy as jnp
+
+        state = {"misc": {"l": [jnp.ones(2)], "t": (jnp.ones(2),), "d": ht.arange(8, split=0)}}
+        ht.utils.save_checkpoint(str(tmp_path / "ck"), state)
+        st = ht.utils.load_checkpoint(str(tmp_path / "ck"))
+        assert isinstance(st["misc"]["l"], list)
+        assert isinstance(st["misc"]["t"], tuple)
+        assert isinstance(st["misc"]["d"], ht.DNDarray) and st["misc"]["d"].split == 0

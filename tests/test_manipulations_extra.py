@@ -1,0 +1,123 @@
+"""Corner-case manipulation/linalg semantics vs NumPy (mined from the
+reference's test assertions: concatenate across splits, pad values, unique
+inverse, topk directions, roll/diff/flip/repeat/moveaxis, stack families,
+split families, percentile interpolation, outer/trace/tri on splits)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+X = np.arange(24, dtype=np.float32).reshape(6, 4)
+M = np.arange(36, dtype=np.float32).reshape(6, 6)
+Y3 = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+
+
+def test_concatenate_mixed_splits():
+    got = ht.concatenate([ht.array(X, split=0), ht.array(X, split=1)], axis=0)
+    np.testing.assert_array_equal(got.numpy(), np.concatenate([X, X], axis=0))
+
+
+def test_pad_constant_values():
+    got = ht.pad(ht.array(X, split=0), ((1, 2), (0, 1)), constant_values=7)
+    np.testing.assert_array_equal(got.numpy(), np.pad(X, ((1, 2), (0, 1)), constant_values=7))
+
+
+def test_unique_return_inverse():
+    a = np.array([3, 1, 3, 2, 1, 0], np.int32)
+    u, inv = ht.unique(ht.array(a, split=0), return_inverse=True)
+    un, invn = np.unique(a, return_inverse=True)
+    np.testing.assert_array_equal(u.numpy(), un)
+    np.testing.assert_array_equal(np.asarray(inv.numpy()).flatten(), invn)
+
+
+def test_topk_both_directions():
+    a = np.array([5.0, 1.0, 9.0, 3.0, 7.0, 2.0], np.float32)
+    v, i = ht.topk(ht.array(a, split=0), 3)
+    np.testing.assert_array_equal(np.sort(v.numpy())[::-1], np.sort(a)[::-1][:3])
+    np.testing.assert_array_equal(np.sort(a[i.numpy()]), np.sort(v.numpy()))
+    v2, _ = ht.topk(ht.array(a, split=0), 2, largest=False)
+    np.testing.assert_array_equal(np.sort(v2.numpy()), np.sort(a)[:2])
+
+
+def test_roll_multi_axis():
+    got = ht.roll(ht.array(X, split=0), (1, -1), axis=(0, 1))
+    np.testing.assert_array_equal(got.numpy(), np.roll(X, (1, -1), axis=(0, 1)))
+
+
+def test_diff_second_order():
+    got = ht.diff(ht.array(X, split=0), n=2, axis=0)
+    np.testing.assert_allclose(got.numpy(), np.diff(X, n=2, axis=0))
+
+
+def test_flip_multi_axis():
+    got = ht.flip(ht.array(X, split=1), (0, 1))
+    np.testing.assert_array_equal(got.numpy(), np.flip(X, (0, 1)))
+
+
+def test_repeat_axis():
+    got = ht.repeat(ht.array(X, split=0), 3, axis=1)
+    np.testing.assert_array_equal(got.numpy(), np.repeat(X, 3, axis=1))
+
+
+def test_moveaxis_3d():
+    got = ht.moveaxis(ht.array(Y3, split=2), 0, 2)
+    np.testing.assert_array_equal(got.numpy(), np.moveaxis(Y3, 0, 2))
+
+
+def test_expand_squeeze_split_tracking():
+    a = ht.array(X, split=1)
+    b = ht.expand_dims(a, 0)
+    assert b.split == 2
+    c = ht.squeeze(b, 0)
+    assert c.split == 1
+    np.testing.assert_array_equal(c.numpy(), X)
+
+
+def test_stack_families():
+    np.testing.assert_array_equal(
+        ht.vstack([ht.array(X, split=0), ht.array(X, split=0)]).numpy(), np.vstack([X, X])
+    )
+    np.testing.assert_array_equal(
+        ht.column_stack([ht.array(X[:, 0], split=0), ht.array(X[:, 1], split=0)]).numpy(),
+        np.column_stack([X[:, 0], X[:, 1]]),
+    )
+
+
+def test_split_families():
+    np.testing.assert_array_equal(
+        ht.vsplit(ht.array(X, split=0), 2)[1].numpy(), np.vsplit(X, 2)[1]
+    )
+    for i in range(4):
+        np.testing.assert_array_equal(
+            ht.array_split(ht.array(X, split=0), 4, axis=0)[i].numpy(),
+            np.array_split(X, 4, axis=0)[i],
+        )
+    # uneven: 6 rows into 4 sections -> sizes 2,2,1,1
+    got = ht.array_split(ht.array(X, split=0), 4, axis=0)
+    assert [g.shape[0] for g in got] == [2, 2, 1, 1]
+
+
+def test_percentile_interpolation():
+    got = ht.percentile(ht.array(X, split=0), 30.0)
+    np.testing.assert_allclose(np.asarray(got), np.percentile(X, 30.0), rtol=1e-5)
+
+
+def test_argmax_global():
+    r = ht.argmax(ht.array(X, split=0))
+    r = r.numpy() if isinstance(r, ht.DNDarray) else np.asarray(r)
+    np.testing.assert_array_equal(r, np.argmax(X))
+
+
+def test_outer_split_vectors():
+    v1, v2 = np.arange(5, dtype=np.float32), np.arange(7, dtype=np.float32) + 1
+    got = ht.linalg.outer(ht.array(v1, split=0), ht.array(v2, split=0))
+    np.testing.assert_array_equal(got.numpy(), np.outer(v1, v2))
+
+
+def test_trace_tri():
+    np.testing.assert_allclose(
+        float(np.asarray(ht.linalg.trace(ht.array(M, split=0)))), np.trace(M)
+    )
+    np.testing.assert_array_equal(ht.tril(ht.array(M, split=1)).numpy(), np.tril(M))
+    np.testing.assert_array_equal(ht.triu(ht.array(M, split=0), k=1).numpy(), np.triu(M, k=1))
