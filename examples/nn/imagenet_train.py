@@ -85,7 +85,7 @@ def main():
     for epoch in range(args.epochs):
         losses = []
         it = ht.utils.data.PartialH5DataLoaderIter(
-            dataset, batch_size=args.batch_size, shuffle=True
+            dataset, batch_size=args.batch_size, shuffle=True, seed=epoch
         )
         # yields (images, labels) tuples — two dataset names configured
         for images, labels in it:

@@ -53,12 +53,17 @@ def local_attention(q, k, v, scale: Optional[float] = None, causal: bool = False
     return jnp.einsum("...qk,...kd->...qd", weights, v)
 
 
-def _ring_body(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float):
+def _ring_body(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float, causal: bool = False):
     """Per-device ring attention with online softmax accumulation.
 
     q_blk: (B, Sq_local, H, D); k/v blk circulate. Accumulates
     (numerator, denominator, running max) so the result is exactly softmax
-    over the full global key axis.
+    over the full global key axis. With ``causal=True`` each step applies the
+    global-position mask: the K/V block resident at step t originated at rank
+    ``(rank - t) mod size``, so key j of that block has global index
+    ``src*Sk + j``; it is visible to query i iff global_k <= global_q. Step 0
+    holds the device's own diagonal block, so every query row sees at least
+    itself and the running max stays finite.
     """
     size = comm.size
     axis = comm.axis_name
@@ -68,7 +73,13 @@ def _ring_body(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float):
     q_heads = jnp.moveaxis(q_blk, 2, 1)  # (B, H, Sq, D)
 
     if pallas_enabled():
-        # per-step flash kernel on the resident K/V block; fold (out, lse)
+        # per-step flash kernel on the resident K/V block; fold (out, lse).
+        # Causal case: blocks are classified per step — step 0 holds the
+        # device's own diagonal block (causal flash); any later block is
+        # either fully visible (src rank < mine: plain flash) or fully
+        # masked (src rank > mine: fold weight zeroed via lse=-inf) — the
+        # kernel never materializes per-step logits either way.
+        rank = jax.lax.axis_index(axis)
         acc = jnp.zeros((B, H, Sq, D), jnp.float32)
         lse = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
         k_cur, v_cur = k_blk, v_blk
@@ -76,19 +87,24 @@ def _ring_body(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float):
             k_heads = jnp.moveaxis(k_cur, 2, 1)
             v_heads = jnp.moveaxis(v_cur, 2, 1)
             out_i, lse_i = flash_attention(
-                q_heads, k_heads, v_heads, scale=float(scale), return_lse=True
+                q_heads, k_heads, v_heads, scale=float(scale),
+                causal=causal and step == 0, return_lse=True,
             )
+            if causal and step > 0:
+                visible = ((rank - step) % size) < rank
+                lse_i = jnp.where(visible, lse_i, -jnp.inf)
             lse_new = jnp.logaddexp(lse, lse_i)
-            acc = (
-                acc * jnp.exp(lse - lse_new)[..., None]
-                + out_i.astype(jnp.float32) * jnp.exp(lse_i - lse_new)[..., None]
-            )
+            # guard the -inf−(-inf) corner (first fold of each row)
+            w_old = jnp.where(jnp.isfinite(lse), jnp.exp(lse - lse_new), 0.0)
+            w_new = jnp.where(jnp.isfinite(lse_i), jnp.exp(lse_i - lse_new), 0.0)
+            acc = acc * w_old[..., None] + out_i.astype(jnp.float32) * w_new[..., None]
             lse = lse_new
             if step != size - 1:
                 k_cur = jax.lax.ppermute(k_cur, axis, perm)
                 v_cur = jax.lax.ppermute(v_cur, axis, perm)
         return jnp.moveaxis(acc, 1, 2).astype(q_blk.dtype)
 
+    rank = jax.lax.axis_index(axis)
     acc = jnp.zeros((B, H, Sq, D), jnp.float32)
     denom = jnp.zeros((B, H, Sq), jnp.float32)
     run_max = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
@@ -101,10 +117,19 @@ def _ring_body(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float):
             jnp.einsum("bhqd,bhkd->bhqk", q_heads.astype(jnp.float32), k_heads.astype(jnp.float32))
             * scale
         )
+        if causal:
+            Sk = k_cur.shape[1]
+            src = (rank - step) % size
+            gq = rank * Sq + jnp.arange(Sq)[:, None]
+            gk = src * Sk + jnp.arange(Sk)[None, :]
+            logits = jnp.where(gk <= gq, logits, -jnp.inf)
         blk_max = jnp.max(logits, axis=-1)
         new_max = jnp.maximum(run_max, blk_max)
-        correction = jnp.exp(run_max - new_max)
+        # fully-masked blocks leave the running max untouched (avoids -inf-inf)
+        new_max = jnp.where(jnp.isfinite(new_max), new_max, run_max)
+        correction = jnp.where(jnp.isfinite(run_max), jnp.exp(run_max - new_max), 0.0)
         p = jnp.exp(logits - new_max[..., None])
+        p = jnp.where(jnp.isfinite(logits), p, 0.0)
         acc = acc * correction[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p, v_heads.astype(jnp.float32)
         )
@@ -118,13 +143,15 @@ def _ring_body(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float):
     return jnp.moveaxis(out, 1, 2).astype(q_blk.dtype)  # (B, Sq, H, D)
 
 
-def ring_attention(q, k, v, comm=None, scale: Optional[float] = None):
+def ring_attention(q, k, v, comm=None, scale: Optional[float] = None, causal: bool = False):
     """Exact attention over a sequence sharded across the mesh.
 
     Inputs: ``(batch, seq, heads, head_dim)`` jax arrays (or DNDarrays split
     along the sequence axis, axis 1). The K/V blocks circulate the ring —
     the reference's cdist systolic skeleton (``distance.py:280-362``) with
-    flash-attention accumulation in place of the distance tile.
+    flash-attention accumulation in place of the distance tile. With
+    ``causal=True`` the global causal mask is applied per ring step (for
+    autoregressive/LM training on sequence-sharded inputs).
     """
     wrapped = isinstance(q, DNDarray)
     if wrapped:
@@ -140,12 +167,12 @@ def ring_attention(q, k, v, comm=None, scale: Optional[float] = None):
 
     key = (
         "ring_attn", qa.shape, ka.shape, str(qa.dtype), float(scale), comm.cache_key,
-        pallas_enabled(),
+        pallas_enabled(), causal,
     )
     fn = _ATTN_CACHE.get(key)
     if fn is None:
         spec = comm.spec(4, 1)  # (batch, seq✂, heads, dim)
-        body = partial(_ring_body, comm=comm, scale=scale)
+        body = partial(_ring_body, comm=comm, scale=scale, causal=causal)
         sm = shard_map(
             body, mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
         )
@@ -157,7 +184,7 @@ def ring_attention(q, k, v, comm=None, scale: Optional[float] = None):
     return out
 
 
-def ulysses_attention(q, k, v, comm=None, scale: Optional[float] = None):
+def ulysses_attention(q, k, v, comm=None, scale: Optional[float] = None, causal: bool = False):
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
 
     Sequence-sharded ``(B, S✂, H, D)`` → all_to_all → head-sharded
@@ -182,7 +209,10 @@ def ulysses_attention(q, k, v, comm=None, scale: Optional[float] = None):
     if scale is None:
         scale = 1.0 / math.sqrt(qa.shape[-1])
 
-    key = ("ulysses", qa.shape, str(qa.dtype), float(scale), comm.cache_key, pallas_enabled())
+    key = (
+        "ulysses", qa.shape, str(qa.dtype), float(scale), comm.cache_key,
+        pallas_enabled(), causal,
+    )
     fn = _ATTN_CACHE.get(key)
     if fn is None:
         spec = comm.spec(4, 1)
@@ -197,8 +227,11 @@ def ulysses_attention(q, k, v, comm=None, scale: Optional[float] = None):
                 return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
 
             qh, kh, vh = seq2head(qb), seq2head(kb), seq2head(vb)
+            # after the swap every device holds the FULL sequence for its
+            # head subset, so the ordinary causal mask applies locally
             out = local_attention(
-                jnp.moveaxis(qh, 2, 1), jnp.moveaxis(kh, 2, 1), jnp.moveaxis(vh, 2, 1), scale
+                jnp.moveaxis(qh, 2, 1), jnp.moveaxis(kh, 2, 1), jnp.moveaxis(vh, 2, 1),
+                scale, causal=causal,
             )
             out = jnp.moveaxis(out, 1, 2)  # back to (B, S, h, D)
             return head2seq(out)

@@ -124,9 +124,16 @@ def _unflatten(leaves: Dict[str, Any], spec=None):
 
             try:
                 mod, qualname = spec["cls"]
+                # manifests are data, not code: only resolve classes from known
+                # state libraries, and only call genuine NamedTuple subclasses
+                allowed = ("optax", "flax", "jax", "heat_tpu", "chex")
+                if mod.partition(".")[0] not in allowed:
+                    return tuple(rebuilt)
                 cls = importlib.import_module(mod)
                 for part in qualname.split("."):
                     cls = getattr(cls, part)
+                if not (isinstance(cls, type) and issubclass(cls, tuple) and hasattr(cls, "_fields")):
+                    return tuple(rebuilt)
                 return cls(*rebuilt)
             except (ImportError, AttributeError):
                 return tuple(rebuilt)  # class no longer importable

@@ -167,3 +167,28 @@ class TestKernelEdgeCases:
         base_o = np.asarray(pk.flash_attention(q, q, q))
         o = np.asarray(pk.flash_attention(q, q, q, block_q=100, block_k=100))
         np.testing.assert_allclose(o, base_o, rtol=1e-6, atol=1e-6)
+
+
+class TestCausalRingPallas:
+    def test_causal_ring_flash_path(self, force_pallas):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(21)
+        B, S, H, D = 2, 64, 8, 16
+        q, k, v = (rng.normal(size=(B, S, H, D)).astype(np.float32) for _ in range(3))
+        dense = np.moveaxis(
+            np.asarray(
+                ht.nn.local_attention(
+                    jnp.moveaxis(jnp.asarray(q), 2, 1),
+                    jnp.moveaxis(jnp.asarray(k), 2, 1),
+                    jnp.moveaxis(jnp.asarray(v), 2, 1),
+                    causal=True,
+                )
+            ),
+            1,
+            2,
+        )
+        out = ht.nn.ring_attention(
+            ht.array(q, split=1), ht.array(k, split=1), ht.array(v, split=1), causal=True
+        )
+        np.testing.assert_allclose(out.numpy(), dense, rtol=1e-4, atol=1e-4)
