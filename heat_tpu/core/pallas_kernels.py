@@ -240,26 +240,18 @@ def _flash_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "causal", "return_lse", "block_q", "block_k")
+    jax.jit, static_argnames=("scale", "causal", "block_q", "block_k")
 )
-def flash_attention(
+def _flash_impl(
     q,
     k,
     v,
-    scale: Optional[float] = None,
-    causal: bool = False,
-    return_lse: bool = False,
-    block_q: int = 256,
-    block_k: int = 256,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_k: int,
 ):
-    """Blockwise (flash) attention with online softmax.
-
-    ``q``: ``(B, H, Sq, D)``; ``k``/``v``: ``(B, H, Sk, D)``. Returns the
-    attention output, plus per-row log-sum-exp when ``return_lse`` — the
-    merge statistic ring attention folds across ``ppermute`` steps.
-    """
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
+    """Raw blockwise (flash) attention forward; returns ``(out, lse)``."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     acc_dtype = jnp.float64 if jnp.promote_types(q.dtype, jnp.float32) == jnp.float64 else jnp.float32
@@ -311,6 +303,75 @@ def flash_attention(
     )(qf, kf, vf)
 
     out = out[:, :Sq, :D].reshape(B, H, Sq, D)
+    return out, lse[:, :Sq, 0].reshape(B, H, Sq)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_diff(q, k, v, scale, causal, block_q, block_k):
+    return _flash_impl(q, k, v, scale, causal, block_q, block_k)
+
+
+def _flash_diff_fwd(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _flash_impl(q, k, v, scale, causal, block_q, block_k)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_diff_bwd(scale, causal, block_q, block_k, residuals, cotangents):
+    """Flash-attention backward: recompute probabilities from the saved lse
+    and apply the standard softmax-attention gradient (fp32). The lse output
+    is a differentiated product too (ring attention folds with it):
+    ``∂lse/∂S = P`` adds ``dlse·P`` to the score cotangent.
+
+    Memory is O(Sq·Sk) per (batch, head) — a jnp fallback rather than a
+    Pallas backward kernel; correct on every backend."""
+    q, k, v, out, lse = residuals
+    dout, dlse = cotangents
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    doutf, outf = dout.astype(jnp.float32), out.astype(jnp.float32)
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    Sq, Sk = s.shape[-2], s.shape[-1]
+    if causal:
+        row = jnp.arange(Sq)[:, None]
+        col = jnp.arange(Sk)[None, :]
+        s = jnp.where(col <= row + (Sk - Sq), s, -jnp.inf)
+    p = jnp.exp(s - lse[..., None].astype(jnp.float32))
+    p = jnp.where(jnp.isfinite(s), p, 0.0)  # fully-masked rows have lse=-inf
+
+    d_rows = jnp.sum(doutf * outf, axis=-1)  # (B, H, Sq)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", doutf, vf)
+    ds = p * (dp - d_rows[..., None] + dlse.astype(jnp.float32)[..., None])
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, doutf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    scale: Optional[float] = None,
+    causal: bool = False,
+    return_lse: bool = False,
+    block_q: int = 256,
+    block_k: int = 256,
+):
+    """Blockwise (flash) attention with online softmax.
+
+    ``q``: ``(B, H, Sq, D)``; ``k``/``v``: ``(B, H, Sk, D)``. Returns the
+    attention output, plus per-row log-sum-exp when ``return_lse`` — the
+    merge statistic ring attention folds across ``ppermute`` steps.
+    Differentiable: the Pallas forward pairs with a recompute-from-lse
+    backward (``_flash_diff_bwd``), so training paths (ring attention, the
+    transformer example) work on TPU.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    out, lse = _flash_diff(q, k, v, float(scale), bool(causal), int(block_q), int(block_k))
     if return_lse:
-        return out, lse[:, :Sq, 0].reshape(B, H, Sq)
+        return out, lse
     return out

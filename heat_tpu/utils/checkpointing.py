@@ -27,6 +27,11 @@ __all__ = ["save_checkpoint", "load_checkpoint", "checkpoint_estimator", "restor
 
 _MANIFEST = "manifest.json"
 
+# namedtuple classes from these top-level modules are reconstructed on
+# restore; extend (e.g. ``NAMEDTUPLE_ALLOWLIST.add("mytrainlib")``) to restore
+# custom state classes — anything else degrades to a plain tuple with a warning
+NAMEDTUPLE_ALLOWLIST = {"optax", "flax", "jax", "heat_tpu", "chex", "__main__"}
+
 
 def _flatten(tree, prefix=""):
     """Flatten nested dicts/lists/tuples of arrays into (path → leaf, spec).
@@ -121,22 +126,32 @@ def _unflatten(leaves: Dict[str, Any], spec=None):
         rebuilt = [_unflatten(leaves, s) for s in spec["items"]]
         if spec["kind"] == "namedtuple":
             import importlib
+            import warnings
+
+            def degrade(reason):
+                warnings.warn(
+                    f"checkpoint namedtuple {spec['cls']} restored as a plain "
+                    f"tuple ({reason}); extend "
+                    f"heat_tpu.utils.checkpointing.NAMEDTUPLE_ALLOWLIST to "
+                    f"restore custom state classes",
+                    stacklevel=2,
+                )
+                return tuple(rebuilt)
 
             try:
                 mod, qualname = spec["cls"]
-                # manifests are data, not code: only resolve classes from known
-                # state libraries, and only call genuine NamedTuple subclasses
-                allowed = ("optax", "flax", "jax", "heat_tpu", "chex")
-                if mod.partition(".")[0] not in allowed:
-                    return tuple(rebuilt)
+                # manifests are data, not code: only resolve classes from
+                # allowlisted modules, and only call genuine NamedTuples
+                if mod.partition(".")[0] not in NAMEDTUPLE_ALLOWLIST:
+                    return degrade("module not in allowlist")
                 cls = importlib.import_module(mod)
                 for part in qualname.split("."):
                     cls = getattr(cls, part)
                 if not (isinstance(cls, type) and issubclass(cls, tuple) and hasattr(cls, "_fields")):
-                    return tuple(rebuilt)
+                    return degrade("not a NamedTuple class")
                 return cls(*rebuilt)
             except (ImportError, AttributeError):
-                return tuple(rebuilt)  # class no longer importable
+                return degrade("class not importable")
         return tuple(rebuilt) if spec["kind"] == "tuple" else rebuilt
     root: Dict[str, Any] = {}
     for path, leaf in leaves.items():

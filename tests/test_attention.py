@@ -6,6 +6,8 @@ import pytest
 
 import heat_tpu as ht
 
+from utils import dense_causal_attention
+
 
 def _qkv(B=2, S=32, H=8, D=16, seed=0):
     rng = np.random.default_rng(seed)
@@ -86,18 +88,7 @@ class TestCausalSequenceParallel:
         q, k, v = _qkv(B=2, S=64, H=8, D=16, seed=11)
         import jax.numpy as jnp
 
-        dense = np.moveaxis(
-            np.asarray(
-                ht.nn.local_attention(
-                    jnp.moveaxis(jnp.asarray(q), 2, 1),
-                    jnp.moveaxis(jnp.asarray(k), 2, 1),
-                    jnp.moveaxis(jnp.asarray(v), 2, 1),
-                    causal=True,
-                )
-            ),
-            1,
-            2,
-        )
+        dense = dense_causal_attention(q, k, v)
         out = ht.nn.ring_attention(
             ht.array(q, split=1), ht.array(k, split=1), ht.array(v, split=1), causal=True
         )
@@ -107,18 +98,7 @@ class TestCausalSequenceParallel:
         q, k, v = _qkv(B=2, S=64, H=8, D=16, seed=12)
         import jax.numpy as jnp
 
-        dense = np.moveaxis(
-            np.asarray(
-                ht.nn.local_attention(
-                    jnp.moveaxis(jnp.asarray(q), 2, 1),
-                    jnp.moveaxis(jnp.asarray(k), 2, 1),
-                    jnp.moveaxis(jnp.asarray(v), 2, 1),
-                    causal=True,
-                )
-            ),
-            1,
-            2,
-        )
+        dense = dense_causal_attention(q, k, v)
         if ht.get_comm().size > 1 and q.shape[2] % ht.get_comm().size:
             pytest.skip("heads must divide mesh size")
         out = ht.nn.ulysses_attention(

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 
 import heat_tpu as ht
+
+from utils import dense_causal_attention
 from heat_tpu.core import pallas_kernels as pk
 
 
@@ -176,19 +178,49 @@ class TestCausalRingPallas:
         rng = np.random.default_rng(21)
         B, S, H, D = 2, 64, 8, 16
         q, k, v = (rng.normal(size=(B, S, H, D)).astype(np.float32) for _ in range(3))
-        dense = np.moveaxis(
-            np.asarray(
-                ht.nn.local_attention(
-                    jnp.moveaxis(jnp.asarray(q), 2, 1),
-                    jnp.moveaxis(jnp.asarray(k), 2, 1),
-                    jnp.moveaxis(jnp.asarray(v), 2, 1),
-                    causal=True,
-                )
-            ),
-            1,
-            2,
-        )
+        dense = dense_causal_attention(q, k, v)
         out = ht.nn.ring_attention(
             ht.array(q, split=1), ht.array(k, split=1), ht.array(v, split=1), causal=True
         )
         np.testing.assert_allclose(out.numpy(), dense, rtol=1e-4, atol=1e-4)
+
+
+class TestFlashBackward:
+    """The Pallas forward pairs with a recompute-from-lse backward
+    (custom_vjp) — training paths must differentiate through it."""
+
+    def test_flash_grad_matches_dense(self, force_pallas):
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(31)
+        B, H, S, D = 1, 2, 64, 16
+        q, k, v = (jnp.asarray(rng.normal(size=(B, H, S, D)).astype(np.float32)) for _ in range(3))
+
+        def dense(q, k, v, causal):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(D * 1.0)
+            if causal:
+                s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, -jnp.inf)
+            return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+        for causal in (False, True):
+            f_flash = lambda a, b, c: jnp.sum(jnp.sin(pk.flash_attention(a, b, c, causal=causal)))
+            f_dense = lambda a, b, c: jnp.sum(jnp.sin(dense(a, b, c, causal)))
+            gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+            gd = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+            for a, b in zip(gf, gd):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4)
+
+    def test_ring_training_step_with_pallas(self, force_pallas):
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(33)
+        q = ht.array(rng.normal(size=(1, 64, 4, 8)).astype(np.float32), split=1).larray
+        comm = ht.get_comm()
+
+        def loss(t):
+            return jnp.sum(ht.nn.ring_attention(t, t, t, comm=comm, causal=True) ** 2)
+
+        g = jax.jit(jax.grad(loss))(q)
+        assert np.isfinite(np.asarray(g)).all()

@@ -84,3 +84,27 @@ class TestTypeLattice:
         s = x.sum()
         assert float(s.item()) == 16.0
         assert x.dtype is ht.bfloat16
+
+
+class TestBfloat16EndToEnd:
+    """bf16 is the MXU input format — it must flow through creation, GEMM,
+    reductions, and promotion without the reference's int16 bit-cast staging
+    (reference ``communication.py:137-138``)."""
+
+    def test_bf16_matmul_reduce_promote(self):
+        a = ht.random.randn(256, 64, split=0, dtype=ht.bfloat16)
+        b = ht.random.randn(64, 32, dtype=ht.bfloat16)
+        c = a @ b
+        assert c.dtype == ht.bfloat16
+        s = float(c.sum().item())
+        assert np.isfinite(s)
+        assert ht.promote_types(ht.bfloat16, ht.float32) == ht.float32
+        assert (a + 1.0).dtype == ht.bfloat16
+        m = a.mean(axis=0)
+        assert m.dtype == ht.bfloat16 and m.shape == (64,)
+
+    def test_bf16_astype_roundtrip_values(self):
+        x = np.linspace(-4, 4, 64).astype(np.float32)
+        a = ht.array(x, split=0, dtype=ht.bfloat16)
+        back = a.astype(ht.float32).numpy()
+        np.testing.assert_allclose(back, x, rtol=2e-2)  # bf16 has ~8 mantissa bits

@@ -67,3 +67,18 @@ def assert_func_equal(
             assert_array_equal(result, expected, rtol=1e-4, atol=1e-6)
         else:
             np.testing.assert_allclose(np.asarray(result), expected, rtol=1e-4, atol=1e-6)
+
+
+def dense_causal_attention(q, k, v):
+    """Dense causal attention reference in (B, S, H, D) layout, via
+    local_attention on the (B, H, S, D) layout — shared by the attention and
+    pallas test files."""
+    import jax.numpy as jnp
+
+    out = ht.nn.local_attention(
+        jnp.moveaxis(jnp.asarray(q), 2, 1),
+        jnp.moveaxis(jnp.asarray(k), 2, 1),
+        jnp.moveaxis(jnp.asarray(v), 2, 1),
+        causal=True,
+    )
+    return np.moveaxis(np.asarray(out), 1, 2)
