@@ -39,6 +39,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 __all__ = [
     "Communication",
     "TPUCommunication",
+    "MeshAxisComm",
+    "MeshGrid",
     "MESH_WORLD",
     "MESH_SELF",
     "get_comm",
@@ -274,6 +276,107 @@ class TPUCommunication(Communication):
         """New communicator over a subset of devices (reference ``Split``, ``:445``)."""
         sub = [self._devices[i] for i in devices]
         return TPUCommunication(sub, axis_name or self.axis_name)
+
+
+class MeshAxisComm(TPUCommunication):
+    """A single named axis of a :class:`MeshGrid`, exposed as a communicator.
+
+    Shares the grid's N-D ``jax.sharding.Mesh``; every inherited collective
+    (``psum``/``all_gather``/``all_to_all``/``ppermute``/``ring_shift``/…)
+    runs over THIS axis only, and ``sharding``/``spec`` place this axis at
+    the split dimension (replicated across the grid's other axes). A
+    DNDarray created with ``comm=grid.axis("dp")`` is therefore sharded over
+    the dp rows of the grid and replicated over the other axes — the
+    building block for combined dp×sp programs.
+    """
+
+    def __init__(self, grid: "MeshGrid", axis_name: str):
+        self._grid = grid
+        self._devices = tuple(grid.mesh.devices.flatten())
+        self.axis_name = axis_name
+        self.mesh = grid.mesh
+
+    @property
+    def size(self) -> int:
+        return self.mesh.shape[self.axis_name]
+
+    @property
+    def grid(self) -> "MeshGrid":
+        return self._grid
+
+    @property
+    def cache_key(self) -> Tuple:
+        return (
+            self.axis_name,
+            tuple(self.mesh.shape.items()),
+            tuple(d.id for d in self._devices),
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"MeshAxisComm(axis='{self.axis_name}', size={self.size}, "
+            f"grid={dict(self.mesh.shape)})"
+        )
+
+
+class MeshGrid:
+    """A named N-D device mesh for combined parallelism (e.g. dp × sp).
+
+    The reference's single-axis ``split`` model composes one strategy at a
+    time; a grid composes several — the batch sharded over one axis while
+    the sequence (ring attention) is sharded over another, in the same
+    compiled program. Multi-host pods: the leading axis is typically the DCN
+    (slow) axis, trailing axes ride ICI.
+
+    >>> grid = MeshGrid((2, 4), ("dp", "sp"))
+    >>> xb = ht.random.rand(64, 16, split=0, comm=grid.axis("dp"))   # batch
+    >>> qs = ht.random.rand(1, 256, 8, 16, split=1, comm=grid.axis("sp"))
+    """
+
+    def __init__(self, shape: Sequence[int], axis_names: Sequence[str] = ("dp", "sp"),
+                 devices: Optional[Sequence] = None):
+        shape = tuple(int(s) for s in shape)
+        axis_names = tuple(axis_names)
+        if len(shape) != len(axis_names):
+            raise ValueError(f"shape {shape} and axis_names {axis_names} length mismatch")
+        if devices is None:
+            devices = tuple(jax.devices())
+        else:
+            devices = tuple(devices)
+        want = int(np.prod(shape))
+        if want != len(devices):
+            raise ValueError(f"grid shape {shape} needs {want} devices, got {len(devices)}")
+        self.shape = shape
+        self.axis_names = axis_names
+        self.mesh = Mesh(np.asarray(devices).reshape(shape), axis_names)
+        self._axes = {name: MeshAxisComm(self, name) for name in axis_names}
+
+    def axis(self, name: str) -> MeshAxisComm:
+        """The communicator view of one grid axis."""
+        return self._axes[name]
+
+    def spec(self, ndim: int, **axis_to_dim: int) -> PartitionSpec:
+        """PartitionSpec placing each named grid axis at the given dimension,
+        e.g. ``grid.spec(4, dp=0, sp=1)`` for a (batch✂dp, seq✂sp, …) array."""
+        placement = [None] * ndim
+        for name, dim in axis_to_dim.items():
+            if name not in self._axes:
+                raise ValueError(f"unknown grid axis {name!r}; have {self.axis_names}")
+            if not -ndim <= dim < ndim:
+                raise ValueError(f"dimension {dim} out of range for ndim {ndim}")
+            dim %= ndim
+            if placement[dim] is not None:
+                raise ValueError(
+                    f"grid axes {placement[dim]!r} and {name!r} both map to dimension {dim}"
+                )
+            placement[dim] = name
+        return PartitionSpec(*placement)
+
+    def sharding(self, ndim: int, **axis_to_dim: int) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec(ndim, **axis_to_dim))
+
+    def __repr__(self) -> str:
+        return f"MeshGrid({dict(zip(self.axis_names, self.shape))})"
 
 
 # ---------------------------------------------------------------------- #

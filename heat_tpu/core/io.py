@@ -78,19 +78,37 @@ def _shard_and_wrap(load_chunk, gshape, jdtype, split, device, comm) -> DNDarray
         return factories.array(np.asarray(data), dtype=types.canonical_heat_type(jdtype), comm=comm, device=device)
     split = sanitize_axis(gshape, split)
     c = comm.chunk_size(gshape[split])
-    shards = []
     sharding = comm.sharding(len(gshape), split)
-    for rank in range(comm.size):
-        _, lshape, slices = comm.chunk(gshape, split, rank=rank)
-        chunk = np.asarray(load_chunk(slices), dtype=np.dtype(jdtype) if jdtype != jnp.bfloat16 else np.float32)
-        pad_rows = c - chunk.shape[split]
-        if pad_rows:
-            cfg = [(0, pad_rows if i == split else 0) for i in range(len(gshape))]
-            chunk = np.pad(chunk, cfg)
-        shards.append(jax.device_put(jnp.asarray(chunk, jdtype), comm.devices[rank]))
     phys_shape = list(gshape)
     phys_shape[split] = c * comm.size
-    parray = jax.make_array_from_single_device_arrays(tuple(phys_shape), sharding, shards)
+    np_dtype = np.dtype(jdtype) if jdtype != jnp.bfloat16 else np.float32
+    cache: dict = {}
+
+    def read_block(index):
+        # index: per-device slice tuple into the PHYSICAL shape; clamp to the
+        # logical extent, read, and pad back to the physical block. Works for
+        # any sharding (1-D mesh or a grid axis view, where devices on other
+        # grid axes receive replicated copies of the same block).
+        key = tuple((s.start, s.stop) for s in index)
+        if key in cache:
+            return cache[key]
+        req = list(index)
+        lo = index[split].start or 0
+        hi = min(index[split].stop or phys_shape[split], gshape[split])
+        req[split] = slice(lo, max(hi, lo))
+        chunk = np.asarray(load_chunk(tuple(req)), dtype=np_dtype)
+        want_rows = (index[split].stop or phys_shape[split]) - lo
+        if chunk.shape[split] < want_rows:
+            cfg = [
+                (0, want_rows - chunk.shape[split] if i == split else 0)
+                for i in range(len(gshape))
+            ]
+            chunk = np.pad(chunk, cfg)
+        out = jnp.asarray(chunk, jdtype)
+        cache[key] = out
+        return out
+
+    parray = jax.make_array_from_callback(tuple(phys_shape), sharding, read_block)
     return DNDarray(
         parray, gshape, types.canonical_heat_type(jdtype), split, device, comm
     )

@@ -143,7 +143,20 @@ def _ring_body(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float, causal
     return jnp.moveaxis(out, 1, 2).astype(q_blk.dtype)  # (B, Sq, H, D)
 
 
-def ring_attention(q, k, v, comm=None, scale: Optional[float] = None, causal: bool = False):
+def _attn_spec(comm, batch_axis):
+    """(batch, seq✂, heads, dim) PartitionSpec; with ``batch_axis`` the
+    batch dimension is sharded over that grid axis too."""
+    if batch_axis is None:
+        return comm.spec(4, 1)
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(batch_axis, comm.axis_name, None, None)
+
+
+def ring_attention(
+    q, k, v, comm=None, scale: Optional[float] = None, causal: bool = False,
+    batch_axis: Optional[str] = None,
+):
     """Exact attention over a sequence sharded across the mesh.
 
     Inputs: ``(batch, seq, heads, head_dim)`` jax arrays (or DNDarrays split
@@ -152,6 +165,11 @@ def ring_attention(q, k, v, comm=None, scale: Optional[float] = None, causal: bo
     flash-attention accumulation in place of the distance tile. With
     ``causal=True`` the global causal mask is applied per ring step (for
     autoregressive/LM training on sequence-sharded inputs).
+
+    On a :class:`~heat_tpu.core.communication.MeshGrid` axis view,
+    ``batch_axis`` names another grid axis the batch dimension is sharded
+    over — combined dp×sp: independent rings run per batch shard
+    (``ring_attention(q, k, v, comm=grid.axis("sp"), batch_axis="dp")``).
     """
     wrapped = isinstance(q, DNDarray)
     if wrapped:
@@ -167,11 +185,11 @@ def ring_attention(q, k, v, comm=None, scale: Optional[float] = None, causal: bo
 
     key = (
         "ring_attn", qa.shape, ka.shape, str(qa.dtype), float(scale), comm.cache_key,
-        pallas_enabled(), causal,
+        pallas_enabled(), causal, batch_axis,
     )
     fn = _ATTN_CACHE.get(key)
     if fn is None:
-        spec = comm.spec(4, 1)  # (batch, seq✂, heads, dim)
+        spec = _attn_spec(comm, batch_axis)
         body = partial(_ring_body, comm=comm, scale=scale, causal=causal)
         sm = shard_map(
             body, mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
@@ -184,7 +202,10 @@ def ring_attention(q, k, v, comm=None, scale: Optional[float] = None, causal: bo
     return out
 
 
-def ulysses_attention(q, k, v, comm=None, scale: Optional[float] = None, causal: bool = False):
+def ulysses_attention(
+    q, k, v, comm=None, scale: Optional[float] = None, causal: bool = False,
+    batch_axis: Optional[str] = None,
+):
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
 
     Sequence-sharded ``(B, S✂, H, D)`` → all_to_all → head-sharded
@@ -211,11 +232,11 @@ def ulysses_attention(q, k, v, comm=None, scale: Optional[float] = None, causal:
 
     key = (
         "ulysses", qa.shape, str(qa.dtype), float(scale), comm.cache_key,
-        pallas_enabled(), causal,
+        pallas_enabled(), causal, batch_axis,
     )
     fn = _ATTN_CACHE.get(key)
     if fn is None:
-        spec = comm.spec(4, 1)
+        spec = _attn_spec(comm, batch_axis)
         axis = comm.axis_name
 
         def body(qb, kb, vb):

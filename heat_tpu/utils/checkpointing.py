@@ -30,7 +30,7 @@ _MANIFEST = "manifest.json"
 # namedtuple classes from these top-level modules are reconstructed on
 # restore; extend (e.g. ``NAMEDTUPLE_ALLOWLIST.add("mytrainlib")``) to restore
 # custom state classes — anything else degrades to a plain tuple with a warning
-NAMEDTUPLE_ALLOWLIST = {"optax", "flax", "jax", "heat_tpu", "chex", "__main__"}
+NAMEDTUPLE_ALLOWLIST = {"optax", "flax", "jax", "heat_tpu", "chex"}
 
 
 def _flatten(tree, prefix=""):
