@@ -17,6 +17,14 @@ from . import functional
 from . import functional as F
 from . import attention
 from .attention import local_attention, ring_attention, ulysses_attention
+from . import parallel
+from .parallel import (
+    column_parallel_dense,
+    row_parallel_dense,
+    tp_mlp,
+    switch_moe,
+    pipeline_apply,
+)
 
 __all__ = [
     "DataParallel",
@@ -27,6 +35,12 @@ __all__ = [
     "local_attention",
     "ring_attention",
     "ulysses_attention",
+    "parallel",
+    "column_parallel_dense",
+    "row_parallel_dense",
+    "tp_mlp",
+    "switch_moe",
+    "pipeline_apply",
 ]
 
 # torch-style aliases onto flax.linen (parity with the reference's
