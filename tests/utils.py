@@ -69,6 +69,22 @@ def assert_func_equal(
             np.testing.assert_allclose(np.asarray(result), expected, rtol=1e-4, atol=1e-6)
 
 
+def dense_causal_attention_jnp(q, k, v):
+    """Pure-jnp dense causal attention in (B, S, H, D) layout — the single
+    differentiable reference implementation shared by the attention, pallas
+    and transformer test files."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = jnp.einsum("bqhk,bshk->bhqs", q, k) / jnp.sqrt(
+        jnp.asarray(q.shape[-1], q.dtype))
+    S, Sk = logits.shape[-2], logits.shape[-1]
+    mask = jnp.tril(jnp.ones((S, Sk), bool), Sk - S)
+    logits = jnp.where(mask, logits, -jnp.inf)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqs,bshk->bqhk", w, v)
+
+
 def dense_causal_attention(q, k, v):
     """Dense causal attention reference in (B, S, H, D) layout, via
     local_attention on the (B, H, S, D) layout — shared by the attention and
