@@ -3,10 +3,18 @@
 The benchmark workload (SURVEY.md §3.4, §6). The reference's Lloyd epoch is a
 chain of cdist → argmin → k masked sum/count Allreduces
 (``kmeans.py:73-139``). Here one **fused jitted Lloyd step** runs per
-iteration: squared-distance GEMM tile (MXU) → argmin → one-hot matmul for the
-centroid sums (MXU again) → GSPMD ``psum`` for counts and sums. The whole
-step is a single XLA program over the sharded array; padding rows are masked
-once inside the kernel.
+iteration, with two backends:
+
+* **Pallas (TPU)**: :func:`heat_tpu.core.pallas_kernels.kmeans_step_tile`
+  streams each device's X shard from HBM exactly ONCE per iteration — the
+  assignment GEMM, argmin, one-hot update GEMM and inertia terms all
+  consume the same VMEM-resident tile — wrapped in ``shard_map`` with a
+  ``psum`` for the cross-device centroid reduction.
+* **XLA (fallback)**: squared-distance GEMM tile (MXU) → argmin → one-hot
+  matmul for the centroid sums → GSPMD ``psum``.
+
+Labels are not materialized in the hot loop (an N-vector write per
+iteration); ``fit`` computes them once after convergence.
 """
 
 from __future__ import annotations
@@ -17,18 +25,52 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
 
 from ..core.dndarray import DNDarray
 from ..core import types
+from ..core.pallas_kernels import kmeans_step_tile, pallas_enabled
 from ._kcluster import _KCluster
 
 __all__ = ["KMeans"]
 
-# cache of jitted Lloyd steps keyed by (physical shape, dtype, k, comm)
+# cache of jitted Lloyd steps keyed by (physical shape, dtype, k, comm, path)
 _STEP_CACHE: dict = {}
 
 
-def _make_step_body(phys_shape, jdt, k, n_valid):
+def _finish_update(sums, counts, centroids):
+    """Centroid division + empty-cluster keep + shift (replicated inputs)."""
+    new_centroids = sums / jnp.maximum(counts, 1.0)[:, None]
+    new_centroids = jnp.where((counts > 0)[:, None], new_centroids, centroids)
+    shift = jnp.sum((new_centroids - centroids) ** 2)
+    return new_centroids, shift
+
+
+def _make_step_body(phys_shape, jdt, k, n_valid, comm):
+    """(xp, centroids) -> (new_centroids, inertia, shift); one Lloyd step."""
+    if pallas_enabled():
+        chunk = phys_shape[0] // comm.size
+        axis = comm.axis_name
+
+        def device_step(xp_blk, centroids):
+            rank = jax.lax.axis_index(axis)
+            row = rank * chunk + jax.lax.broadcasted_iota(
+                jnp.int32, (chunk, 1), 0)
+            mask = (row < n_valid).astype(xp_blk.dtype)
+            sums, counts, inertia = kmeans_step_tile(xp_blk, centroids, mask)
+            sums = jax.lax.psum(sums, axis)
+            counts = jax.lax.psum(counts, axis)
+            inertia = jax.lax.psum(inertia, axis)
+            new_centroids, shift = _finish_update(sums, counts, centroids)
+            return new_centroids, inertia, shift
+
+        return shard_map(
+            device_step, mesh=comm.mesh,
+            in_specs=(comm.spec(2, 0), P()),
+            out_specs=(P(), P(), P()),
+            check_vma=False)
+
     def _step(xp, centroids):
         # valid-row mask for canonical padding
         row = jax.lax.broadcasted_iota(jnp.int32, (phys_shape[0], 1), 0)
@@ -41,21 +83,43 @@ def _make_step_body(phys_shape, jdt, k, n_valid):
         onehot_f = onehot.astype(xp.dtype)
         counts = jnp.sum(onehot_f, axis=0)  # (k,)  — psum by GSPMD
         sums = onehot_f.T @ xp  # (k, d) GEMM — psum by GSPMD
-        new_centroids = sums / jnp.maximum(counts, 1.0)[:, None]
-        # keep empty clusters where they are (reference keeps old centroid)
-        new_centroids = jnp.where((counts > 0)[:, None], new_centroids, centroids)
         inertia = jnp.sum(jnp.where(valid[:, 0], jnp.min(d2, axis=1), 0.0))
-        shift = jnp.sum((new_centroids - centroids) ** 2)
-        return new_centroids, labels, inertia, shift
+        new_centroids, shift = _finish_update(sums, counts, centroids)
+        return new_centroids, inertia, shift
 
     return _step
 
 
 def _lloyd_step_fn(phys_shape, jdt, k, n_valid, comm):
-    key = (phys_shape, str(jdt), k, n_valid, comm.cache_key)
+    key = (phys_shape, str(jdt), k, n_valid, comm.cache_key, pallas_enabled())
     fn = _STEP_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(_make_step_body(phys_shape, jdt, k, n_valid))
+        fn = jax.jit(_make_step_body(phys_shape, jdt, k, n_valid, comm))
+        _STEP_CACHE[key] = fn
+    return fn
+
+
+def _assign_fn(phys_shape, jdt, k, n_valid, comm):
+    """Final assignment pass: labels AND inertia against the same (final)
+    centroids, so ``labels_``/``cluster_centers_``/``inertia_`` are mutually
+    consistent (sklearn convention). The x^2 term does not change the
+    argmin; it is added back only for the inertia."""
+    key = ("assign", phys_shape, str(jdt), k, n_valid, comm.cache_key)
+    fn = _STEP_CACHE.get(key)
+    if fn is None:
+
+        def _assign(xp, centroids):
+            row = jax.lax.broadcasted_iota(jnp.int32, (phys_shape[0],), 0)
+            valid = row < n_valid
+            c2 = jnp.sum(centroids * centroids, axis=1)[None, :]
+            scores = c2 - 2.0 * (xp @ centroids.T)
+            labels = jnp.argmin(scores, axis=1)
+            x2 = jnp.sum(xp * xp, axis=1)
+            inertia = jnp.sum(
+                jnp.where(valid, x2 + jnp.min(scores, axis=1), 0.0))
+            return labels, inertia
+
+        fn = jax.jit(_assign)
         _STEP_CACHE[key] = fn
     return fn
 
@@ -68,22 +132,53 @@ def _lloyd_fori_fn(phys_shape, jdt, k, n_valid, comm):
     hard part 5). Used by the benchmark driver, which times two different
     trip counts with the same executable and differences them to cancel
     constant dispatch/transfer overhead."""
-    key = ("fori", phys_shape, str(jdt), k, n_valid, comm.cache_key)
+    key = ("fori", phys_shape, str(jdt), k, n_valid, comm.cache_key,
+           pallas_enabled())
     fn = _STEP_CACHE.get(key)
     if fn is None:
-        single = _make_step_body(phys_shape, jdt, k, n_valid)
+        if pallas_enabled():
+            # shard_map OUTSIDE the loop: the valid mask is computed once
+            # and the whole iteration sequence is one per-device program
+            chunk = phys_shape[0] // comm.size
+            axis = comm.axis_name
 
-        def _run(xp, centroids, iters):
-            def body(_, carry):
-                c, _, _ = carry
-                c2, _, inertia, shift = single(xp, c)
-                return c2, inertia, shift
+            def _run_device(xp_blk, centroids, iters):
+                rank = jax.lax.axis_index(axis)
+                row = rank * chunk + jax.lax.broadcasted_iota(
+                    jnp.int32, (chunk, 1), 0)
+                mask = (row < n_valid).astype(xp_blk.dtype)
 
-            z = jnp.zeros((), jdt)
-            c, inertia, shift = jax.lax.fori_loop(0, iters, body, (centroids, z, z))
-            return c, inertia, shift
+                def body(_, carry):
+                    c, _, _ = carry
+                    sums, counts, inertia = kmeans_step_tile(xp_blk, c, mask)
+                    sums = jax.lax.psum(sums, axis)
+                    counts = jax.lax.psum(counts, axis)
+                    inertia = jax.lax.psum(inertia, axis)
+                    new_c, shift = _finish_update(sums, counts, c)
+                    return new_c, inertia, shift
 
-        fn = jax.jit(_run)
+                z = jnp.zeros((), jdt)
+                return jax.lax.fori_loop(0, iters, body, (centroids, z, z))
+
+            fn = jax.jit(shard_map(
+                _run_device, mesh=comm.mesh,
+                in_specs=(comm.spec(2, 0), P(), P()),
+                out_specs=(P(), P(), P()),
+                check_vma=False))
+        else:
+            single = _make_step_body(phys_shape, jdt, k, n_valid, comm)
+
+            def _run(xp, centroids, iters):
+                def body(_, carry):
+                    c, _, _ = carry
+                    return single(xp, c)
+
+                z = jnp.zeros((), jdt)
+                c, inertia, shift = jax.lax.fori_loop(
+                    0, iters, body, (centroids, z, z))
+                return c, inertia, shift
+
+            fn = jax.jit(_run)
         _STEP_CACHE[key] = fn
     return fn
 
@@ -131,16 +226,16 @@ class KMeans(_KCluster):
         centroids = self._cluster_centers._logical().astype(jdt)
         step = _lloyd_step_fn(xp.shape, jdt, self.n_clusters, x.shape[0], x.comm)
 
-        labels = None
-        inertia = None
         it = 0
         for it in range(1, self.max_iter + 1):
-            centroids, labels, inertia, shift = step(xp, centroids)
+            centroids, _, shift = step(xp, centroids)
             if float(shift) <= self.tol * self.tol:
                 break
 
         self._cluster_centers = DNDarray.from_logical(centroids, None, x.device, x.comm)
         n = x.shape[0]
+        labels, inertia = _assign_fn(
+            xp.shape, jdt, self.n_clusters, n, x.comm)(xp, centroids)
         self._labels = DNDarray(
             labels, (n,), types.canonical_heat_type(labels.dtype), 0 if x.split == 0 else None,
             x.device, x.comm,

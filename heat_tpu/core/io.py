@@ -195,20 +195,51 @@ def load_csv(
     comm=None,
 ) -> DNDarray:
     """Load a CSV file (reference ``load_csv``, ``io.py:710``; the reference's
-    byte-offset chunked parse becomes a host read + sharded placement)."""
+    byte-offset chunked parse becomes a host read + sharded placement).
+
+    The parse runs through the native multithreaded C++ parser
+    (``heat_tpu/native/fastcsv.cpp``) when a compiler is available — the
+    reference's Python byte-range convention at native speed — and falls
+    back to ``numpy.genfromtxt`` otherwise (identical NaN semantics)."""
     comm = sanitize_comm(comm)
     device = devices.sanitize_device(device)
     dtype = types.canonical_heat_type(dtype)
-    data = np.genfromtxt(
-        path, delimiter=sep, skip_header=header_lines, encoding=encoding
-    )
-    if data.ndim == 1:
-        # disambiguate a single data row (→ (1, c)) from a single column
-        # (→ (r,)) by counting data lines
-        with open(path, encoding=encoding) as handle:
-            n_lines = sum(1 for line in handle if line.strip()) - header_lines
-        if n_lines == 1 and data.size > 1:
-            data = data.reshape(1, -1)
+    data = None
+    from .. import native
+
+    # the C++ parser reads raw bytes — only valid for ASCII-superset
+    # encodings (a UTF-16 file would NaN out silently, not fall back)
+    ascii_superset = encoding.lower().replace("-", "").replace("_", "") in (
+        "utf8", "ascii", "latin1", "iso88591")
+    if ascii_superset and native.available():
+        try:
+            start = 0
+            if header_lines:
+                with open(path, "rb") as handle:
+                    for _ in range(header_lines):
+                        handle.readline()
+                    start = handle.tell()
+            data = native.parse_csv_chunk(path, start=start, sep=sep)
+            if data.shape == (1, 1):
+                data = data.reshape(())  # single cell: 0-d (genfromtxt parity)
+            elif data.shape[0] == 1 and data.shape[1] > 1:
+                pass  # single data row stays (1, c)
+            elif data.shape[1] == 1:
+                data = data[:, 0]  # single column flattens (genfromtxt parity)
+        except (OSError, RuntimeError):
+            data = None
+        # ValueError (ragged) propagates: genfromtxt would raise too
+    if data is None:
+        data = np.genfromtxt(
+            path, delimiter=sep, skip_header=header_lines, encoding=encoding
+        )
+        if data.ndim == 1:
+            # disambiguate a single data row (→ (1, c)) from a single column
+            # (→ (r,)) by counting data lines
+            with open(path, encoding=encoding) as handle:
+                n_lines = sum(1 for line in handle if line.strip()) - header_lines
+            if n_lines == 1 and data.size > 1:
+                data = data.reshape(1, -1)
     return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
 
 

@@ -41,6 +41,7 @@ __all__ = [
     "set_pallas",
     "cdist_tile",
     "flash_attention",
+    "kmeans_step_tile",
 ]
 
 _NEG_BIG = -1e30  # finite stand-in for -inf so exp() of masked rows is safe
@@ -385,3 +386,120 @@ def flash_attention(
     if return_lse:
         return out, lse
     return out
+
+
+# --------------------------------------------------------------------------- #
+# fused KMeans Lloyd tile                                                     #
+# --------------------------------------------------------------------------- #
+
+
+def _kmeans_kernel(x_ref, c_ref, mask_ref, sums_ref, counts_ref, stats_ref,
+                   acc_sums, acc_counts, acc_inertia, *, block_rows: int,
+                   acc_dtype):
+    """One X row-block of the fused Lloyd step.
+
+    The assignment GEMM, argmin, one-hot update GEMM and the inertia terms
+    all consume the SAME VMEM-resident ``(block_rows, d)`` X tile, so each
+    Lloyd iteration streams X from HBM exactly once (the jnp path reads it
+    three times: the x^2 pass and both GEMMs). Scratch accumulators persist
+    across the sequential 1-D grid; outputs are written on the last step.
+    """
+    step = pl.program_id(0)
+    nsteps = pl.num_programs(0)
+
+    @pl.when(step == 0)
+    def _init():
+        acc_sums[...] = jnp.zeros_like(acc_sums)
+        acc_counts[...] = jnp.zeros_like(acc_counts)
+        acc_inertia[...] = jnp.zeros_like(acc_inertia)
+
+    x = x_ref[...].astype(acc_dtype)              # (bm, d)
+    c = c_ref[...].astype(acc_dtype)              # (kp, d), pad rows = +big
+    valid = mask_ref[...].astype(acc_dtype)       # (bm, 1)
+
+    c2 = jnp.sum(c * c, axis=1)[None, :]          # (1, kp)
+    xc = jax.lax.dot_general(
+        x, c, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=acc_dtype,
+        precision=jax.lax.Precision.HIGHEST,
+    )                                             # (bm, kp)
+    scores = c2 - 2.0 * xc                        # d^2 minus the x^2 term
+    # explicit int32 index dtype: under jax_enable_x64 jnp.argmin asks for
+    # int64 indices, which Mosaic's reduce-index lowering rejects
+    labels = jax.lax.argmin(scores, 1, jnp.int32)  # (bm,)
+    kp = scores.shape[1]
+    onehot = (labels[:, None] == jax.lax.broadcasted_iota(
+        jnp.int32, (block_rows, kp), 1)).astype(acc_dtype) * valid
+
+    acc_sums[...] += jax.lax.dot_general(
+        onehot, x, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=acc_dtype,
+        precision=jax.lax.Precision.HIGHEST,
+    )                                             # (kp, d)
+    acc_counts[...] += jnp.sum(onehot, axis=0, keepdims=True)  # (1, kp)
+    # inertia: min d^2 = min(scores) + x^2, both from the resident tile
+    x2 = jnp.sum(x * x, axis=1)                   # (bm,)
+    min_s = jnp.min(scores, axis=1)               # (bm,)
+    acc_inertia[0, 0] += jnp.sum((min_s + x2) * valid[:, 0])
+
+    @pl.when(step == nsteps - 1)
+    def _flush():
+        sums_ref[...] = acc_sums[...].astype(sums_ref.dtype)
+        counts_ref[...] = jnp.broadcast_to(
+            acc_counts[...], counts_ref.shape).astype(counts_ref.dtype)
+        stats_ref[...] = jnp.broadcast_to(
+            acc_inertia[0, 0], stats_ref.shape).astype(stats_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows",))
+def kmeans_step_tile(x, centroids, valid_mask, block_rows: int = 1024):
+    """Fused Lloyd iteration over a local X shard: ONE HBM pass.
+
+    ``x``: ``(N_pad, d)``; ``centroids``: ``(k, d)``; ``valid_mask``:
+    ``(N_pad, 1)`` 1.0 for real rows (the canonical-padding mask, constant
+    across iterations). Returns ``(sums (k, d), counts (k,), inertia)`` —
+    the per-shard partials the caller psums over the mesh. Labels are not
+    produced here; the fit computes them once after convergence (a single
+    extra assignment pass) instead of writing N int32s every iteration.
+    """
+    n, d = x.shape
+    k = centroids.shape[0]
+    acc_dtype = jnp.float64 if jnp.promote_types(x.dtype, jnp.float32) == jnp.float64 else jnp.float32
+    kp = _round_up(k, 128)
+    bm = min(_round_up(block_rows, 8), _round_up(n, 8))
+    npad = _round_up(n, bm)
+    xp = _pad_axis(x, 0, npad)
+    maskp = _pad_axis(valid_mask.astype(x.dtype), 0, npad)
+    # pad centroid rows with a huge coordinate: their c^2 term dominates so
+    # argmin never selects a padding cluster
+    cp = jnp.full((kp, d), 1e15, x.dtype).at[:k].set(centroids)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    sums, counts, stats = pl.pallas_call(
+        functools.partial(_kmeans_kernel, block_rows=bm, acc_dtype=acc_dtype),
+        grid=(npad // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i: (_i32(i), _i32(0))),
+            pl.BlockSpec((kp, d), lambda i: (_i32(0), _i32(0))),
+            pl.BlockSpec((bm, 1), lambda i: (_i32(i), _i32(0))),
+        ],
+        out_specs=[
+            pl.BlockSpec((kp, d), lambda i: (_i32(0), _i32(0))),
+            pl.BlockSpec((8, kp), lambda i: (_i32(0), _i32(0))),
+            pl.BlockSpec((8, 128), lambda i: (_i32(0), _i32(0))),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((kp, d), acc_dtype, vma=_vma(x, centroids)),
+            jax.ShapeDtypeStruct((8, kp), acc_dtype, vma=_vma(x, centroids)),
+            jax.ShapeDtypeStruct((8, 128), acc_dtype, vma=_vma(x, centroids)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((kp, d), acc_dtype),
+            pltpu.VMEM((1, kp), acc_dtype),
+            pltpu.VMEM((1, 1), acc_dtype),
+        ],
+        interpret=_interpret(),
+    )(xp, cp, maskp)
+    return (sums[:k].astype(x.dtype), counts[0, :k].astype(x.dtype),
+            stats[0, 0].astype(x.dtype))

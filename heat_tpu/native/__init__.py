@@ -1,0 +1,157 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference keeps its entire runtime in Python and leans on external
+native libraries (SURVEY.md §2: ATen kernels + MPI). heat_tpu's compute
+path is XLA/Pallas; this package holds the native pieces of the runtime
+AROUND that path — currently the chunked CSV parser behind
+:func:`heat_tpu.core.io.load_csv` (the reference's Python byte-offset
+parse, ``heat/core/io.py:710``, as a multithreaded C++ pass).
+
+The shared library is compiled on first use with the system ``g++``
+(``-O3 -shared -fPIC -pthread``) and cached next to the sources, falling
+back to ``~/.cache/heat_tpu`` when the package directory is read-only.
+Everything degrades gracefully: :func:`available` returns False when no
+compiler (or a failed build) and callers keep their pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["available", "parse_csv_chunk", "scan_csv_chunk"]
+
+_SRC = os.path.join(os.path.dirname(__file__), "fastcsv.cpp")
+_LIB_NAME = "libheat_tpu_native.so"
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _candidate_dirs():
+    yield os.path.dirname(__file__)
+    yield os.path.join(
+        os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+        "heat_tpu")
+
+
+def _build(libdir: str) -> Optional[str]:
+    os.makedirs(libdir, exist_ok=True)
+    target = os.path.join(libdir, _LIB_NAME)
+    if os.path.exists(target) and os.path.getmtime(target) >= os.path.getmtime(_SRC):
+        return target
+    # build to a temp name then rename: concurrent processes race benignly
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=libdir)
+    os.close(fd)
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, target)
+        return target
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("HEAT_TPU_NATIVE") in ("0", "false", "False"):
+        return None
+    for libdir in _candidate_dirs():
+        try:
+            path = _build(libdir)
+        except OSError:
+            path = None
+        if path:
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                continue
+            lib.fastcsv_scan.restype = ctypes.c_int
+            lib.fastcsv_scan.argtypes = [
+                ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_char,
+                ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long)]
+            lib.fastcsv_parse.restype = ctypes.c_long
+            lib.fastcsv_parse.argtypes = [
+                ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_char,
+                ctypes.POINTER(ctypes.c_double), ctypes.c_long, ctypes.c_long,
+                ctypes.c_int]
+            lib.fastcsv_parse_alloc.restype = ctypes.c_int
+            lib.fastcsv_parse_alloc.argtypes = [
+                ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_char,
+                ctypes.c_int, ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_double))]
+            lib.fastcsv_free.restype = None
+            lib.fastcsv_free.argtypes = [ctypes.POINTER(ctypes.c_double)]
+            _lib = lib
+            return _lib
+    return None
+
+
+def available() -> bool:
+    """True when the native library is importable (compiling it on demand)."""
+    return _load() is not None
+
+
+def scan_csv_chunk(path: str, start: int = 0, end: int = -1,
+                   sep: str = ",") -> Tuple[int, int]:
+    """(rows, cols) of the data lines whose first byte is in [start, end)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native CSV parser unavailable")
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    rc = lib.fastcsv_scan(path.encode(), start, end, sep.encode()[0:1],
+                          ctypes.byref(rows), ctypes.byref(cols))
+    if rc == -3:
+        raise ValueError(f"ragged CSV (inconsistent column counts): {path!r}")
+    if rc != 0:
+        raise OSError(f"fastcsv_scan failed for {path!r}")
+    return rows.value, cols.value
+
+
+def parse_csv_chunk(path: str, start: int = 0, end: int = -1, sep: str = ",",
+                    threads: Optional[int] = None) -> np.ndarray:
+    """Parse a byte range of a numeric CSV into a float64 (rows, cols) array.
+
+    Same chunk convention as the reference's parallel CSV load: a line
+    belongs to the byte range its first character falls in, so adjacent
+    ranges partition the file exactly. Unparseable fields become NaN;
+    ragged files raise ValueError (genfromtxt parity). Single-read: the
+    file is read and scanned once in C++ (``fastcsv_parse_alloc``).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native CSV parser unavailable")
+    if threads is None:
+        threads = min(os.cpu_count() or 1, 16)
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    data = ctypes.POINTER(ctypes.c_double)()
+    rc = lib.fastcsv_parse_alloc(
+        path.encode(), start, end, sep.encode()[0:1], threads,
+        ctypes.byref(rows), ctypes.byref(cols), ctypes.byref(data))
+    if rc == -3:
+        raise ValueError(f"ragged CSV (inconsistent column counts): {path!r}")
+    if rc != 0:
+        raise OSError(f"fastcsv_parse_alloc failed ({rc}) for {path!r}")
+    if rows.value == 0:
+        return np.empty((0, max(cols.value, 0)), np.float64)
+    try:
+        out = np.ctypeslib.as_array(
+            data, shape=(rows.value, cols.value)).copy()
+    finally:
+        lib.fastcsv_free(data)
+    return out

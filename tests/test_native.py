@@ -1,0 +1,126 @@
+"""Native C++ runtime components (`heat_tpu/native`): the multithreaded
+chunked CSV parser behind `ht.load_csv`, verified against numpy.genfromtxt
+semantics (same NaN behavior, same byte-range chunk convention as the
+reference's parallel CSV load).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain for the native library")
+
+DATA = os.path.join(os.path.dirname(ht.__file__), "datasets")
+
+
+class TestFastCSV:
+    def test_matches_genfromtxt(self, tmp_path):
+        rng = np.random.default_rng(0)
+        arr = rng.normal(size=(500, 7))
+        p = tmp_path / "data.csv"
+        np.savetxt(p, arr, delimiter=",", fmt="%.10g")
+        got = native.parse_csv_chunk(str(p))
+        want = np.genfromtxt(p, delimiter=",")
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+
+    def test_iris_semicolon(self):
+        p = os.path.join(DATA, "iris.csv")
+        got = native.parse_csv_chunk(p, sep=";")
+        want = np.genfromtxt(p, delimiter=";")
+        np.testing.assert_allclose(got, want, rtol=1e-12)
+
+    def test_byte_ranges_partition_file(self, tmp_path):
+        rng = np.random.default_rng(1)
+        arr = rng.normal(size=(1000, 3))
+        p = tmp_path / "data.csv"
+        np.savetxt(p, arr, delimiter=",", fmt="%.10g")
+        size = os.path.getsize(p)
+        # any cut points: a line belongs to the range its first byte is in
+        cuts = [0, size // 3 + 7, 2 * size // 3 - 11, size]
+        parts = [
+            native.parse_csv_chunk(str(p), cuts[i], cuts[i + 1])
+            for i in range(3)
+        ]
+        np.testing.assert_allclose(
+            np.vstack([q for q in parts if q.size]), arr, rtol=1e-9)
+
+    def test_nan_and_blank_line_semantics(self, tmp_path):
+        p = tmp_path / "messy.csv"
+        p.write_text("h1,h2,h3\n1,2,3\n4,,x\n\n7,8,9\n")
+        hdr = len("h1,h2,h3\n")
+        got = native.parse_csv_chunk(str(p), hdr)
+        want = np.genfromtxt(p, delimiter=",", skip_header=1)
+        np.testing.assert_allclose(got, want, equal_nan=True)
+
+    def test_scan_counts(self, tmp_path):
+        p = tmp_path / "s.csv"
+        p.write_text("1,2\n3,4\n5,6\n")
+        assert native.scan_csv_chunk(str(p)) == (3, 2)
+
+    def test_load_csv_uses_native(self, tmp_path):
+        rng = np.random.default_rng(2)
+        arr = rng.normal(size=(64, 5)).astype(np.float32)
+        p = tmp_path / "x.csv"
+        np.savetxt(p, arr, delimiter=",", fmt="%.8g")
+        for split in (None, 0, 1):
+            x = ht.load_csv(str(p), split=split)
+            np.testing.assert_allclose(x.numpy(), arr, rtol=1e-5)
+
+    def test_load_csv_header_lines(self, tmp_path):
+        p = tmp_path / "h.csv"
+        p.write_text("# a header\n# another\n1.5,2.5\n3.5,4.5\n")
+        x = ht.load_csv(str(p), header_lines=2)
+        np.testing.assert_allclose(x.numpy(), [[1.5, 2.5], [3.5, 4.5]])
+
+    def test_tab_separated_empty_field(self, tmp_path):
+        """Empty field with a whitespace separator must NaN, not steal the
+        next field's digits (strtod skips leading whitespace); file without
+        a trailing newline must not overread."""
+        p = tmp_path / "tab.csv"
+        p.write_text("1\t\t3\n4\t5\t6")  # note: no trailing newline
+        got = native.parse_csv_chunk(str(p), sep="\t")
+        assert np.isnan(got[0, 1]) and got[0, 2] == 3 and got[1, 2] == 6
+
+    def test_ragged_raises_like_genfromtxt(self, tmp_path):
+        p = tmp_path / "rag.csv"
+        p.write_text("1,2\n3,4,5\n")
+        with pytest.raises(ValueError, match="ragged"):
+            native.parse_csv_chunk(str(p))
+        with pytest.raises(ValueError):
+            np.genfromtxt(p, delimiter=",")  # same outcome either path
+
+    def test_whitespace_only_field(self, tmp_path):
+        p = tmp_path / "ws.csv"
+        p.write_text("1, \n7,8\n")
+        got = native.parse_csv_chunk(str(p))
+        want = np.genfromtxt(p, delimiter=",")
+        np.testing.assert_allclose(got, want, equal_nan=True)
+
+    def test_load_csv_non_ascii_encoding_falls_back(self, tmp_path):
+        p = tmp_path / "u16.csv"
+        p.write_bytes("1.5,2.5\n3.5,4.5\n".encode("utf-16"))
+        x = ht.load_csv(str(p), encoding="utf-16")
+        np.testing.assert_allclose(x.numpy(), [[1.5, 2.5], [3.5, 4.5]])
+
+
+class TestKMeansConsistency:
+    def test_labels_centers_inertia_consistent(self):
+        """inertia_ must equal the sum of squared distances of points to
+        cluster_centers_[labels_] (one final assignment computes both)."""
+        from heat_tpu.cluster import KMeans
+
+        ht.random.seed(9)
+        x = ht.random.rand(301, 8, split=0)
+        km = KMeans(n_clusters=5, max_iter=3, random_state=1).fit(x)  # stops early
+        xn = x.numpy()
+        c = km.cluster_centers_.numpy()
+        lab = km.labels_.numpy()
+        want = ((xn - c[lab]) ** 2).sum()
+        np.testing.assert_allclose(km.inertia_, want, rtol=1e-4)
+        d2 = ((xn[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_array_equal(lab, d2.argmin(1))

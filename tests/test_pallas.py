@@ -224,3 +224,42 @@ class TestFlashBackward:
 
         g = jax.jit(jax.grad(loss))(q)
         assert np.isfinite(np.asarray(g)).all()
+
+
+class TestKMeansStepTile:
+    def test_matches_reference(self):
+        rng = np.random.default_rng(11)
+        n, d, k, nv = 2048 + 77, 48, 8, 2048 + 13  # uneven rows + padding
+        x = rng.standard_normal((n, d)).astype(np.float32)
+        c = rng.standard_normal((k, d)).astype(np.float32)
+        mask = (np.arange(n) < nv).astype(np.float32)[:, None]
+
+        sums, counts, inertia = pk.kmeans_step_tile(
+            jnp.asarray(x), jnp.asarray(c), jnp.asarray(mask))
+
+        d2 = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
+        lab = d2.argmin(1)
+        oh = (lab[:, None] == np.arange(k)) * mask
+        np.testing.assert_allclose(np.asarray(sums), oh.T @ x, rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(np.asarray(counts), oh.sum(0), rtol=0, atol=0)
+        np.testing.assert_allclose(
+            float(inertia), (d2.min(1) * mask[:, 0]).sum(), rtol=1e-5)
+
+    def test_kmeans_pallas_path_matches_xla(self, force_pallas):
+        """Full KMeans fit through the fused kernel (interpret mode on the
+        CPU mesh) against the XLA step path."""
+        import heat_tpu as ht
+        from heat_tpu.cluster import KMeans
+
+        ht.random.seed(5)
+        x = ht.random.rand(503, 16, split=0)  # uneven over the mesh
+        km_p = KMeans(n_clusters=4, max_iter=12, random_state=3).fit(x)
+
+        pk.set_pallas(False)
+        km_x = KMeans(n_clusters=4, max_iter=12, random_state=3).fit(x)
+
+        np.testing.assert_allclose(
+            km_p.cluster_centers_.numpy(), km_x.cluster_centers_.numpy(),
+            rtol=1e-4, atol=1e-4)
+        np.testing.assert_array_equal(km_p.labels_.numpy(), km_x.labels_.numpy())
+        np.testing.assert_allclose(km_p.inertia_, km_x.inertia_, rtol=1e-4)
