@@ -6,8 +6,10 @@ from . import vision_transforms
 from . import checkpointing
 from . import profiling
 from .checkpointing import (
+    CheckpointManager,
     checkpoint_estimator,
     load_checkpoint,
     restore_estimator,
+    run_with_recovery,
     save_checkpoint,
 )

@@ -23,7 +23,14 @@ import jax.numpy as jnp
 from ..core import factories, types
 from ..core.dndarray import DNDarray
 
-__all__ = ["save_checkpoint", "load_checkpoint", "checkpoint_estimator", "restore_estimator"]
+__all__ = [
+    "save_checkpoint",
+    "load_checkpoint",
+    "checkpoint_estimator",
+    "restore_estimator",
+    "CheckpointManager",
+    "run_with_recovery",
+]
 
 _MANIFEST = "manifest.json"
 
@@ -228,3 +235,111 @@ def restore_estimator(path: str, estimator):
             else:
                 setattr(estimator, name, value)
     return estimator
+
+
+class CheckpointManager:
+    """Rotating training-loop checkpoints with resume discovery.
+
+    The reference has no failure-detection/elastic-recovery story at all —
+    a rank failure kills the MPI job and training restarts from scratch
+    (SURVEY.md §5). This manager provides the TPU-native equivalent of a
+    restartable loop: periodic atomic checkpoints (``save`` respects
+    ``every_steps``), keep-last-``keep`` rotation, and ``restore`` of the
+    newest complete checkpoint after a crash or preemption.
+
+    >>> mgr = CheckpointManager("/tmp/run", every_steps=100, keep=3)
+    >>> start, state = mgr.restore() or (0, init_state())
+    >>> for step in range(start, total):
+    ...     state = train_step(state)
+    ...     mgr.save(step + 1, state)
+    """
+
+    def __init__(self, directory: str, every_steps: int = 1, keep: int = 3):
+        if every_steps < 1 or keep < 1:
+            raise ValueError("every_steps and keep must be >= 1")
+        self.directory = directory
+        self.every_steps = every_steps
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"ckpt_{step:012d}")
+
+    def all_steps(self):
+        """Steps with a complete (manifest present) checkpoint, ascending."""
+        steps = []
+        for name in os.listdir(self.directory):
+            if name.startswith("ckpt_") and os.path.exists(
+                    os.path.join(self.directory, name, _MANIFEST)):
+                try:
+                    steps.append(int(name[5:]))
+                except ValueError:
+                    continue
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, state: Dict[str, Any], force: bool = False) -> bool:
+        """Checkpoint ``state`` at ``step`` if due (or ``force``); rotates
+        old checkpoints. Returns True when a checkpoint was written."""
+        if not force and step % self.every_steps != 0:
+            return False
+        save_checkpoint(self._path(step), state, step=step)
+        for old in self.all_steps()[:-self.keep]:
+            _rmtree(self._path(old))
+        return True
+
+    def restore(self):
+        """(step, state) of the newest complete checkpoint, or None.
+
+        Checkpoints that fail to load (e.g. truncated by a crash mid-write,
+        which atomic manifests make unlikely) are skipped with a warning,
+        falling back to the next-newest — the elastic-recovery path. The
+        returned state is exactly what was saved (the manifest's step is
+        reported separately, not injected into the dict).
+        """
+        import warnings
+
+        for step in reversed(self.all_steps()):
+            try:
+                state = load_checkpoint(self._path(step))
+            except Exception as exc:
+                warnings.warn(
+                    f"skipping unreadable checkpoint step {step} at "
+                    f"{self._path(step)}: {exc!r}")
+                continue
+            state.pop("__step__", None)
+            return step, state
+        return None
+
+
+def _rmtree(path: str) -> None:
+    import shutil
+
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def run_with_recovery(train_fn, manager: CheckpointManager, init_state,
+                      max_failures: int = 3):
+    """Run a restartable training loop with crash recovery.
+
+    ``train_fn(state, start_step, save) -> state`` runs the loop body; it
+    must call ``save(step, state)`` as it goes (the manager's cadence
+    applies) and may raise at any point. On an exception the loop restarts
+    from the newest checkpoint, up to ``max_failures`` times — the
+    single-controller analogue of elastic training (the reference's MPI
+    SPMD model cannot do this at all; SURVEY.md §5 "failure detection:
+    none").
+    """
+    failures = 0
+    while True:
+        restored = manager.restore()
+        start, state = restored if restored else (0, init_state)
+        try:
+            return train_fn(state, start, manager.save)
+        except Exception:
+            failures += 1
+            if failures > max_failures:
+                raise

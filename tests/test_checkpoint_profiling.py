@@ -1,8 +1,12 @@
 """Checkpoint/resume and profiling subsystem tests (these subsystems exceed
 the reference, which has neither — SURVEY.md §5)."""
 
+import os
+
 import numpy as np
 import pytest
+
+import jax.numpy as jnp
 
 import heat_tpu as ht
 
@@ -113,3 +117,65 @@ class TestPytreeStructureRoundTrip:
         assert isinstance(st["misc"]["l"], list)
         assert isinstance(st["misc"]["t"], tuple)
         assert isinstance(st["misc"]["d"], ht.DNDarray) and st["misc"]["d"].split == 0
+
+
+class TestCheckpointManager:
+    def test_rotation_and_restore(self, tmp_path):
+        from heat_tpu.utils.checkpointing import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "run"), every_steps=2, keep=2)
+        for step in range(1, 8):
+            wrote = mgr.save(step, {"w": jnp.full((3,), float(step)), "step": step})
+            assert wrote == (step % 2 == 0)
+        assert mgr.all_steps() == [4, 6]  # keep=2 rotation
+        step, state = mgr.restore()
+        assert step == 6 and state["step"] == 6
+        np.testing.assert_allclose(np.asarray(state["w"]), 6.0)
+
+    def test_restore_skips_corrupt_newest(self, tmp_path):
+        from heat_tpu.utils.checkpointing import CheckpointManager, _MANIFEST
+
+        mgr = CheckpointManager(str(tmp_path / "run"), keep=3)
+        mgr.save(1, {"v": 1}, force=True)
+        mgr.save(2, {"v": 2}, force=True)
+        # corrupt the newest manifest (as a crash mid-write would)
+        manifest = os.path.join(mgr._path(2), _MANIFEST)
+        with open(manifest, "w") as f:
+            f.write("{ not json")
+        step, state = mgr.restore()
+        assert step == 1 and state["v"] == 1
+
+    def test_run_with_recovery(self, tmp_path):
+        from heat_tpu.utils.checkpointing import CheckpointManager, run_with_recovery
+
+        mgr = CheckpointManager(str(tmp_path / "run"), every_steps=1, keep=2)
+        crashes = {"left": 2}
+
+        def train(state, start, save):
+            assert "__step__" not in state  # restore() returns the saved dict
+            w = state["w"]
+            for step in range(start, 10):
+                w = w + 1.0
+                save(step + 1, {"w": w})
+                # crash on the first save of each attempt while budget lasts
+                # (a fixed step would never recur after resuming past it)
+                if step == start and crashes["left"] > 0:
+                    crashes["left"] -= 1
+                    raise RuntimeError("simulated preemption")
+            return {"w": w}
+
+        out = run_with_recovery(train, mgr, {"w": jnp.zeros(())})
+        # every step contributes exactly once despite two crashes
+        assert crashes["left"] == 0
+        assert float(out["w"]) == 10.0
+
+    def test_run_with_recovery_gives_up(self, tmp_path):
+        from heat_tpu.utils.checkpointing import CheckpointManager, run_with_recovery
+
+        mgr = CheckpointManager(str(tmp_path / "run2"), every_steps=1, keep=1)
+
+        def always_fails(state, start, save):
+            raise RuntimeError("hard failure")
+
+        with pytest.raises(RuntimeError, match="hard failure"):
+            run_with_recovery(always_fails, mgr, {"w": 0}, max_failures=2)
