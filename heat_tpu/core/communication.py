@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = [
     "Communication",
+    "Request",
     "TPUCommunication",
     "MeshAxisComm",
     "MeshGrid",
@@ -47,6 +48,33 @@ __all__ = [
     "use_comm",
     "sanitize_comm",
 ]
+
+
+class Request:
+    """Completed-request handle returned by the ``I*`` collective aliases
+    (reference ``MPIRequest``, ``communication.py:29-85``).
+
+    Under XLA every collective is a traced op whose overlap with compute is
+    scheduled by the compiler, so the request is complete by construction;
+    ``Wait``/``Test`` exist for drop-in parity with reference call sites.
+    """
+
+    __slots__ = ("_value",)
+
+    def __init__(self, value):
+        self._value = value
+
+    def Wait(self):
+        return self._value
+
+    wait = Wait
+
+    def Test(self) -> bool:
+        return True
+
+    @property
+    def value(self):
+        return self._value
 
 
 class Communication:
@@ -268,6 +296,61 @@ class TPUCommunication(Communication):
 
         g = jax.lax.all_gather(x, self.axis_name)
         return g[root]
+
+    def scan(self, x):
+        """Inclusive prefix sum over devices (reference ``Scan``, ``:845``)."""
+        return self.exscan(x) + x
+
+    # ------------------------------------------------------------------ #
+    # reference-named aliases (migration surface)                        #
+    # ------------------------------------------------------------------ #
+    # The reference exposes MPI names in blocking + nonblocking pairs
+    # (``communication.py:458-1872``). The blocking names map 1:1 onto the
+    # collectives above; the I-variants return an immediately-complete
+    # :class:`Request` — under XLA the *compiler* owns comm/compute overlap
+    # (the very thing the reference builds wait-handle machinery for), so
+    # "nonblocking" is the default execution model, not an API mode.
+
+    def Allreduce(self, x):
+        return self.psum(x)
+
+    def Allgather(self, x, axis: int = 0):
+        return self.all_gather(x, axis)
+
+    Allgatherv = Allgather
+
+    def Alltoall(self, x, split_axis: int = 0, concat_axis: int = 0):
+        return self.all_to_all(x, split_axis, concat_axis)
+
+    Alltoallv = Alltoall
+    Alltoallw = Alltoall
+
+    def Bcast(self, x, root: int = 0):
+        return self.broadcast_from(x, root)
+
+    def Exscan(self, x):
+        return self.exscan(x)
+
+    def Scan(self, x):
+        return self.scan(x)
+
+    def Iallreduce(self, x):
+        return Request(self.psum(x))
+
+    def Iallgather(self, x, axis: int = 0):
+        return Request(self.all_gather(x, axis))
+
+    def Ialltoall(self, x, split_axis: int = 0, concat_axis: int = 0):
+        return Request(self.all_to_all(x, split_axis, concat_axis))
+
+    def Ibcast(self, x, root: int = 0):
+        return Request(self.broadcast_from(x, root))
+
+    def Iexscan(self, x):
+        return Request(self.exscan(x))
+
+    def Iscan(self, x):
+        return Request(self.scan(x))
 
     # ------------------------------------------------------------------ #
     # sub-communicators                                                  #

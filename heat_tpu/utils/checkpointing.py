@@ -289,6 +289,14 @@ class CheckpointManager:
         save_checkpoint(self._path(step), state, step=step)
         for old in self.all_steps()[:-self.keep]:
             _rmtree(self._path(old))
+        # also sweep orphans: ckpt dirs without a manifest are dead partial
+        # writes from a crash mid-save; they would otherwise accumulate
+        # (all_steps() never lists them, so rotation alone misses them)
+        for name in os.listdir(self.directory):
+            full = os.path.join(self.directory, name)
+            if (name.startswith("ckpt_") and full != self._path(step)
+                    and not os.path.exists(os.path.join(full, _MANIFEST))):
+                _rmtree(full)
         return True
 
     def restore(self):
@@ -333,10 +341,14 @@ def run_with_recovery(train_fn, manager: CheckpointManager, init_state,
     SPMD model cannot do this at all; SURVEY.md §5 "failure detection:
     none").
     """
+    import copy
+
     failures = 0
     while True:
         restored = manager.restore()
-        start, state = restored if restored else (0, init_state)
+        # fresh copy per attempt: a crashed train_fn that mutated the
+        # initial state in place must not leak into the retry
+        start, state = restored if restored else (0, copy.deepcopy(init_state))
         try:
             return train_fn(state, start, manager.save)
         except Exception:

@@ -179,3 +179,33 @@ class TestCheckpointManager:
 
         with pytest.raises(RuntimeError, match="hard failure"):
             run_with_recovery(always_fails, mgr, {"w": 0}, max_failures=2)
+
+    def test_orphan_partial_checkpoints_swept(self, tmp_path):
+        from heat_tpu.utils.checkpointing import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "run3"), every_steps=1, keep=2)
+        mgr.save(1, {"v": 1})
+        # simulate a crash mid-save: dir exists, no manifest
+        orphan = os.path.join(mgr.directory, "ckpt_000000000099")
+        os.makedirs(orphan)
+        with open(os.path.join(orphan, "arrays.npz"), "wb") as f:
+            f.write(b"partial")
+        mgr.save(2, {"v": 2})
+        assert not os.path.exists(orphan)
+        assert mgr.all_steps() == [1, 2]
+
+    def test_retry_gets_pristine_init_state(self, tmp_path):
+        from heat_tpu.utils.checkpointing import CheckpointManager, run_with_recovery
+
+        mgr = CheckpointManager(str(tmp_path / "run4"), every_steps=100, keep=1)
+        attempts = {"n": 0}
+
+        def train(state, start, save):
+            attempts["n"] += 1
+            state["epoch"] += 1  # in-place mutation before any save lands
+            if attempts["n"] == 1:
+                raise RuntimeError("crash before first checkpoint")
+            return state
+
+        out = run_with_recovery(train, mgr, {"epoch": 0})
+        assert out["epoch"] == 1  # not 2: retry saw a fresh copy

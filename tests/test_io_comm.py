@@ -156,3 +156,75 @@ class TestPrinting:
     def test_print0(self, capsys):
         ht.print0("hello")
         assert "hello" in capsys.readouterr().out
+
+
+class TestReferenceNamedAliases:
+    """The MPI-named migration surface (reference ``communication.py:458-1872``):
+    blocking names map onto the collectives, I-variants return a complete
+    Request (XLA owns overlap)."""
+
+    def test_blocking_aliases(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+
+        comm = ht.get_comm()
+        n = comm.size
+        x = ht.arange(4 * n, dtype=ht.float32, split=0)
+        spec = comm.spec(1, 0)
+
+        def body(blk):
+            total = comm.Allreduce(jnp.sum(blk))        # 0+..+(4n-1)
+            first = comm.Bcast(blk[:1], root=0)          # rank 0's first elem
+            ex = comm.Exscan(jnp.sum(blk))
+            inc = comm.Scan(jnp.sum(blk))
+            return jnp.stack([total, first[0], ex, inc])  # (4,) per device
+
+        fn = shard_map(body, mesh=comm.mesh, in_specs=spec, out_specs=spec,
+                       check_vma=False)
+        out = np.asarray(jax.jit(fn)(x.larray)).reshape(n, 4)
+        shard_sums = np.arange(4 * n, dtype=np.float64).reshape(n, 4).sum(1)
+        np.testing.assert_allclose(out[:, 0], 4 * n * (4 * n - 1) / 2)  # Allreduce
+        np.testing.assert_allclose(out[:, 1], 0.0)                      # Bcast root 0
+        np.testing.assert_allclose(                                     # Exscan
+            out[:, 2], np.concatenate([[0.0], np.cumsum(shard_sums)[:-1]]))
+        np.testing.assert_allclose(out[:, 3], np.cumsum(shard_sums))    # Scan
+
+    def test_nonblocking_aliases_complete_requests(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+
+        comm = ht.get_comm()
+        x = ht.arange(2 * comm.size, dtype=ht.float32, split=0)
+        spec = comm.spec(1, 0)
+
+        def body(blk):
+            req = comm.Iallreduce(jnp.sum(blk))
+            assert req.Test()
+            return jnp.broadcast_to(req.Wait(), blk.shape)
+
+        fn = shard_map(body, mesh=comm.mesh, in_specs=spec, out_specs=spec,
+                       check_vma=False)
+        out = np.asarray(jax.jit(fn)(x.larray))
+        n = 2 * comm.size
+        np.testing.assert_allclose(out, n * (n - 1) / 2)
+
+    def test_alltoall_alias(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+
+        comm = ht.get_comm()
+        n = comm.size
+        x = ht.arange(n * n, dtype=ht.float32, split=0)  # n rows per device? n total
+        spec = comm.spec(1, 0)
+
+        def body(blk):
+            return comm.Alltoall(blk, split_axis=0, concat_axis=0)
+
+        fn = shard_map(body, mesh=comm.mesh, in_specs=spec, out_specs=spec,
+                       check_vma=False)
+        out = np.asarray(jax.jit(fn)(x.larray))
+        want = np.arange(n * n, dtype=np.float32).reshape(n, n).T.reshape(-1)
+        np.testing.assert_allclose(out, want)
