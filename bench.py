@@ -81,9 +81,48 @@ def torch_kmeans_time_per_iter(n: int, d: int = 64, k: int = 8, iters: int = 3) 
     return (t1 - t0) / iters
 
 
+def _pallas_kmeans_safe() -> bool:
+    """Compile-probe the fused KMeans kernel in a SUBPROCESS with a hard
+    timeout. A Mosaic/compile pathology (or a wedged device) then cannot
+    hang the benchmark itself — the probe fails and the XLA Lloyd path is
+    used instead."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import numpy as np, jax, jax.numpy as jnp\n"
+        "from heat_tpu.core.pallas_kernels import kmeans_step_tile\n"
+        "x = jnp.asarray(np.random.default_rng(0).random((4096, 64), np.float32))\n"
+        "c = jnp.asarray(np.random.default_rng(1).random((8, 64), np.float32))\n"
+        "m = jnp.ones((4096, 1), jnp.float32)\n"
+        "r = kmeans_step_tile(x, c, m)\n"
+        "jax.block_until_ready(r)\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], timeout=240,
+            capture_output=True, cwd=os.path.dirname(os.path.abspath(__file__)))
+        if proc.returncode != 0:
+            sys.stderr.write(
+                "bench: Pallas KMeans probe failed; falling back to the XLA "
+                "Lloyd path. Probe stderr:\n" + proc.stderr.decode(errors="replace"))
+        return proc.returncode == 0
+    except Exception as exc:
+        sys.stderr.write(
+            f"bench: Pallas KMeans probe errored ({exc!r}); falling back to "
+            "the XLA Lloyd path.\n")
+        return False
+
+
 def main() -> None:
     n = 1 << 23  # 8.4M points × 64 features ≈ 2.1 GB float32
     n_torch = 1 << 19  # small torch sample, extrapolated linearly
+
+    import os
+
+    if os.environ.get("HEAT_TPU_PALLAS") is None and not _pallas_kmeans_safe():
+        os.environ["HEAT_TPU_PALLAS"] = "0"  # read before heat_tpu import below
 
     ips = tpu_kmeans_iter_per_s(n)
     t_torch_small = torch_kmeans_time_per_iter(n_torch)

@@ -340,8 +340,13 @@ class TPUCommunication(Communication):
     def Iallgather(self, x, axis: int = 0):
         return Request(self.all_gather(x, axis))
 
+    Iallgatherv = Iallgather
+
     def Ialltoall(self, x, split_axis: int = 0, concat_axis: int = 0):
         return Request(self.all_to_all(x, split_axis, concat_axis))
+
+    Ialltoallv = Ialltoall
+    Ialltoallw = Ialltoall
 
     def Ibcast(self, x, root: int = 0):
         return Request(self.broadcast_from(x, root))

@@ -341,17 +341,32 @@ def run_with_recovery(train_fn, manager: CheckpointManager, init_state,
     SPMD model cannot do this at all; SURVEY.md §5 "failure detection:
     none").
     """
-    import copy
-
     failures = 0
     while True:
         restored = manager.restore()
         # fresh copy per attempt: a crashed train_fn that mutated the
         # initial state in place must not leak into the retry
-        start, state = restored if restored else (0, copy.deepcopy(init_state))
+        start, state = restored if restored else (0, _fresh_state(init_state))
         try:
             return train_fn(state, start, manager.save)
         except Exception:
             failures += 1
             if failures > max_failures:
                 raise
+
+
+def _fresh_state(tree):
+    """Structure-fresh copy of a state pytree: every container is rebuilt
+    (so in-place container mutations cannot leak across retries) while
+    immutable leaves (jax.Array, scalars) are shared. Mutable leaves are
+    copied shallowly: numpy arrays by value, DNDarrays re-wrapped (their
+    backing jax.Array is immutable; comm/mesh are shared — deepcopy would
+    choke on device handles and round-trip arrays through the host)."""
+    def leaf(x):
+        if isinstance(x, np.ndarray):
+            return x.copy()
+        if isinstance(x, DNDarray):
+            return DNDarray(x.larray, x.gshape, x.dtype, x.split, x.device, x.comm)
+        return x
+
+    return jax.tree.map(leaf, tree, is_leaf=lambda x: isinstance(x, DNDarray))

@@ -209,3 +209,26 @@ class TestCheckpointManager:
 
         out = run_with_recovery(train, mgr, {"epoch": 0})
         assert out["epoch"] == 1  # not 2: retry saw a fresh copy
+
+    def test_retry_copy_handles_dndarrays(self, tmp_path):
+        """The per-attempt fresh copy must not deepcopy device handles:
+        DNDarray-bearing init states work and arrays are shared, not
+        round-tripped through the host."""
+        from heat_tpu.utils.checkpointing import CheckpointManager, run_with_recovery
+
+        mgr = CheckpointManager(str(tmp_path / "run5"), every_steps=100, keep=1)
+        init = {"x": ht.arange(16, split=0), "n": np.zeros(2), "lst": []}
+        attempts = {"n": 0}
+
+        def train(state, start, save):
+            attempts["n"] += 1
+            assert isinstance(state["x"], ht.DNDarray) and state["x"].split == 0
+            state["lst"].append(attempts["n"])  # container mutation
+            state["n"][0] = attempts["n"]       # numpy mutation
+            if attempts["n"] == 1:
+                raise RuntimeError("crash")
+            return state
+
+        out = run_with_recovery(train, mgr, init)
+        assert out["lst"] == [2] and out["n"][0] == 2  # no leak from attempt 1
+        assert init["lst"] == [] and init["n"][0] == 0  # init untouched
