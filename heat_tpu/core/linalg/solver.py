@@ -10,7 +10,7 @@ from .. import arithmetics, factories
 from ..dndarray import DNDarray
 from .basics import matmul, dot, transpose
 
-__all__ = ["cg", "lanczos"]
+__all__ = ["cg", "lanczos", "solve", "cholesky", "eigh", "lstsq"]
 
 
 def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
@@ -122,3 +122,63 @@ def lanczos(
             return V_out, T_out
         return V_out, T
     return V, T
+
+
+def solve(A: DNDarray, b: DNDarray) -> DNDarray:
+    """Solve the square dense system ``A x = b`` (beyond the reference,
+    whose solver module stops at cg/lanczos — ``solver.py:13-184``).
+
+    Runs XLA's LU solve on the logical (unpadded) arrays; inputs of any
+    split are accepted (the solve itself is replicated — for tall
+    least-squares systems use :func:`lstsq`, which stays distributed).
+    """
+    if A.ndim != 2 or A.shape[0] != A.shape[1]:
+        raise ValueError(f"'A' must be square 2-D, got shape {A.shape}")
+    x = jnp.linalg.solve(A._logical(), b._logical())
+    return DNDarray.from_logical(x, None, A.device, A.comm)
+
+
+def cholesky(A: DNDarray) -> DNDarray:
+    """Lower Cholesky factor of a symmetric positive-definite matrix."""
+    if A.ndim != 2 or A.shape[0] != A.shape[1]:
+        raise ValueError(f"'A' must be square 2-D, got shape {A.shape}")
+    L = jnp.linalg.cholesky(A._logical())
+    return DNDarray.from_logical(L, None, A.device, A.comm)
+
+
+def eigh(A: DNDarray):
+    """Eigendecomposition of a symmetric matrix: ``(w, v)`` ascending."""
+    if A.ndim != 2 or A.shape[0] != A.shape[1]:
+        raise ValueError(f"'A' must be square 2-D, got shape {A.shape}")
+    w, v = jnp.linalg.eigh(A._logical())
+    return (DNDarray.from_logical(w, None, A.device, A.comm),
+            DNDarray.from_logical(v, None, A.device, A.comm))
+
+
+def lstsq(A: DNDarray, b: DNDarray) -> DNDarray:
+    """Least-squares solution of an (overdetermined) system ``A x ≈ b``.
+
+    Distributed path: for a tall ``split=0`` matrix this is TSQR —
+    ``x = R^{-1} (Q^T b)`` where Q/R come from the blockwise QR
+    (:func:`heat_tpu.core.linalg.qr.qr`), so the tall dimension never
+    gathers; ``Q^T b`` is a distributed GEMM. Replicated/other splits use
+    XLA's lstsq on the logical arrays.
+    """
+    if A.ndim != 2:
+        raise ValueError(f"'A' must be 2-D, got {A.ndim}-D")
+    m, n = A.shape
+    if A.split == 0 and m >= n:
+        from .qr import qr
+
+        dec = qr(A, calc_q=True)
+        qtb = matmul(transpose(dec.Q), b if b.ndim == 2 else b.expand_dims(1))
+        r = dec.R._logical()
+        # lstsq (not a triangular solve) on the small R system: for a
+        # rank-deficient A this returns the min-norm solution, matching the
+        # replicated path, instead of inf/NaN from a singular solve
+        x, *_ = jnp.linalg.lstsq(r[:n, :n], qtb._logical()[:n])
+        if b.ndim == 1:
+            x = x[:, 0]
+        return DNDarray.from_logical(x, None, A.device, A.comm)
+    x, *_ = jnp.linalg.lstsq(A._logical(), b._logical())
+    return DNDarray.from_logical(x, None, A.device, A.comm)

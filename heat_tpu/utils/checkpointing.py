@@ -359,14 +359,21 @@ def _fresh_state(tree):
     """Structure-fresh copy of a state pytree: every container is rebuilt
     (so in-place container mutations cannot leak across retries) while
     immutable leaves (jax.Array, scalars) are shared. Mutable leaves are
-    copied shallowly: numpy arrays by value, DNDarrays re-wrapped (their
-    backing jax.Array is immutable; comm/mesh are shared — deepcopy would
-    choke on device handles and round-trip arrays through the host)."""
+    copied: numpy arrays by value, DNDarrays re-wrapped (their backing
+    jax.Array is immutable; comm/mesh are shared — a whole-tree deepcopy
+    would choke on device handles and round-trip arrays through the host),
+    and any other leaf (set, bytearray, custom object) by deepcopy so a
+    crashed attempt's mutations cannot leak either."""
+    import copy
+
     def leaf(x):
+        if isinstance(x, jax.Array) or isinstance(
+                x, (int, float, complex, bool, str, bytes, type(None))):
+            return x
         if isinstance(x, np.ndarray):
             return x.copy()
         if isinstance(x, DNDarray):
             return DNDarray(x.larray, x.gshape, x.dtype, x.split, x.device, x.comm)
-        return x
+        return copy.deepcopy(x)
 
     return jax.tree.map(leaf, tree, is_leaf=lambda x: isinstance(x, DNDarray))

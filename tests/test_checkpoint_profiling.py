@@ -232,3 +232,22 @@ class TestCheckpointManager:
         out = run_with_recovery(train, mgr, init)
         assert out["lst"] == [2] and out["n"][0] == 2  # no leak from attempt 1
         assert init["lst"] == [] and init["n"][0] == 0  # init untouched
+
+    def test_retry_copy_deep_copies_odd_mutables(self, tmp_path):
+        from heat_tpu.utils.checkpointing import CheckpointManager, run_with_recovery
+
+        mgr = CheckpointManager(str(tmp_path / "run6"), every_steps=100, keep=1)
+        init = {"seen": set(), "buf": bytearray(b"ab")}
+        attempts = {"n": 0}
+
+        def train(state, start, save):
+            attempts["n"] += 1
+            state["seen"].add(attempts["n"])
+            state["buf"][0] = attempts["n"]
+            if attempts["n"] == 1:
+                raise RuntimeError("crash")
+            return state
+
+        out = run_with_recovery(train, mgr, init)
+        assert out["seen"] == {2}          # attempt 1's mutation didn't leak
+        assert init["seen"] == set() and init["buf"] == bytearray(b"ab")

@@ -146,3 +146,64 @@ class TestTiling:
         t = ht.tiling.SquareDiagTiles(x, tiles_per_proc=1)
         assert t.tile_rows >= 1 and t.tile_columns >= 1
         assert len(t.row_indices) == t.tile_rows
+
+
+class TestDenseSolvers:
+    """solve/cholesky/eigh/lstsq (beyond the reference's cg/lanczos)."""
+
+    def test_solve(self):
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal((6, 6)).astype(np.float64) + 6 * np.eye(6)
+        b = rng.standard_normal((6,))
+        for split in (None, 0, 1):
+            x = ht.linalg.solve(ht.array(A, split=split), ht.array(b))
+            np.testing.assert_allclose(x.numpy(), np.linalg.solve(A, b), rtol=1e-8)
+
+    def test_cholesky(self):
+        rng = np.random.default_rng(1)
+        M = rng.standard_normal((5, 5))
+        A = M @ M.T + 5 * np.eye(5)
+        L = ht.linalg.cholesky(ht.array(A, split=0))
+        np.testing.assert_allclose(L.numpy(), np.linalg.cholesky(A), rtol=1e-8)
+
+    def test_eigh(self):
+        rng = np.random.default_rng(2)
+        M = rng.standard_normal((7, 7))
+        A = (M + M.T) / 2
+        w, v = ht.linalg.eigh(ht.array(A, split=1))
+        wn, vn = np.linalg.eigh(A)
+        np.testing.assert_allclose(w.numpy(), wn, rtol=1e-8, atol=1e-10)
+        np.testing.assert_allclose(np.abs(v.numpy()), np.abs(vn), rtol=1e-6, atol=1e-8)
+
+    @pytest.mark.parametrize("ndim_b", [1, 2])
+    def test_lstsq_tall_split0_tsqr_path(self, ndim_b):
+        rng = np.random.default_rng(3)
+        m = 8 * ht.MESH_WORLD.size + 5
+        A = rng.standard_normal((m, 4))
+        b = rng.standard_normal((m,) if ndim_b == 1 else (m, 3))
+        x = ht.linalg.lstsq(ht.array(A, split=0), ht.array(b, split=0))
+        want, *_ = np.linalg.lstsq(A, b, rcond=None)
+        assert x.shape == want.shape
+        np.testing.assert_allclose(x.numpy(), want, rtol=1e-6, atol=1e-8)
+
+    def test_lstsq_replicated(self):
+        rng = np.random.default_rng(4)
+        A = rng.standard_normal((10, 3))
+        b = rng.standard_normal((10,))
+        x = ht.linalg.lstsq(ht.array(A), ht.array(b))
+        want, *_ = np.linalg.lstsq(A, b, rcond=None)
+        np.testing.assert_allclose(x.numpy(), want, rtol=1e-6, atol=1e-8)
+
+    def test_lstsq_rank_deficient_matches_replicated(self):
+        rng = np.random.default_rng(5)
+        m = 8 * ht.MESH_WORLD.size
+        A = rng.standard_normal((m, 4))
+        A[:, 3] = A[:, 0]  # dependent column
+        b = rng.standard_normal(m)
+        x0 = ht.linalg.lstsq(ht.array(A, split=0), ht.array(b, split=0)).numpy()
+        xr = ht.linalg.lstsq(ht.array(A), ht.array(b)).numpy()
+        assert np.isfinite(x0).all()
+        # both must achieve the same (minimal) residual
+        r0 = np.linalg.norm(A @ x0 - b)
+        rr = np.linalg.norm(A @ xr - b)
+        np.testing.assert_allclose(r0, rr, rtol=1e-6)
