@@ -759,10 +759,12 @@ class DNDarray:
 
         return indexing.nonzero(self)
 
-    def unique(self, sorted=True, return_inverse=False, axis=None):
+    def unique(self, sorted=True, return_inverse=False, axis=None, return_counts=False):
         from . import manipulations
 
-        return manipulations.unique(self, sorted=sorted, return_inverse=return_inverse, axis=axis)
+        return manipulations.unique(
+            self, sorted=sorted, return_inverse=return_inverse, axis=axis,
+            return_counts=return_counts)
 
     def clip(self, a_min, a_max, out=None):
         from . import rounding

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 
 from .. import arithmetics, factories
 from ..dndarray import DNDarray
-from .basics import matmul, dot, transpose
+from .basics import matmul, dot, transpose, _square_check
 
 __all__ = ["cg", "lanczos", "solve", "cholesky", "eigh", "lstsq"]
 
@@ -132,24 +132,21 @@ def solve(A: DNDarray, b: DNDarray) -> DNDarray:
     split are accepted (the solve itself is replicated — for tall
     least-squares systems use :func:`lstsq`, which stays distributed).
     """
-    if A.ndim != 2 or A.shape[0] != A.shape[1]:
-        raise ValueError(f"'A' must be square 2-D, got shape {A.shape}")
+    _square_check(A)
     x = jnp.linalg.solve(A._logical(), b._logical())
     return DNDarray.from_logical(x, None, A.device, A.comm)
 
 
 def cholesky(A: DNDarray) -> DNDarray:
     """Lower Cholesky factor of a symmetric positive-definite matrix."""
-    if A.ndim != 2 or A.shape[0] != A.shape[1]:
-        raise ValueError(f"'A' must be square 2-D, got shape {A.shape}")
+    _square_check(A)
     L = jnp.linalg.cholesky(A._logical())
     return DNDarray.from_logical(L, None, A.device, A.comm)
 
 
 def eigh(A: DNDarray):
     """Eigendecomposition of a symmetric matrix: ``(w, v)`` ascending."""
-    if A.ndim != 2 or A.shape[0] != A.shape[1]:
-        raise ValueError(f"'A' must be square 2-D, got shape {A.shape}")
+    _square_check(A)
     w, v = jnp.linalg.eigh(A._logical())
     return (DNDarray.from_logical(w, None, A.device, A.comm),
             DNDarray.from_logical(v, None, A.device, A.comm))

@@ -504,19 +504,27 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
     return vals_d, idx_d
 
 
-def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):
-    """Unique elements (reference ``:3051``).
+def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False,
+           axis: Optional[int] = None, return_counts: bool = False):
+    """Unique elements (reference ``:3051``; ``return_counts`` exceeds the
+    reference's signature, matching numpy's).
 
     Dynamic-shape op: computed on the gathered logical array (documented XLA
     semantic, SURVEY.md §7 hard part 4); result is replicated.
     """
     logical = a._logical()
-    if return_inverse:
-        res, inverse = jnp.unique(logical, return_inverse=True, axis=axis)
-        return (
-            _wrap_logical(res, None, a),
-            _wrap_logical(inverse.reshape(logical.shape if axis is None else (-1,)), None, a),
-        )
+    if return_inverse or return_counts:
+        res, *rest = jnp.unique(
+            logical, return_inverse=return_inverse,
+            return_counts=return_counts, axis=axis)
+        out = [_wrap_logical(res, None, a)]
+        if return_inverse:
+            inverse = rest.pop(0)
+            out.append(_wrap_logical(
+                inverse.reshape(logical.shape if axis is None else (-1,)), None, a))
+        if return_counts:
+            out.append(_wrap_logical(rest.pop(0), None, a))
+        return tuple(out)
     res = jnp.unique(logical, axis=axis)
     return _wrap_logical(res, None, a)
 

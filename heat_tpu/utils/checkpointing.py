@@ -362,8 +362,11 @@ def _fresh_state(tree):
     copied: numpy arrays by value, DNDarrays re-wrapped (their backing
     jax.Array is immutable; comm/mesh are shared — a whole-tree deepcopy
     would choke on device handles and round-trip arrays through the host),
-    and any other leaf (set, bytearray, custom object) by deepcopy so a
-    crashed attempt's mutations cannot leak either."""
+    and any other leaf (set, bytearray, custom object) by best-effort
+    deepcopy so a crashed attempt's mutations cannot leak either. Leaves
+    that refuse to deepcopy (locks, open handles, device-handle-bearing
+    objects) are shared unchanged rather than breaking startup — such
+    leaves must not be mutated by train_fn."""
     import copy
 
     def leaf(x):
@@ -374,6 +377,9 @@ def _fresh_state(tree):
             return x.copy()
         if isinstance(x, DNDarray):
             return DNDarray(x.larray, x.gshape, x.dtype, x.split, x.device, x.comm)
-        return copy.deepcopy(x)
+        try:
+            return copy.deepcopy(x)
+        except Exception:
+            return x
 
     return jax.tree.map(leaf, tree, is_leaf=lambda x: isinstance(x, DNDarray))

@@ -121,3 +121,19 @@ def test_trace_tri():
     )
     np.testing.assert_array_equal(ht.tril(ht.array(M, split=1)).numpy(), np.tril(M))
     np.testing.assert_array_equal(ht.triu(ht.array(M, split=0), k=1).numpy(), np.triu(M, k=1))
+
+
+class TestUniqueCounts:
+    def test_return_counts_and_inverse(self):
+        import numpy as np
+
+        iv = np.random.default_rng(3).integers(0, 12, 200).astype(np.int32)
+        wv, wi, wc = np.unique(iv, return_inverse=True, return_counts=True)
+        for split in (None, 0):
+            x = ht.array(iv, split=split)
+            v, c = ht.unique(x, return_counts=True)
+            np.testing.assert_array_equal(np.sort(v.numpy()), wv)
+            order = np.argsort(v.numpy())
+            np.testing.assert_array_equal(c.numpy()[order], wc)
+            v2, inv, c2 = ht.unique(x, return_inverse=True, return_counts=True)
+            np.testing.assert_array_equal(v2.numpy()[inv.numpy()], iv)
