@@ -43,3 +43,11 @@ from . import optim
 from . import utils
 
 __version__ = core.__version__
+
+
+def __getattr__(name):
+    # lazy world communicators (constructing them initializes the XLA
+    # backend, which must not happen at import time — see distributed_init)
+    if name in ("MESH_WORLD", "MESH_SELF"):
+        return getattr(communication, name)
+    raise AttributeError(f"module 'heat_tpu' has no attribute {name!r}")

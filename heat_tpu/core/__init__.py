@@ -55,3 +55,9 @@ from .linalg import *
 from . import linalg
 from .pallas_kernels import pallas_enabled, set_pallas
 from . import pallas_kernels
+
+
+def __getattr__(name):
+    if name in ("MESH_WORLD", "MESH_SELF"):
+        return getattr(communication, name)
+    raise AttributeError(f"module 'heat_tpu.core' has no attribute {name!r}")

@@ -42,11 +42,10 @@ __all__ = [
     "TPUCommunication",
     "MeshAxisComm",
     "MeshGrid",
-    "MESH_WORLD",
-    "MESH_SELF",
     "get_comm",
     "use_comm",
     "sanitize_comm",
+    "distributed_init",
 ]
 
 
@@ -470,18 +469,40 @@ class MeshGrid:
 # ---------------------------------------------------------------------- #
 # module globals (reference ``communication.py:1886-1933``)              #
 # ---------------------------------------------------------------------- #
-MESH_WORLD = TPUCommunication()
-MESH_SELF = TPUCommunication(jax.devices()[:1])
+# World/self communicators are LAZY: importing heat_tpu must not touch the
+# XLA backend, or ``distributed_init`` (which must run before any backend
+# use) could never be called after the import. They materialize on first
+# attribute access via module ``__getattr__`` (``MPI_WORLD``/``MPI_SELF``
+# mirror the reference's aliases) and ``distributed_init`` rebuilds them.
+_mesh_world: Optional[TPUCommunication] = None
+_mesh_self: Optional[TPUCommunication] = None
+__default_comm: Optional[TPUCommunication] = None
 
-# backward-compatible aliases mirroring the reference's MPI_WORLD/MPI_SELF
-MPI_WORLD = MESH_WORLD
-MPI_SELF = MESH_SELF
 
-__default_comm = MESH_WORLD
+def _world() -> TPUCommunication:
+    global _mesh_world
+    if _mesh_world is None:
+        _mesh_world = TPUCommunication()
+    return _mesh_world
+
+
+def __getattr__(name: str):
+    global _mesh_self
+    if name in ("MESH_WORLD", "MPI_WORLD"):
+        return _world()
+    if name in ("MESH_SELF", "MPI_SELF"):
+        if _mesh_self is None:
+            _mesh_self = TPUCommunication(jax.devices()[:1])
+        return _mesh_self
+    raise AttributeError(
+        f"module 'heat_tpu.core.communication' has no attribute {name!r}")
 
 
 def get_comm() -> TPUCommunication:
     """Return the default communicator (reference ``get_comm``, ``:1893``)."""
+    global __default_comm
+    if __default_comm is None:
+        __default_comm = _world()
     return __default_comm
 
 
@@ -500,3 +521,32 @@ def sanitize_comm(comm) -> TPUCommunication:
     if not isinstance(comm, Communication):
         raise TypeError(f"comm must be a Communication, got {type(comm)}")
     return comm
+
+
+def distributed_init(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     **kwargs) -> TPUCommunication:
+    """Join a multi-host pod and rebuild the world communicator.
+
+    The reference's runtime bring-up is ``MPI.COMM_WORLD`` at import time
+    (``communication.py:1886``); the TPU-native equivalent is explicit:
+    ``jax.distributed.initialize`` (topology auto-detected on TPU pods —
+    all arguments optional there) followed by a world communicator over
+    the now-global device set. Host-local shards feed in through
+    ``factories.array(..., is_split=...)`` / per-host chunked I/O exactly
+    as single-host; collectives ride ICI within a slice and DCN across
+    hosts via the mesh.
+
+    Returns the new default communicator (also installed via
+    :func:`use_comm` and as ``MESH_WORLD``).
+    """
+    # None arguments mean auto-detect (the TPU-pod default)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id, **kwargs)
+    global _mesh_world
+    _mesh_world = TPUCommunication(jax.devices())
+    use_comm(_mesh_world)
+    return _mesh_world

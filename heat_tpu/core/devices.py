@@ -54,21 +54,40 @@ class Device:
 cpu = Device("cpu", 0)
 """The host-CPU platform singleton (reference ``devices.py:79``)."""
 
-# accelerator singleton: present when the JAX backend is TPU (or GPU)
-_platform = jax.default_backend()
-if _platform not in ("cpu",):
-    globals()[_platform] = Device(_platform, 0)
-    __default_device = globals()[_platform]
-else:
-    __default_device = cpu
+# Platform detection is LAZY: importing heat_tpu must not initialize the
+# XLA backend, or ``distributed_init()`` (which must run before any backend
+# touch) could never be called after the import. The accelerator singleton
+# and default device materialize on first use; ``tpu`` resolves via module
+# ``__getattr__``.
+_platform: Optional[str] = None
+_accel: Optional[Device] = None
+_default_device: Optional[Device] = None
 
-# convenience: expose `tpu` if a TPU backend exists
-tpu: Optional[Device] = globals().get("tpu")
+
+def _detect() -> None:
+    global _platform, _accel, _default_device
+    if _platform is None:
+        _platform = jax.default_backend()
+        if _platform != "cpu":
+            _accel = Device(_platform, 0)
+        if _default_device is None:
+            _default_device = _accel if _accel is not None else cpu
+
+
+def __getattr__(name: str):
+    if name == "tpu":
+        _detect()
+        return _accel if _accel is not None and _accel.device_type == "tpu" else None
+    if name in ("gpu", "axon"):
+        _detect()
+        return _accel if _accel is not None and _accel.device_type == name else None
+    raise AttributeError(f"module 'heat_tpu.core.devices' has no attribute {name!r}")
 
 
 def get_device() -> Device:
     """Default device for new arrays (reference ``get_device``, ``devices.py:113``)."""
-    return __default_device
+    _detect()
+    return _default_device
 
 
 def sanitize_device(device: Union[str, Device, None]) -> Device:
@@ -80,13 +99,13 @@ def sanitize_device(device: Union[str, Device, None]) -> Device:
     name = str(device).split(":")[0].strip().lower()
     if name == "cpu":
         return cpu
-    known = globals().get(name)
-    if isinstance(known, Device):
-        return known
+    _detect()
+    if _accel is not None and name == _accel.device_type:
+        return _accel
     raise ValueError(f"Unknown device, must be 'cpu' or '{_platform}', got {device!r}")
 
 
 def use_device(device: Union[str, Device, None] = None) -> None:
     """Set the default device (reference ``use_device``, ``devices.py:157``)."""
-    global __default_device
-    __default_device = sanitize_device(device)
+    global _default_device
+    _default_device = sanitize_device(device)

@@ -10,6 +10,14 @@ from .. import arithmetics, factories
 from ..dndarray import DNDarray
 from .basics import matmul, dot, transpose, _square_check
 
+
+def _square_2d_check(a) -> None:
+    """Strictly 2-D square (these solvers document a 2-D contract; the
+    batched-last-two-dims _square_check would silently widen it)."""
+    if a.ndim != 2:
+        raise ValueError(f"expected a 2-D matrix, got {a.ndim}-D")
+    _square_check(a)
+
 __all__ = ["cg", "lanczos", "solve", "cholesky", "eigh", "lstsq"]
 
 
@@ -132,21 +140,21 @@ def solve(A: DNDarray, b: DNDarray) -> DNDarray:
     split are accepted (the solve itself is replicated — for tall
     least-squares systems use :func:`lstsq`, which stays distributed).
     """
-    _square_check(A)
+    _square_2d_check(A)
     x = jnp.linalg.solve(A._logical(), b._logical())
     return DNDarray.from_logical(x, None, A.device, A.comm)
 
 
 def cholesky(A: DNDarray) -> DNDarray:
     """Lower Cholesky factor of a symmetric positive-definite matrix."""
-    _square_check(A)
+    _square_2d_check(A)
     L = jnp.linalg.cholesky(A._logical())
     return DNDarray.from_logical(L, None, A.device, A.comm)
 
 
 def eigh(A: DNDarray):
     """Eigendecomposition of a symmetric matrix: ``(w, v)`` ascending."""
-    _square_check(A)
+    _square_2d_check(A)
     w, v = jnp.linalg.eigh(A._logical())
     return (DNDarray.from_logical(w, None, A.device, A.comm),
             DNDarray.from_logical(v, None, A.device, A.comm))

@@ -379,7 +379,13 @@ def _fresh_state(tree):
             return DNDarray(x.larray, x.gshape, x.dtype, x.split, x.device, x.comm)
         try:
             return copy.deepcopy(x)
-        except Exception:
+        except Exception as exc:
+            import warnings
+
+            warnings.warn(
+                f"run_with_recovery: state leaf of type {type(x).__name__} "
+                f"could not be copied ({exc!r}) and is SHARED across retry "
+                "attempts — it must not be mutated by train_fn")
             return x
 
     return jax.tree.map(leaf, tree, is_leaf=lambda x: isinstance(x, DNDarray))

@@ -228,3 +228,35 @@ class TestReferenceNamedAliases:
         out = np.asarray(jax.jit(fn)(x.larray))
         want = np.arange(n * n, dtype=np.float32).reshape(n, n).T.reshape(-1)
         np.testing.assert_allclose(out, want)
+
+
+class TestDistributedInit:
+    def test_import_does_not_touch_backend_and_init_rebuilds_world(self):
+        """`import heat_tpu` must leave the XLA backend uninitialized so
+        `distributed_init` (multi-host bring-up) can still run; afterwards
+        the world communicator spans the global device set."""
+        import subprocess
+        import sys
+
+        import socket
+
+        with socket.socket() as sock:  # a free port: concurrent runs must
+            sock.bind(("localhost", 0))  # not collide on a fixed coordinator
+            port = sock.getsockname()[1]
+        code = (
+            "import os\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "os.environ['PALLAS_AXON_POOL_IPS'] = ''\n"
+            "os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=4'\n"
+            "import heat_tpu as ht\n"
+            "import jax._src.xla_bridge as xb\n"
+            "assert not xb.backends_are_initialized()\n"
+            f"comm = ht.distributed_init(coordinator_address='localhost:{port}',\n"
+            "                           num_processes=1, process_id=0)\n"
+            "assert comm.size == 4 and ht.get_comm() is comm\n"
+            "assert ht.MESH_WORLD is comm\n"
+            "assert int(ht.arange(17, split=0).sum().item()) == 136\n"
+        )
+        r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                           timeout=240)
+        assert r.returncode == 0, r.stderr.decode()[-800:]
