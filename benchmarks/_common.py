@@ -1,26 +1,35 @@
 """Shared helpers for the benchmark drivers.
 
-Import this BEFORE ``heat_tpu``:
+Importing this module puts the repo root on ``sys.path`` so the drivers can
+``import heat_tpu`` when invoked as scripts. ``maybe_init_distributed()``
+may be called before or after importing heat_tpu (the package import is
+backend-free); it must only precede any array work:
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    from _common import maybe_init_distributed
-    maybe_init_distributed()        # must precede the heat_tpu import
-
+    from _common import add_common_args, maybe_init_distributed
+    maybe_init_distributed()
     import heat_tpu as ht
 
 ``maybe_init_distributed`` must run before heat_tpu builds its default mesh
 from ``jax.devices()`` — on a multi-host pod the mesh has to span every host.
 """
 
+import os
 import sys
+
+# the drivers are invoked as scripts (``python benchmarks/kmeans/...``), so
+# the repo root is not on sys.path; add it so ``import heat_tpu`` resolves
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
 
 def maybe_init_distributed() -> None:
-    """Call ``jax.distributed.initialize()`` when ``--distributed`` is given."""
+    """Join the pod when ``--distributed`` is given (heat_tpu's import is
+    backend-free, so this works from any driver before array work)."""
     if "--distributed" in sys.argv:
-        import jax
+        import heat_tpu as ht
 
-        jax.distributed.initialize()  # topology from the TPU pod environment
+        ht.distributed_init()  # topology from the TPU pod environment
 
 
 def add_common_args(parser) -> None:

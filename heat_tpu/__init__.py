@@ -28,6 +28,17 @@ _jax.config.update("jax_enable_x64", True)
 if _jax.config.jax_default_matmul_precision is None:
     _jax.config.update("jax_default_matmul_precision", "high")
 
+# The CPU backend dispatches executables asynchronously; two in-flight
+# programs with collectives can interleave their in-process rendezvous and
+# deadlock (XLA CPU rendezvous timeout -> hard abort; observed with the
+# kmeans++ seeding programs racing the Lloyd step on an 8-device host
+# mesh). Serial dispatch on CPU removes the race; TPU is unaffected. Set
+# before backend init (importing heat_tpu does not initialize a backend).
+try:
+    _jax.config.update("jax_cpu_enable_async_dispatch", False)
+except Exception:  # unknown flag on some jax versions: keep going
+    pass
+
 from .core import *
 from . import core
 from .core import communication, devices, types, factories, manipulations, linalg

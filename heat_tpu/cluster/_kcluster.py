@@ -73,25 +73,35 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             return
         if self.init in ("kmeans++", "probability_based"):
             self._cluster_centers = self._kmeanspp(x)
+            # synchronize before the caller launches its iteration programs:
+            # concurrently-executing collective programs can interleave
+            # their rendezvous on the CPU backend and deadlock (observed
+            # with the seeding cdist ring vs the first Lloyd step)
+            jax.block_until_ready(self._cluster_centers.larray)
             return
         raise ValueError(f"initialization method {self.init!r} is not supported")
 
     def _kmeanspp(self, x: DNDarray) -> DNDarray:
-        """k-means++ D²-weighted seeding (reference ``_kcluster.py:120-194``)."""
-        logical_like = x
+        """k-means++ D²-weighted seeding (reference ``_kcluster.py:120-194``).
+
+        The heavy part (min squared distance per point) runs sharded on
+        device; the D²-weighted draw itself is O(n) on k tiny vectors and
+        runs on HOST with concrete indices. Device-side cumsum/searchsorted/
+        gather-by-traced-index would each be a separate tiny collective
+        program — a stampede of in-process rendezvous that can starve the
+        host thread pool and hard-abort XLA's CPU runtime (observed on
+        single-core CI hosts with an 8-device mesh).
+        """
         n = x.shape[0]
         k = self.n_clusters
-        first = ht_random.randint(0, n, (1,), comm=x.comm)._logical()[0]
+        first = int(ht_random.randint(0, n, (1,), comm=x.comm)._logical()[0])
         centers = x._logical()[first][None, :]
-        jdt = centers.dtype
-        for i in range(1, k):
-            d2 = self._pairwise_sq_dist_to(x, centers)  # (n,) min sq distance, replicated
-            # D²-weighted draw via the global RNG stream
-            u = ht_random.rand(1, comm=x.comm)._logical()[0]
-            probs = d2 / jnp.maximum(jnp.sum(d2), 1e-30)
-            cdf = jnp.cumsum(probs)
-            nxt = jnp.searchsorted(cdf, u.astype(cdf.dtype))
-            nxt = jnp.minimum(nxt, n - 1)
+        for _ in range(1, k):
+            d2 = np.asarray(self._pairwise_sq_dist_to(x, centers))  # (n,), host
+            u = float(ht_random.rand(1, comm=x.comm)._logical()[0])
+            total = max(float(d2.sum()), 1e-30)
+            cdf = np.cumsum(d2 / total)
+            nxt = min(int(np.searchsorted(cdf, u)), n - 1)
             centers = jnp.concatenate([centers, x._logical()[nxt][None, :]], axis=0)
         return DNDarray.from_logical(centers, None, x.device, x.comm)
 

@@ -59,7 +59,7 @@ class KMedians(_KCluster):
             new_centroids = self._median_update(logical, labels, centroids, k)
             shift = float(jnp.sum((new_centroids - centroids) ** 2))
             centroids = new_centroids
-            if shift <= self.tol * self.tol:
+            if self.tol >= 0 and shift <= self.tol * self.tol:
                 break
 
         self._cluster_centers = DNDarray.from_logical(centroids, None, x.device, x.comm)

@@ -155,3 +155,28 @@ class TestEstimators:
         assert params["n_clusters"] == 3
         km.set_params(n_clusters=5)
         assert km.n_clusters == 5
+
+
+class TestKMeansTolAndSeeding:
+    def test_negative_tol_never_converges_early(self):
+        """tol=-1 is the benchmark convention for 'run all iterations';
+        squaring it must not turn it into tol^2=1 and break instantly."""
+        ht.random.seed(4)
+        x = ht.random.rand(600, 8, split=0)
+        km = ht.cluster.KMeans(n_clusters=4, max_iter=7, tol=-1.0, random_state=0)
+        km.fit(x)
+        assert km._n_iter == 7
+
+    def test_kmeanspp_repeated_fits(self):
+        """Repeated kmeans++ fits on a sizeable array (regression: the
+        device-side seeding programs starved the host thread pool and
+        hard-aborted the XLA CPU runtime)."""
+        ht.random.seed(5)
+        x = ht.random.rand(5000, 16, split=0)
+        inertias = []
+        for _ in range(3):
+            km = ht.cluster.KMeans(n_clusters=6, init="kmeans++", max_iter=4,
+                                   tol=-1.0)
+            km.fit(x)
+            inertias.append(km.inertia_)
+        assert all(np.isfinite(v) for v in inertias)
