@@ -111,7 +111,10 @@ class _KCluster(ClusteringMixin, BaseEstimator):
 
         c = DNDarray.from_logical(centers, None, x.device, x.comm)
         d = cdist(x, c, quadratic_expansion=True)
-        dmin = d.min(axis=1)
+        # replicate before the caller's host-side draw: a split array's
+        # shards span non-addressable devices on multi-host pods, where a
+        # host fetch of the sharded value would raise
+        dmin = d.min(axis=1).resplit(None)
         return dmin._logical() ** 2
 
     def _assign_to_cluster(self, x: DNDarray) -> DNDarray:

@@ -527,6 +527,16 @@ class DNDarray:
 
         return arithmetics.mod(other, self)
 
+    def __divmod__(self, other):
+        from . import arithmetics
+
+        return (arithmetics.floordiv(self, other), arithmetics.mod(self, other))
+
+    def __rdivmod__(self, other):
+        from . import arithmetics
+
+        return (arithmetics.floordiv(other, self), arithmetics.mod(other, self))
+
     def __pow__(self, other):
         from . import arithmetics
 

@@ -40,6 +40,7 @@ __all__ = [
     "vdot",
     "vecdot",
     "vector_norm",
+    "einsum",
 ]
 
 
@@ -328,3 +329,103 @@ def vecdot(x1: DNDarray, x2: DNDarray, axis=None, keepdims: bool = False) -> DND
     if axis is None:
         axis = m.ndim - 1
     return arithmetics.sum(m, axis=axis, keepdims=keepdims)
+
+
+def einsum(subscripts: str, *operands: DNDarray, out=None) -> DNDarray:
+    """Distributed Einstein summation (beyond the reference's op surface;
+    the reference composes matmul/transpose/trace by hand,
+    ``basics.py:424-2120``).
+
+    Runs ``jnp.einsum`` on the zero-filled physical arrays — padding is
+    algebraically safe for sum-of-products expressions (padded positions
+    contribute zero to contractions; padded output positions are sliced
+    away) — so sharded operands stay sharded and XLA/GSPMD schedules the
+    collectives exactly as for :func:`matmul`. The output keeps the split
+    of the first output dimension that derives from a split operand
+    dimension (contracted-split inputs psum into a replicated output).
+
+    Restrictions: explicit subscripts only (no ``...``), no repeated output
+    labels.
+    """
+    from ..dndarray import DNDarray as _D
+
+    if "..." in subscripts:
+        raise NotImplementedError("einsum with ellipsis is not supported")
+    if not operands:
+        raise ValueError("einsum needs at least one operand")
+    if any(not isinstance(op, _D) for op in operands):
+        raise TypeError("all operands must be DNDarrays")
+
+    expr = subscripts.replace(" ", "")
+    if "->" in expr:
+        in_part, out_part = expr.split("->")
+    else:
+        in_part = expr
+        # implicit mode: alphabetically sorted labels that appear exactly once
+        from collections import Counter
+
+        counts = Counter(c for c in in_part if c.isalpha())
+        out_part = "".join(sorted(c for c, n in counts.items() if n == 1))
+    in_specs = in_part.split(",")
+    if len(in_specs) != len(operands):
+        raise ValueError(
+            f"{len(in_specs)} subscript groups for {len(operands)} operands")
+    if len(set(out_part)) != len(out_part):
+        raise ValueError("repeated output labels are not supported")
+
+    comm = operands[0].comm
+    # user shape errors must raise (numpy semantics), not vanish into the
+    # split-padding normalization below: validate LOGICAL extents per label
+    logical_sizes: dict = {}
+    for op, spec in zip(operands, in_specs):
+        if len(spec) != op.ndim:
+            raise ValueError(
+                f"subscript {spec!r} does not match operand ndim {op.ndim}")
+        for ax, label in enumerate(spec):
+            prev = logical_sizes.setdefault(label, op.gshape[ax])
+            if prev != op.gshape[ax]:
+                raise ValueError(
+                    f"size of label {label!r} does not match between operands "
+                    f"({prev} vs {op.gshape[ax]})")
+
+    # output split: first output label whose source operand dimension is split
+    out_split = None
+    for pos, label in enumerate(out_part):
+        for op, spec in zip(operands, in_specs):
+            if op.split is not None and op.split < len(spec) and spec[op.split] == label:
+                out_split = pos
+                break
+        if out_split is not None:
+            break
+
+    # normalize every label to one physical extent: a label can pair a
+    # padded (split) dim with an unpadded one across operands; zero-pad the
+    # shorter dims — zeros contribute nothing to sum-of-products terms and
+    # padded output positions are sliced away below
+    filled = [op.filled(0) for op in operands]
+    sizes: dict = {}
+    for arr, spec in zip(filled, in_specs):
+        for ax, label in enumerate(spec):
+            sizes[label] = max(sizes.get(label, 0), arr.shape[ax])
+    normed = []
+    for arr, spec in zip(filled, in_specs):
+        widths = [(0, sizes[l] - arr.shape[ax]) for ax, l in enumerate(spec)]
+        normed.append(jnp.pad(arr, widths) if any(w for _, w in widths) else arr)
+
+    res = jnp.einsum(in_part + "->" + out_part, *normed)
+    # slice padded output dims back to their logical extents
+    logical_shape = []
+    for label in out_part:
+        for op, spec in zip(operands, in_specs):
+            if label in spec:
+                logical_shape.append(op.gshape[spec.index(label)])
+                break
+    res = res[tuple(slice(0, s) for s in logical_shape)]
+    result = DNDarray.from_logical(res, out_split, operands[0].device, comm)
+    if out is not None:
+        from .. import sanitation
+
+        sanitation.sanitize_out(out, tuple(logical_shape), result.split, result.device)
+        out.larray = result.resplit(out.split).larray
+        return out
+    return result

@@ -254,3 +254,17 @@ class TestDataPrepUtils:
         dali_tfrecord2idx(str(src_dir), str(out_dir), str(src_dir), str(out_dir))
         lines = (out_dir / "a.tfrecord").read_text().strip().splitlines()
         assert len(lines) == 3
+
+
+class TestDivmod:
+    def test_divmod_matches_numpy(self):
+        ia = np.random.default_rng(0).integers(1, 50, (10,)).astype(np.int64)
+        ib = np.random.default_rng(1).integers(1, 5, (10,)).astype(np.int64)
+        for split in (None, 0):
+            q, r = divmod(ht.array(ia, split=split), ht.array(ib, split=split))
+            wq, wr = divmod(ia, ib)
+            np.testing.assert_array_equal(q.numpy(), wq)
+            np.testing.assert_array_equal(r.numpy(), wr)
+            q2, r2 = divmod(7, ht.array(ib, split=split))
+            np.testing.assert_array_equal(q2.numpy(), 7 // ib)
+            np.testing.assert_array_equal(r2.numpy(), 7 % ib)

@@ -207,3 +207,71 @@ class TestDenseSolvers:
         r0 = np.linalg.norm(A @ x0 - b)
         rr = np.linalg.norm(A @ xr - b)
         np.testing.assert_allclose(r0, rr, rtol=1e-6)
+
+
+class TestEinsum:
+    """Distributed einsum on zero-filled physical shards (beyond reference)."""
+
+    CASES = [
+        ("ij,jk->ik", [(9, 5), (5, 7)]),
+        ("ij,ij->ij", [(6, 7), (6, 7)]),
+        ("ij,ij->", [(6, 7), (6, 7)]),
+        ("ij->ji", [(9, 4)]),
+        ("ii->", [(6, 6)]),
+        ("ii->i", [(6, 6)]),
+        ("bij,bjk->bik", [(3, 5, 4), (3, 4, 6)]),
+        ("ij,kj->ik", [(5, 8), (7, 8)]),
+        ("i,i->", [(11,), (11,)]),
+        ("ij,j->i", [(6, 9), (9,)]),
+    ]
+
+    @pytest.mark.parametrize("expr,shapes", CASES)
+    def test_matches_numpy_all_splits(self, expr, shapes):
+        import zlib
+
+        rng = np.random.default_rng(zlib.crc32(expr.encode()))
+        arrays = [rng.standard_normal(s).astype(np.float32) for s in shapes]
+        want = np.einsum(expr, *arrays)
+        splits = [None] + [0] + ([1] if min(len(s) for s in shapes) > 1 else [])
+        for split in splits:
+            ops = []
+            for a in arrays:
+                sp = split if (split is not None and split < a.ndim) else None
+                ops.append(ht.array(a, split=sp))
+            got = ht.linalg.einsum(expr, *ops)
+            np.testing.assert_allclose(
+                got.numpy(), want, rtol=2e-4, atol=2e-4,
+                err_msg=f"{expr} split={split}")
+
+    def test_implicit_output(self):
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((5, 6)).astype(np.float32)
+        b = rng.standard_normal((6, 4)).astype(np.float32)
+        got = ht.linalg.einsum("ij,jk", ht.array(a, split=0), ht.array(b))
+        np.testing.assert_allclose(got.numpy(), np.einsum("ij,jk", a, b),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_output_stays_sharded(self):
+        a = ht.random.rand(16, 8, split=0)
+        b = ht.random.rand(8, 8, split=None)
+        out = ht.linalg.einsum("ij,jk->ik", a, b)
+        assert out.split == 0
+        # contracted-split inputs give a replicated (psum'd) output
+        c = ht.random.rand(16, 8, split=1)
+        d = ht.random.rand(8, 8, split=0)
+        out2 = ht.linalg.einsum("ij,jk->ik", c, d)
+        np.testing.assert_allclose(
+            out2.numpy(), c.numpy() @ d.numpy(), rtol=2e-4, atol=2e-4)
+
+    def test_errors(self):
+        a = ht.random.rand(4, 4)
+        with pytest.raises(NotImplementedError):
+            ht.linalg.einsum("...i->...", a)
+        with pytest.raises(ValueError):
+            ht.linalg.einsum("ij->ii", a)
+
+    def test_mismatched_label_sizes_raise(self):
+        a = ht.random.rand(3, split=0)
+        b = ht.random.rand(5, split=0)
+        with pytest.raises(ValueError, match="label"):
+            ht.linalg.einsum("i,i->", a, b)
