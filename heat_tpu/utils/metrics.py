@@ -1,0 +1,165 @@
+"""Lightweight training/runtime metrics (beyond the reference, which has no
+metrics/observability at all — SURVEY.md §5 "none beyond printing").
+
+A process-local registry of counters, gauges and observation series with
+JSON-lines export — enough to instrument training loops and benchmarks
+without external dependencies:
+
+>>> from heat_tpu.utils import metrics
+>>> metrics.inc("steps")
+>>> metrics.observe("loss", 0.42)
+>>> with metrics.timer("epoch") as t:
+...     out = train_one_epoch()
+...     t.sync(out)                  # device-synced duration (optional)
+>>> metrics.dump("run_metrics.jsonl", step=10)
+
+Snapshots are sectioned (``counters`` / ``gauges`` / ``series``) so names
+never collide across kinds or with ``dump``'s extra fields. ``dump``
+clears the observation series by default, making each JSON line a window
+since the previous dump (counters and gauges persist). Device-side values
+are fetched in one batched ``jax.device_get`` per snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from contextlib import contextmanager
+from typing import Any, Dict
+
+__all__ = ["Metrics", "inc", "gauge", "observe", "timer", "to_dict", "dump", "reset"]
+
+
+def _finite(v):
+    """JSON-safe value: non-finite floats become None (strict JSON has no
+    NaN/Infinity, and diverged runs are exactly when the lines must parse)."""
+    if isinstance(v, float) and not math.isfinite(v):
+        return None
+    return v
+
+
+class Metrics:
+    """A metrics registry: counters, gauges and windowed observations."""
+
+    def __init__(self):
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, Any] = {}
+        self._observations: Dict[str, list] = {}
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        """Add to a monotonically-increasing counter."""
+        self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def gauge(self, name: str, value: Any) -> None:
+        """Set a point-in-time value (kept as-is; may be a device scalar)."""
+        self._gauges[name] = value
+
+    def observe(self, name: str, value: Any) -> None:
+        """Append to a value series (loss curve, step time, ...)."""
+        self._observations.setdefault(name, []).append(value)
+
+    @contextmanager
+    def timer(self, name: str):
+        """Record a wall-clock duration into the ``name`` series.
+
+        Yields a :class:`heat_tpu.utils.profiling.Timer`; call its
+        ``sync(value)`` on a device result inside the block so the recorded
+        duration covers the compute, not just the async dispatch.
+        """
+        from .profiling import Timer
+
+        with Timer(name) as t:
+            yield t
+        self.observe(name, t.seconds)
+
+    @staticmethod
+    def _fetch(values):
+        """One batched host fetch for a list of (possibly device) values."""
+        try:
+            import jax
+
+            values = jax.device_get(values)
+        except Exception:
+            pass
+        out = []
+        for v in values:
+            try:
+                out.append(float(v))
+            except (TypeError, ValueError):
+                out.append(v)
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Sectioned snapshot with per-series summary statistics."""
+        series: Dict[str, Any] = {}
+        for k, raw in self._observations.items():
+            vals = self._fetch(raw)
+            nums = [v for v in vals if isinstance(v, float)]
+            if nums:
+                series[k] = {
+                    "count": len(nums),
+                    "last": _finite(nums[-1]),
+                    "mean": _finite(sum(nums) / len(nums)),
+                    "min": _finite(min(nums)),
+                    "max": _finite(max(nums)),
+                }
+            else:
+                series[k] = {"count": len(vals)}
+        return {
+            "counters": dict(self._counters),
+            "gauges": {k: _finite(v) for k, v in
+                       zip(self._gauges, self._fetch(list(self._gauges.values())))},
+            "series": series,
+        }
+
+    def dump(self, path: str, reset_series: bool = True, **extra) -> Dict[str, Any]:
+        """Append one JSON line (snapshot + ``extra`` fields) to ``path``.
+
+        By default the observation series are cleared afterwards so each
+        line summarizes the window since the previous dump — long runs
+        neither grow memory nor hold device buffers alive. Counters and
+        gauges persist.
+        """
+        record = {"ts": time.time(), **extra, **self.to_dict()}
+        with open(path, "a") as handle:
+            handle.write(json.dumps(record) + "\n")
+        if reset_series:
+            self._observations.clear()
+        return record
+
+    def reset(self) -> None:
+        self._counters.clear()
+        self._gauges.clear()
+        self._observations.clear()
+
+
+_default = Metrics()
+
+
+def inc(name: str, value: float = 1.0) -> None:
+    _default.inc(name, value)
+
+
+def gauge(name: str, value: Any) -> None:
+    _default.gauge(name, value)
+
+
+def observe(name: str, value: Any) -> None:
+    _default.observe(name, value)
+
+
+def timer(name: str):
+    return _default.timer(name)
+
+
+def to_dict() -> Dict[str, Any]:
+    return _default.to_dict()
+
+
+def dump(path: str, reset_series: bool = True, **extra) -> Dict[str, Any]:
+    return _default.dump(path, reset_series=reset_series, **extra)
+
+
+def reset() -> None:
+    _default.reset()

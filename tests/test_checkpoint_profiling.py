@@ -251,3 +251,93 @@ class TestCheckpointManager:
         out = run_with_recovery(train, mgr, init)
         assert out["seen"] == {2}          # attempt 1's mutation didn't leak
         assert init["seen"] == set() and init["buf"] == bytearray(b"ab")
+
+
+class TestMetrics:
+    def test_counters_gauges_observations(self, tmp_path):
+        from heat_tpu.utils.metrics import Metrics
+
+        m = Metrics()
+        m.inc("steps"); m.inc("steps"); m.inc("tokens", 512)
+        m.gauge("lr", 3e-4)
+        for v in (0.5, 0.4, 0.3):
+            m.observe("loss", v)
+        with m.timer("step_time"):
+            pass
+        snap = m.to_dict()
+        assert snap["counters"]["steps"] == 2
+        assert snap["counters"]["tokens"] == 512
+        assert snap["gauges"]["lr"] == 3e-4
+        loss = snap["series"]["loss"]
+        assert loss["count"] == 3 and loss["last"] == 0.3
+        assert loss["min"] == 0.3 and loss["max"] == 0.5
+        assert snap["series"]["step_time"]["count"] == 1
+
+        p = tmp_path / "m.jsonl"
+        m.dump(str(p), step=7)
+        m.observe("loss", 0.2)
+        m.dump(str(p), step=8)
+        import json as _json
+
+        lines = [_json.loads(l) for l in open(p)]
+        assert len(lines) == 2 and lines[0]["step"] == 7
+        # dump windows the series: line 2 only sees the post-dump value,
+        # counters persist
+        assert lines[1]["series"]["loss"]["count"] == 1
+        assert lines[1]["counters"]["steps"] == 2
+
+    def test_name_collisions_are_sectioned(self):
+        from heat_tpu.utils.metrics import Metrics
+
+        m = Metrics()
+        m.inc("loss")             # a counter AND a series named "loss"
+        m.observe("loss", 0.4)
+        snap = m.to_dict()
+        assert snap["counters"]["loss"] == 1
+        assert snap["series"]["loss"]["last"] == 0.4
+
+    def test_nonfinite_values_stay_valid_json(self, tmp_path):
+        from heat_tpu.utils.metrics import Metrics
+
+        m = Metrics()
+        m.observe("loss", float("nan"))
+        m.gauge("g", float("inf"))
+        p = tmp_path / "m.jsonl"
+        m.dump(str(p))
+        import json as _json
+
+        rec = _json.loads(open(p).read())  # must parse strictly
+        assert rec["series"]["loss"]["last"] is None
+        assert rec["gauges"]["g"] is None
+
+    def test_device_scalars_fetched_at_dump(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.utils.metrics import Metrics
+
+        m = Metrics()
+        m.observe("loss", jnp.asarray(1.5))
+        m.gauge("g", jnp.asarray(2.0))
+        snap = m.to_dict()
+        assert snap["series"]["loss"]["last"] == 1.5
+        assert snap["gauges"]["g"] == 2.0
+
+    def test_timer_sync_handle(self):
+        import jax.numpy as jnp
+
+        from heat_tpu.utils.metrics import Metrics
+
+        m = Metrics()
+        with m.timer("t") as t:
+            s = jnp.arange(1000).sum()
+            t.sync(s)
+        assert m.to_dict()["series"]["t"]["last"] > 0
+
+    def test_module_level_registry(self):
+        from heat_tpu.utils import metrics
+
+        metrics.reset()
+        metrics.inc("x")
+        assert metrics.to_dict()["counters"]["x"] == 1
+        metrics.reset()
+        assert metrics.to_dict()["counters"] == {}
