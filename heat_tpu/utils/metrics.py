@@ -31,12 +31,26 @@ from typing import Any, Dict
 __all__ = ["Metrics", "inc", "gauge", "observe", "timer", "to_dict", "dump", "reset"]
 
 
-def _finite(v):
+def _jsonable(v):
     """JSON-safe value: non-finite floats become None (strict JSON has no
-    NaN/Infinity, and diverged runs are exactly when the lines must parse)."""
-    if isinstance(v, float) and not math.isfinite(v):
-        return None
-    return v
+    NaN/Infinity, and diverged runs are exactly when the lines must parse);
+    arrays become (sanitized) nested lists; anything else unknown is
+    stringified rather than aborting the dump."""
+    if isinstance(v, float):
+        return v if math.isfinite(v) else None
+    if isinstance(v, (int, str, bool, type(None))):
+        return v
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    tolist = getattr(v, "tolist", None)
+    if tolist is not None:
+        return _jsonable(tolist())
+    try:
+        return _jsonable(float(v))
+    except (TypeError, ValueError):
+        return str(v)
 
 
 class Metrics:
@@ -73,43 +87,39 @@ class Metrics:
             yield t
         self.observe(name, t.seconds)
 
-    @staticmethod
-    def _fetch(values):
-        """One batched host fetch for a list of (possibly device) values."""
+    def to_dict(self) -> Dict[str, Any]:
+        """Sectioned snapshot with per-series summary statistics."""
+        # ONE batched host fetch for every device value in the snapshot
+        payload = {"series": dict(self._observations), "gauges": dict(self._gauges)}
         try:
             import jax
 
-            values = jax.device_get(values)
+            payload = jax.device_get(payload)
         except Exception:
             pass
-        out = []
-        for v in values:
-            try:
-                out.append(float(v))
-            except (TypeError, ValueError):
-                out.append(v)
-        return out
 
-    def to_dict(self) -> Dict[str, Any]:
-        """Sectioned snapshot with per-series summary statistics."""
         series: Dict[str, Any] = {}
-        for k, raw in self._observations.items():
-            vals = self._fetch(raw)
-            nums = [v for v in vals if isinstance(v, float)]
+        for k, vals in payload["series"].items():
+            nums = []
+            for v in vals:
+                try:
+                    f = float(v)
+                except (TypeError, ValueError):
+                    continue
+                nums.append(f)
             if nums:
                 series[k] = {
                     "count": len(nums),
-                    "last": _finite(nums[-1]),
-                    "mean": _finite(sum(nums) / len(nums)),
-                    "min": _finite(min(nums)),
-                    "max": _finite(max(nums)),
+                    "last": _jsonable(nums[-1]),
+                    "mean": _jsonable(sum(nums) / len(nums)),
+                    "min": _jsonable(min(nums)),
+                    "max": _jsonable(max(nums)),
                 }
             else:
                 series[k] = {"count": len(vals)}
         return {
-            "counters": dict(self._counters),
-            "gauges": {k: _finite(v) for k, v in
-                       zip(self._gauges, self._fetch(list(self._gauges.values())))},
+            "counters": {k: _jsonable(v) for k, v in self._counters.items()},
+            "gauges": {k: _jsonable(v) for k, v in payload["gauges"].items()},
             "series": series,
         }
 
@@ -121,9 +131,12 @@ class Metrics:
         neither grow memory nor hold device buffers alive. Counters and
         gauges persist.
         """
-        record = {"ts": time.time(), **extra, **self.to_dict()}
+        record = {"ts": time.time(), **{k: _jsonable(v) for k, v in extra.items()},
+                  **self.to_dict()}
         with open(path, "a") as handle:
-            handle.write(json.dumps(record) + "\n")
+            # allow_nan=False backstops the sanitizer: a line either parses
+            # strictly or the bug surfaces here, never a silent NaN token
+            handle.write(json.dumps(record, allow_nan=False) + "\n")
         if reset_series:
             self._observations.clear()
         return record

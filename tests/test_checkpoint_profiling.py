@@ -341,3 +341,19 @@ class TestMetrics:
         assert metrics.to_dict()["counters"]["x"] == 1
         metrics.reset()
         assert metrics.to_dict()["counters"] == {}
+
+    def test_nonscalar_and_nonfinite_counters_dump_strictly(self, tmp_path):
+        import jax.numpy as jnp
+
+        from heat_tpu.utils.metrics import Metrics
+
+        m = Metrics()
+        m.gauge("per_class", jnp.arange(4.0))       # non-scalar device array
+        m.inc("bad_sum", float("nan"))               # non-finite counter
+        p = tmp_path / "m.jsonl"
+        m.dump(str(p))
+        import json as _json
+
+        rec = _json.loads(open(p).read(), parse_constant=lambda c: 1 / 0)
+        assert rec["gauges"]["per_class"] == [0.0, 1.0, 2.0, 3.0]
+        assert rec["counters"]["bad_sum"] is None
