@@ -74,6 +74,11 @@ def main() -> int:
     parser.add_argument("--reference", default="/root/reference/heat")
     args = parser.parse_args()
 
+    # invoked as a script: the repo root is not on sys.path
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if root not in sys.path:
+        sys.path.insert(0, root)
+
     import heat_tpu as ht
 
     search_modules = [ht, ht.linalg, ht.spatial, ht.random]
