@@ -18,6 +18,8 @@ from . import functional as F
 from . import attention
 from .attention import local_attention, ring_attention, ulysses_attention
 from . import parallel
+from . import transformer
+from .transformer import TransformerLM, TransformerLMConfig
 from .parallel import (
     column_parallel_dense,
     row_parallel_dense,
@@ -36,6 +38,9 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
     "parallel",
+    "transformer",
+    "TransformerLM",
+    "TransformerLMConfig",
     "column_parallel_dense",
     "row_parallel_dense",
     "tp_mlp",
