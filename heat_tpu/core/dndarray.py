@@ -272,12 +272,14 @@ class DNDarray:
     def lnbytes(self) -> int:
         return int(np.prod(self.lshape)) * self.itemsize if self.lshape else self.itemsize
 
-    def lshape_map(self, force_check: bool = False):
-        """(size, ndim) per-device logical shard shapes (reference ``:573``)."""
+    @property
+    def lshape_map(self):
+        """(size, ndim) per-device logical shard shapes (reference ``:573``,
+        a property there too)."""
         return self.__comm.lshape_map(self.__gshape, self.__split)
 
     def create_lshape_map(self, force_check: bool = False):
-        return self.lshape_map(force_check)
+        return self.lshape_map
 
     @property
     def lloc(self):
@@ -347,7 +349,7 @@ class DNDarray:
         if target_map is None:
             return None
         target = np.asarray(target_map)
-        if np.array_equal(target, self.lshape_map()):
+        if np.array_equal(target, self.lshape_map):
             return None
         raise NotImplementedError(
             "heat_tpu uses a canonical even-shard layout managed by XLA; "

@@ -130,7 +130,7 @@ class SquareDiagTiles:
 
     @property
     def lshape_map(self):
-        return self.__arr.lshape_map()
+        return self.__arr.lshape_map
 
     @property
     def row_indices(self) -> List[int]:

@@ -1,0 +1,181 @@
+"""Arithmetic ops across every split vs NumPy — the reference's
+``heat/core/tests/test_arithmetics.py`` strategy (every op × every split,
+compare to the NumPy implementation)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import all_splits, assert_array_equal, assert_func_equal
+
+
+BINARY_OPS = [
+    (ht.add, np.add),
+    (ht.sub, np.subtract),
+    (ht.mul, np.multiply),
+    (ht.div, np.divide),
+    (ht.pow, np.power),
+    (ht.maximum, np.maximum),
+    (ht.minimum, np.minimum),
+    (ht.copysign, np.copysign),
+    (ht.hypot, np.hypot),
+    (ht.logaddexp, np.logaddexp),
+    (ht.logaddexp2, np.logaddexp2),
+]
+
+
+@pytest.mark.parametrize("ht_op,np_op", BINARY_OPS, ids=lambda f: getattr(f, "__name__", str(f)))
+def test_binary_float_ops_all_splits(ht_op, np_op):
+    rng = np.random.default_rng(3)
+    a = (rng.random((7, 5)) * 4 + 0.5).astype(np.float32)
+    b = (rng.random((7, 5)) * 4 + 0.5).astype(np.float32)
+    expected = np_op(a, b)
+    for sa in all_splits(2):
+        for sb in all_splits(2):
+            x = ht.array(a, split=sa)
+            y = ht.array(b, split=sb)
+            assert_array_equal(ht_op(x, y), expected, rtol=1e-4, atol=1e-5)
+
+
+INT_BINARY_OPS = [
+    (ht.floordiv, np.floor_divide),
+    (ht.mod, np.mod),
+    (ht.fmod, np.fmod),
+    (ht.bitwise_and, np.bitwise_and),
+    (ht.bitwise_or, np.bitwise_or),
+    (ht.bitwise_xor, np.bitwise_xor),
+    (ht.left_shift, np.left_shift),
+    (ht.right_shift, np.right_shift),
+]
+
+
+@pytest.mark.parametrize("ht_op,np_op", INT_BINARY_OPS, ids=lambda f: getattr(f, "__name__", str(f)))
+def test_binary_int_ops_all_splits(ht_op, np_op):
+    rng = np.random.default_rng(4)
+    a = rng.integers(1, 30, size=(6, 4)).astype(np.int32)
+    b = rng.integers(1, 5, size=(6, 4)).astype(np.int32)
+    expected = np_op(a, b)
+    for sa in all_splits(2):
+        x = ht.array(a, split=sa)
+        y = ht.array(b, split=sa)
+        assert_array_equal(ht_op(x, y), expected)
+
+
+def test_scalar_operands_both_sides():
+    rng = np.random.default_rng(5)
+    a = rng.random((5, 6)).astype(np.float32) + 1
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert_array_equal(x + 2.5, a + 2.5, rtol=1e-5)
+        assert_array_equal(2.5 + x, 2.5 + a, rtol=1e-5)
+        assert_array_equal(x - 1.5, a - 1.5, rtol=1e-5)
+        assert_array_equal(1.5 - x, 1.5 - a, rtol=1e-5)
+        assert_array_equal(x * 3, a * 3, rtol=1e-5)
+        assert_array_equal(3 / x, 3 / a, rtol=1e-4)
+        assert_array_equal(x ** 2, a ** 2, rtol=1e-4)
+        assert_array_equal(2 ** x, 2 ** a, rtol=1e-4)
+
+
+def test_broadcast_binary_mixed_rank():
+    rng = np.random.default_rng(6)
+    a = rng.random((4, 5, 3)).astype(np.float32)
+    b = rng.random((5, 1)).astype(np.float32)
+    expected = a + b
+    for split in all_splits(3):
+        x = ht.array(a, split=split)
+        y = ht.array(b)
+        assert_array_equal(x + y, expected, rtol=1e-5)
+    # row vector against matrix, both distributed
+    c = rng.random((1, 3)).astype(np.float32)
+    for split in all_splits(3):
+        x = ht.array(a, split=split)
+        z = ht.array(c, split=1)
+        assert_array_equal(x * z, a * c, rtol=1e-5)
+
+
+def test_inplace_dunder_ops_preserve_split():
+    rng = np.random.default_rng(7)
+    a = rng.random((8, 3)).astype(np.float32)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        x += 1
+        x *= 2
+        assert x.split == split
+        assert_array_equal(x, (a + 1) * 2, rtol=1e-5)
+
+
+def test_neg_pos_invert():
+    rng = np.random.default_rng(8)
+    a = rng.random((6, 6)).astype(np.float32) - 0.5
+    i = rng.integers(-10, 10, size=(6, 6)).astype(np.int32)
+    for split in all_splits(2):
+        assert_array_equal(ht.neg(ht.array(a, split=split)), -a, rtol=1e-6)
+        assert_array_equal(ht.pos(ht.array(a, split=split)), +a, rtol=1e-6)
+        assert_array_equal(ht.invert(ht.array(i, split=split)), np.invert(i))
+        assert_array_equal(~ht.array(i, split=split), ~i)
+
+
+def test_prod_sum_axes_and_keepdims():
+    rng = np.random.default_rng(9)
+    a = (rng.random((4, 5, 3)) + 0.5).astype(np.float32)
+    for split in all_splits(3):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.sum(x), a.sum(keepdims=False).reshape(()), rtol=1e-4)
+        for axis in range(3):
+            assert_array_equal(ht.sum(x, axis=axis), a.sum(axis=axis), rtol=1e-4)
+            assert_array_equal(
+                ht.sum(x, axis=axis, keepdims=True), a.sum(axis=axis, keepdims=True), rtol=1e-4
+            )
+            assert_array_equal(ht.prod(x, axis=axis), a.prod(axis=axis), rtol=1e-3)
+        assert_array_equal(ht.sum(x, axis=(0, 2)), a.sum(axis=(0, 2)), rtol=1e-4)
+
+
+def test_cumsum_cumprod_along_split_and_other_axes():
+    rng = np.random.default_rng(10)
+    a = (rng.random((7, 4)) + 0.5).astype(np.float32)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        for axis in range(2):
+            assert_array_equal(ht.cumsum(x, axis=axis), np.cumsum(a, axis=axis), rtol=1e-4)
+            assert_array_equal(ht.cumprod(x, axis=axis), np.cumprod(a, axis=axis), rtol=1e-3)
+
+
+def test_diff_orders_and_axes():
+    rng = np.random.default_rng(11)
+    a = rng.random((6, 5)).astype(np.float32)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        for axis in range(2):
+            for n in (1, 2):
+                assert_array_equal(ht.diff(x, n=n, axis=axis), np.diff(a, n=n, axis=axis), rtol=1e-4)
+
+
+def test_divmod_matches_numpy():
+    rng = np.random.default_rng(12)
+    a = rng.integers(1, 50, size=(6, 4)).astype(np.int32)
+    b = rng.integers(1, 7, size=(6, 4)).astype(np.int32)
+    dq, dr = np.divmod(a, b)
+    for split in all_splits(2):
+        q, r = divmod(ht.array(a, split=split), ht.array(b, split=split))
+        assert_array_equal(q, dq)
+        assert_array_equal(r, dr)
+
+
+def test_dtype_promotion_int_float():
+    a = np.arange(12, dtype=np.int32).reshape(3, 4)
+    b = np.linspace(0, 1, 12, dtype=np.float32).reshape(3, 4)
+    for split in all_splits(2):
+        out = ht.array(a, split=split) + ht.array(b, split=split)
+        assert out.dtype in (ht.float32, ht.float64)
+        assert_array_equal(out, a + b, rtol=1e-5)
+
+
+def test_out_keyword_reuses_buffer():
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        out = ht.zeros((4, 3), dtype=ht.float32, split=split)
+        res = ht.add(x, x, out=out)
+        assert res is out
+        assert_array_equal(out, a + a, rtol=1e-6)

@@ -26,7 +26,7 @@ class TestProperties:
         size = ht.get_comm().size
         n = 10
         x = ht.zeros((n,), split=0)
-        lmap = x.lshape_map()
+        lmap = x.lshape_map
         assert lmap.shape == (size, 1)
         assert lmap.sum() == n
         # ceil chunks: first devices get ceil(n/size)

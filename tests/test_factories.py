@@ -1,0 +1,122 @@
+"""Factory functions across splits (reference ``test_factories.py``):
+creation shapes, dtypes, split semantics, *_like, ranges, grids."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import all_splits, assert_array_equal
+
+
+def test_arange_forms():
+    assert_array_equal(ht.arange(7), np.arange(7))
+    assert_array_equal(ht.arange(2, 11), np.arange(2, 11))
+    assert_array_equal(ht.arange(1, 10, 2), np.arange(1, 10, 2))
+    assert_array_equal(ht.arange(0, 1, 0.125, dtype=ht.float32), np.arange(0, 1, 0.125, dtype=np.float32), rtol=1e-6)
+    assert_array_equal(ht.arange(11, split=0), np.arange(11))
+
+
+def test_zeros_ones_full_empty_shapes_and_splits():
+    for split in all_splits(2):
+        z = ht.zeros((5, 7), split=split)
+        o = ht.ones((5, 7), split=split)
+        f = ht.full((5, 7), 3.5, split=split)
+        e = ht.empty((5, 7), split=split)
+        assert_array_equal(z, np.zeros((5, 7)))
+        assert_array_equal(o, np.ones((5, 7)))
+        assert_array_equal(f, np.full((5, 7), 3.5), rtol=1e-6)
+        assert tuple(e.shape) == (5, 7)
+    # int shape and 1-tuple
+    assert tuple(ht.zeros(4).shape) == (4,)
+    assert tuple(ht.ones((3,)).shape) == (3,)
+
+
+def test_like_factories_inherit_shape_dtype_split():
+    base = ht.full((6, 3), 2.0, dtype=ht.float32, split=1)
+    for fn, np_fn in [(ht.zeros_like, np.zeros_like), (ht.ones_like, np.ones_like),
+                      (ht.empty_like, None)]:
+        out = fn(base)
+        assert tuple(out.shape) == (6, 3)
+        assert out.split == 1
+        assert out.dtype == ht.float32
+        if np_fn is not None:
+            assert_array_equal(out, np_fn(np.full((6, 3), 2.0, np.float32)))
+    fl = ht.full_like(base, 9.0)
+    assert_array_equal(fl, np.full((6, 3), 9.0), rtol=1e-6)
+
+
+def test_eye_rect_and_split():
+    for split in all_splits(2):
+        assert_array_equal(ht.eye(5, split=split), np.eye(5))
+        assert_array_equal(ht.eye((4, 6), split=split), np.eye(4, 6))
+
+
+def test_linspace_logspace():
+    assert_array_equal(ht.linspace(0, 1, 9), np.linspace(0, 1, 9), rtol=1e-6)
+    assert_array_equal(ht.linspace(-4, 4, 17, split=0), np.linspace(-4, 4, 17), rtol=1e-6)
+    assert_array_equal(ht.logspace(0, 3, 7), np.logspace(0, 3, 7), rtol=1e-4)
+
+
+def test_meshgrid_matches_numpy():
+    x = np.arange(4, dtype=np.float32)
+    y = np.arange(3, dtype=np.float32)
+    nx, ny = np.meshgrid(x, y)
+    hx, hy = ht.meshgrid(ht.array(x), ht.array(y))
+    assert_array_equal(hx, nx)
+    assert_array_equal(hy, ny)
+    nxi, nyi = np.meshgrid(x, y, indexing="ij")
+    hxi, hyi = ht.meshgrid(ht.array(x), ht.array(y), indexing="ij")
+    assert_array_equal(hxi, nxi)
+    assert_array_equal(hyi, nyi)
+
+
+def test_array_from_nested_lists_scalars_and_dtype():
+    assert_array_equal(ht.array([[1, 2], [3, 4]]), np.array([[1, 2], [3, 4]]))
+    s = ht.array(5.0)
+    assert tuple(s.shape) == ()
+    assert float(s) == 5.0
+    x = ht.array([1, 2, 3], dtype=ht.float64)
+    assert x.dtype == ht.float64
+
+
+def test_array_copies_by_default():
+    src = np.arange(6, dtype=np.float32)
+    x = ht.array(src, split=0)
+    src[:] = -1
+    assert_array_equal(x, np.arange(6, dtype=np.float32))
+
+
+def test_array_from_dndarray_resplit_on_creation():
+    a = np.arange(24, dtype=np.float32).reshape(4, 6)
+    x = ht.array(a, split=0)
+    y = ht.array(x, split=1)
+    assert y.split == 1
+    assert_array_equal(y, a)
+
+
+def test_is_split_adopts_local_shards():
+    # under a single controller the passed object IS the full process-local
+    # data: is_split=k adopts it sharded along k (and excludes split=)
+    full = np.arange(24, dtype=np.float32).reshape(8, 3)
+    x = ht.array(full, is_split=0)
+    assert x.split == 0
+    assert_array_equal(x, full)
+    with pytest.raises(ValueError):
+        ht.array(full, split=0, is_split=0)
+
+
+def test_uneven_split_lshape_map_covers_global():
+    # 7 rows over the mesh: padded even physical shards, logical map must sum to 7
+    x = ht.arange(7, split=0)
+    m = x.lshape_map  # property, as in the reference
+    total = sum(int(r[0]) for r in np.asarray(m))
+    assert total == 7
+    assert_array_equal(x, np.arange(7))
+
+
+@pytest.mark.parametrize("dtype", [ht.int32, ht.int64, ht.float32, ht.float64, ht.bfloat16])
+def test_factory_dtypes(dtype):
+    x = ht.ones((4, 4), dtype=dtype, split=0)
+    assert x.dtype == dtype
+    np.testing.assert_allclose(x.numpy().astype(np.float64), np.ones((4, 4)))

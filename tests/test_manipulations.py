@@ -174,5 +174,5 @@ class TestResplit:
         assert x.is_balanced()
         b = ht.balance(x, copy=True)
         assert_array_equal(b, np.arange(10))
-        r = ht.redistribute(x, target_map=x.lshape_map())
+        r = ht.redistribute(x, target_map=x.lshape_map)
         assert_array_equal(r, np.arange(10))

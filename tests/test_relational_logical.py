@@ -1,0 +1,101 @@
+"""Relational + logical ops across splits vs NumPy (reference
+``test_relational.py`` + ``test_logical.py``)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import all_splits, assert_array_equal
+
+
+REL_OPS = [
+    (ht.eq, np.equal),
+    (ht.ne, np.not_equal),
+    (ht.lt, np.less),
+    (ht.le, np.less_equal),
+    (ht.gt, np.greater),
+    (ht.ge, np.greater_equal),
+]
+
+
+@pytest.mark.parametrize("ht_op,np_op", REL_OPS, ids=lambda f: getattr(f, "__name__", str(f)))
+def test_relational_all_splits(ht_op, np_op):
+    rng = np.random.default_rng(21)
+    a = rng.integers(0, 4, size=(6, 5)).astype(np.float32)
+    b = rng.integers(0, 4, size=(6, 5)).astype(np.float32)
+    expected = np_op(a, b)
+    for split in all_splits(2):
+        out = ht_op(ht.array(a, split=split), ht.array(b, split=split))
+        assert out.dtype == ht.bool
+        assert_array_equal(out, expected)
+
+
+def test_relational_dunders_and_scalars():
+    a = np.arange(10, dtype=np.float32).reshape(2, 5)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert_array_equal(x < 5, a < 5)
+        assert_array_equal(x >= 3, a >= 3)
+        assert_array_equal(x == 4, a == 4)
+        assert_array_equal(x != 4, a != 4)
+
+
+def test_equal_is_global_scalar_bool():
+    a = np.arange(20, dtype=np.float32).reshape(4, 5)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        y = ht.array(a.copy(), split=split)
+        assert ht.equal(x, y) is True or ht.equal(x, y) == True  # noqa: E712
+        z = a.copy()
+        z[3, 4] += 1  # a mismatch on the LAST rank's shard must be seen globally
+        assert not ht.equal(x, ht.array(z, split=split))
+
+
+def test_all_any_axes():
+    rng = np.random.default_rng(22)
+    a = rng.integers(0, 2, size=(5, 6)).astype(bool)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        np.testing.assert_array_equal(np.asarray(ht.all(x)), a.all())
+        np.testing.assert_array_equal(np.asarray(ht.any(x)), a.any())
+        for axis in range(2):
+            assert_array_equal(ht.all(x, axis=axis), a.all(axis=axis))
+            assert_array_equal(ht.any(x, axis=axis), a.any(axis=axis))
+
+
+def test_allclose_isclose():
+    a = np.linspace(0, 1, 24, dtype=np.float32).reshape(4, 6)
+    b = a + 1e-9  # within default atol=1e-8 (numpy agrees)
+    c = a.copy()
+    c[3, 5] += 0.5
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert ht.allclose(x, ht.array(b, split=split))
+        assert not ht.allclose(x, ht.array(c, split=split))
+        assert_array_equal(ht.isclose(x, ht.array(c, split=split)), np.isclose(a, c))
+
+
+def test_isfinite_isinf_isnan_family():
+    a = np.array([[0.0, np.inf, -np.inf], [np.nan, 1.0, -2.0]], dtype=np.float32)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.isfinite(x), np.isfinite(a))
+        assert_array_equal(ht.isinf(x), np.isinf(a))
+        assert_array_equal(ht.isnan(x), np.isnan(a))
+        assert_array_equal(ht.isneginf(x), np.isneginf(a))
+        assert_array_equal(ht.isposinf(x), np.isposinf(a))
+
+
+def test_logical_ops_and_signbit():
+    rng = np.random.default_rng(23)
+    a = rng.integers(0, 2, size=(6, 4)).astype(bool)
+    b = rng.integers(0, 2, size=(6, 4)).astype(bool)
+    f = rng.random((6, 4)).astype(np.float32) - 0.5
+    for split in all_splits(2):
+        x, y = ht.array(a, split=split), ht.array(b, split=split)
+        assert_array_equal(ht.logical_and(x, y), np.logical_and(a, b))
+        assert_array_equal(ht.logical_or(x, y), np.logical_or(a, b))
+        assert_array_equal(ht.logical_xor(x, y), np.logical_xor(a, b))
+        assert_array_equal(ht.logical_not(x), np.logical_not(a))
+        assert_array_equal(ht.signbit(ht.array(f, split=split)), np.signbit(f))

@@ -1,0 +1,105 @@
+"""Rounding, exponential, trigonometric and complex ops across splits vs
+NumPy (reference ``test_rounding.py`` + ``test_exponential.py`` +
+``test_trigonometrics.py`` + ``test_complex_math.py``)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import all_splits, assert_array_equal, assert_func_equal
+
+
+def test_rounding_family():
+    a = np.array([[-2.7, -1.5, -0.2], [0.2, 1.5, 2.7]], dtype=np.float32)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.abs(x), np.abs(a), rtol=1e-6)
+        assert_array_equal(ht.fabs(x), np.fabs(a), rtol=1e-6)
+        assert_array_equal(ht.ceil(x), np.ceil(a))
+        assert_array_equal(ht.floor(x), np.floor(a))
+        assert_array_equal(ht.trunc(x), np.trunc(a))
+        assert_array_equal(ht.round(x), np.round(a))
+        assert_array_equal(ht.sign(x), np.sign(a))
+        assert_array_equal(ht.sgn(x), np.sign(a))
+
+
+def test_clip_scalar_and_array_bounds():
+    rng = np.random.default_rng(31)
+    a = (rng.random((5, 6)) * 10 - 5).astype(np.float32)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.clip(x, -1, 1), np.clip(a, -1, 1), rtol=1e-6)
+        assert_array_equal(x.clip(-2, 0.5), np.clip(a, -2, 0.5), rtol=1e-6)
+
+
+def test_modf_returns_fractional_and_integral():
+    a = np.array([[-1.75, 0.0, 2.5], [3.25, -0.5, 7.0]], dtype=np.float32)
+    nf, ni = np.modf(a)
+    for split in all_splits(2):
+        f, i = ht.modf(ht.array(a, split=split))
+        assert_array_equal(f, nf, rtol=1e-6)
+        assert_array_equal(i, ni, rtol=1e-6)
+
+
+EXP_OPS = [
+    (ht.exp, np.exp),
+    (ht.expm1, np.expm1),
+    (ht.exp2, np.exp2),
+    (ht.log, np.log),
+    (ht.log2, np.log2),
+    (ht.log10, np.log10),
+    (ht.log1p, np.log1p),
+    (ht.sqrt, np.sqrt),
+    (ht.square, np.square),
+]
+
+
+@pytest.mark.parametrize("ht_op,np_op", EXP_OPS, ids=lambda f: getattr(f, "__name__", str(f)))
+def test_exponential_family(ht_op, np_op):
+    assert_func_equal((5, 7), ht_op, np_op, dtype=np.float32, low=0.1, high=5)
+
+
+TRIG_OPS = [
+    (ht.sin, np.sin), (ht.cos, np.cos), (ht.tan, np.tan),
+    (ht.sinh, np.sinh), (ht.cosh, np.cosh), (ht.tanh, np.tanh),
+    (ht.arcsin, np.arcsin), (ht.arccos, np.arccos), (ht.arctan, np.arctan),
+    (ht.arcsinh, np.arcsinh), (ht.arctanh, np.arctanh),
+]
+
+
+@pytest.mark.parametrize("ht_op,np_op", TRIG_OPS, ids=lambda f: getattr(f, "__name__", str(f)))
+def test_trig_family(ht_op, np_op):
+    assert_func_equal((4, 6), ht_op, np_op, dtype=np.float32, low=-0.9, high=0.9)
+
+
+def test_arccosh_domain():
+    assert_func_equal((4, 6), ht.arccosh, np.arccosh, dtype=np.float32, low=1.1, high=4)
+
+
+def test_arctan2_and_deg_rad():
+    rng = np.random.default_rng(32)
+    a = (rng.random((5, 4)) - 0.5).astype(np.float32)
+    b = (rng.random((5, 4)) - 0.5).astype(np.float32)
+    deg = (rng.random((5, 4)) * 360 - 180).astype(np.float32)
+    for split in all_splits(2):
+        assert_array_equal(
+            ht.arctan2(ht.array(a, split=split), ht.array(b, split=split)),
+            np.arctan2(a, b), rtol=1e-5, atol=1e-6,
+        )
+        assert_array_equal(ht.deg2rad(ht.array(deg, split=split)), np.deg2rad(deg), rtol=1e-5)
+        assert_array_equal(ht.rad2deg(ht.array(a, split=split)), np.rad2deg(a), rtol=1e-5)
+        assert_array_equal(ht.degrees(ht.array(a, split=split)), np.degrees(a), rtol=1e-5)
+        assert_array_equal(ht.radians(ht.array(deg, split=split)), np.radians(deg), rtol=1e-5)
+
+
+def test_complex_math_angle_conj_real_imag():
+    rng = np.random.default_rng(33)
+    a = (rng.random((4, 5)) - 0.5 + 1j * (rng.random((4, 5)) - 0.5)).astype(np.complex64)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.angle(x), np.angle(a), rtol=1e-5, atol=1e-6)
+        assert_array_equal(ht.conj(x), np.conj(a), rtol=1e-6)
+        assert_array_equal(ht.real(x), a.real, rtol=1e-6)
+        assert_array_equal(ht.imag(x), a.imag, rtol=1e-6)
+        assert_array_equal(ht.angle(x, deg=True), np.angle(a, deg=True), rtol=1e-4)
