@@ -7,7 +7,7 @@ import pytest
 
 import heat_tpu as ht
 
-from utils import all_splits, assert_array_equal, assert_func_equal
+from utils import all_splits, assert_array_equal
 
 
 BINARY_OPS = [

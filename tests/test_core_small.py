@@ -1,0 +1,134 @@
+"""Small core modules: stride_tricks, sanitation, devices, constants,
+memory, tiling, version (reference ``test_stride_tricks.py``,
+``test_sanitation.py``, ``test_devices.py``, ``test_constants.py``,
+``test_memory.py``, ``test_tiling.py``)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import stride_tricks, sanitation
+
+from utils import assert_array_equal
+
+
+class TestStrideTricks:
+    def test_broadcast_shape(self):
+        assert stride_tricks.broadcast_shape((5, 4), (4,)) == (5, 4)
+        assert stride_tricks.broadcast_shape((1, 100, 1), (10, 1, 5)) == (10, 100, 5)
+        assert stride_tricks.broadcast_shape((8, 1, 6, 1), (7, 1, 5)) == (8, 7, 6, 5)
+        with pytest.raises(ValueError):
+            stride_tricks.broadcast_shape((5, 4), (5, 5))
+
+    def test_sanitize_axis(self):
+        assert stride_tricks.sanitize_axis((3, 4), 1) == 1
+        assert stride_tricks.sanitize_axis((3, 4), -1) == 1
+        assert stride_tricks.sanitize_axis((3, 4), None) is None
+        with pytest.raises(ValueError):
+            stride_tricks.sanitize_axis((3, 4), 2)
+        with pytest.raises(ValueError):
+            stride_tricks.sanitize_axis((3, 4), -3)
+        with pytest.raises(TypeError):
+            stride_tricks.sanitize_axis((3, 4), 1.5)
+
+    def test_sanitize_shape(self):
+        assert stride_tricks.sanitize_shape(3) == (3,)
+        assert stride_tricks.sanitize_shape((2, 3)) == (2, 3)
+        assert stride_tricks.sanitize_shape([4, 5]) == (4, 5)
+        with pytest.raises(ValueError):
+            stride_tricks.sanitize_shape((-2, 3))
+        with pytest.raises(TypeError):
+            stride_tricks.sanitize_shape("nope")
+
+
+class TestSanitation:
+    def test_sanitize_in_rejects_non_dndarray(self):
+        with pytest.raises(TypeError):
+            sanitation.sanitize_in(np.zeros(3))
+
+    def test_sanitize_out_shape_mismatch(self):
+        out = ht.zeros((3, 3))
+        with pytest.raises(ValueError):
+            sanitation.sanitize_out(out, (4, 4), None, None)
+
+    def test_sanitize_distribution_aligns_split(self):
+        a = ht.arange(12, split=0).reshape((3, 4))
+        b = ht.array(np.arange(12, dtype=np.float32).reshape(3, 4), split=1)
+        out = sanitation.sanitize_distribution(b, target=a)
+        assert out.split == a.split
+        assert_array_equal(out, np.arange(12, dtype=np.float32).reshape(3, 4))
+
+
+class TestDevices:
+    def test_singletons_and_sanitize(self):
+        assert ht.cpu.device_type == "cpu"
+        assert ht.devices.sanitize_device(None) is ht.devices.get_device()
+        assert ht.devices.sanitize_device("cpu") is ht.cpu
+        with pytest.raises(ValueError):
+            ht.devices.sanitize_device("nope")
+
+    def test_use_device_roundtrip(self):
+        prev = ht.devices.get_device()
+        ht.use_device("cpu")
+        assert ht.devices.get_device() is ht.cpu
+        ht.use_device(prev)
+
+    def test_array_carries_device(self):
+        x = ht.ones((2, 2))
+        assert x.device in (ht.cpu, getattr(ht, "tpu", ht.cpu))
+        assert isinstance(repr(x.device), str)
+
+
+class TestConstants:
+    def test_values(self):
+        assert ht.pi == pytest.approx(np.pi)
+        assert ht.e == pytest.approx(np.e)
+        assert np.isinf(ht.inf) and ht.inf > 0
+        assert np.isnan(ht.nan)
+        assert np.isinf(ht.Inf) and np.isnan(ht.NaN)
+
+
+class TestMemory:
+    def test_copy_is_deep(self):
+        x = ht.arange(6, dtype=ht.float32, split=0)
+        y = ht.copy(x)
+        y += 1
+        np.testing.assert_allclose(x.numpy(), np.arange(6))
+        np.testing.assert_allclose(y.numpy(), np.arange(6) + 1)
+
+    def test_sanitize_memory_layout_accepts_orders(self):
+        from heat_tpu.core import memory
+
+        x = ht.ones((3, 4))
+        out = memory.sanitize_memory_layout(x.larray, order="C")
+        assert out.shape == x.larray.shape
+        # XLA owns physical layout: column-major is explicitly unsupported
+        with pytest.raises(NotImplementedError):
+            memory.sanitize_memory_layout(x.larray, order="F")
+
+
+class TestTiling:
+    def test_split_tiles_cover_array(self):
+        x = ht.arange(40, dtype=ht.float32, split=0).reshape((8, 5))
+        tiles = ht.tiling.SplitTiles(x)
+        # tiles along the split axis partition it
+        assert int(np.asarray(tiles.tile_dimensions[0]).sum()) == 8
+
+    def test_square_diag_tiles_props(self):
+        x = ht.array(np.arange(64, dtype=np.float32).reshape(8, 8), split=0)
+        tiles = ht.tiling.SquareDiagTiles(x, tiles_per_proc=1)
+        assert tiles.tile_rows >= 1
+        assert tiles.tile_columns >= 1
+        assert len(tiles.row_indices) == tiles.tile_rows
+        assert len(tiles.col_indices) == tiles.tile_columns
+        lm = tiles.lshape_map
+        assert np.asarray(lm).shape[0] == x.comm.size
+
+
+class TestVersion:
+    def test_version_tuple(self):
+        import heat_tpu
+
+        assert isinstance(heat_tpu.__version__, str)
+        parts = heat_tpu.__version__.split(".")
+        assert len(parts) >= 2

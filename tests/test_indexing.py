@@ -1,0 +1,116 @@
+"""Indexing surface: ``nonzero``/``where`` (reference ``test_indexing.py``)
+plus global fancy getitem/setitem across splits (reference
+``test_dndarray.py`` getitem/setitem coverage, ``dndarray.py:656-1652``)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import all_splits, assert_array_equal
+
+
+def test_nonzero_matches_numpy():
+    a = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 4]], dtype=np.float32)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        nz = ht.nonzero(x)
+        np.testing.assert_array_equal(np.asarray(nz.numpy()), np.stack(np.nonzero(a), 1))
+
+
+def test_where_three_arg_and_condition_only():
+    rng = np.random.default_rng(41)
+    a = (rng.random((5, 6)) - 0.5).astype(np.float32)
+    b = rng.random((5, 6)).astype(np.float32)
+    c = rng.random((5, 6)).astype(np.float32)
+    for split in all_splits(2):
+        cond = ht.array(a, split=split) > 0
+        out = ht.where(cond, ht.array(b, split=split), ht.array(c, split=split))
+        assert_array_equal(out, np.where(a > 0, b, c), rtol=1e-6)
+    # scalar branches
+    out = ht.where(ht.array(a, split=0) > 0, 1.0, -1.0)
+    assert_array_equal(out, np.where(a > 0, 1.0, -1.0))
+
+
+class TestGetitem:
+    rng = np.random.default_rng(42)
+    a = rng.random((8, 9, 4)).astype(np.float32)
+
+    @pytest.mark.parametrize("key", [
+        0, -1, (2,), (slice(None), 3), (slice(1, 7),), (slice(None, None, 2),),
+        (slice(None), slice(2, 8, 3)), (1, 2, 3), (slice(None), slice(None), -1),
+        (Ellipsis, 0), (None, 2), (slice(6, 2, -1), 1),
+    ])
+    def test_basic_keys_all_splits(self, key):
+        expected = self.a[key]
+        for split in all_splits(3):
+            x = ht.array(self.a, split=split)
+            out = x[key]
+            if np.isscalar(expected) or expected.shape == ():
+                np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-6)
+            else:
+                assert_array_equal(out, expected, rtol=1e-6)
+
+    def test_integer_array_indexing(self):
+        idx = np.array([0, 3, 5, 3])
+        for split in all_splits(3):
+            x = ht.array(self.a, split=split)
+            assert_array_equal(x[idx], self.a[idx], rtol=1e-6)
+            assert_array_equal(x[ht.array(idx)], self.a[idx], rtol=1e-6)
+
+    def test_boolean_mask_rows(self):
+        mask = np.zeros(8, bool)
+        mask[[1, 4, 6]] = True
+        for split in all_splits(3):
+            x = ht.array(self.a, split=split)
+            assert_array_equal(x[mask], self.a[mask], rtol=1e-6)
+
+    def test_negative_step_full_reverse(self):
+        for split in all_splits(3):
+            x = ht.array(self.a, split=split)
+            assert_array_equal(x[::-1], self.a[::-1], rtol=1e-6)
+
+
+class TestSetitem:
+    def _base(self):
+        return np.arange(48, dtype=np.float32).reshape(6, 8)
+
+    @pytest.mark.parametrize("key,val", [
+        (0, -1.0),
+        ((slice(None), 2), -2.0),
+        ((slice(1, 5), slice(0, 4)), -3.0),
+        ((2, 3), 99.0),
+        ((slice(None, None, 2),), -4.0),
+    ])
+    def test_scalar_assignment(self, key, val):
+        for split in all_splits(2):
+            a = self._base()
+            x = ht.array(a, split=split)
+            x[key] = val
+            a[key] = val
+            assert_array_equal(x, a, rtol=1e-6)
+
+    def test_array_assignment_broadcast(self):
+        row = np.linspace(0, 1, 8, dtype=np.float32)
+        for split in all_splits(2):
+            a = self._base()
+            x = ht.array(a, split=split)
+            x[3] = ht.array(row)
+            a[3] = row
+            assert_array_equal(x, a, rtol=1e-6)
+
+    def test_setitem_with_dndarray_block(self):
+        blk = np.full((2, 3), -7.0, np.float32)
+        for split in all_splits(2):
+            a = self._base()
+            x = ht.array(a, split=split)
+            x[1:3, 2:5] = ht.array(blk, split=split)
+            a[1:3, 2:5] = blk
+            assert_array_equal(x, a, rtol=1e-6)
+
+    def test_setitem_preserves_split_and_dtype(self):
+        for split in all_splits(2):
+            x = ht.array(self._base(), split=split)
+            x[0, 0] = 5
+            assert x.split == split
+            assert x.dtype == ht.float32

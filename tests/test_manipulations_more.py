@@ -1,0 +1,197 @@
+"""Wider manipulations coverage (reference ``test_manipulations.py``, 32 test
+functions): stack family, splits, pad modes, repeat, roll multi-axis, flips,
+moveaxis/swapaxes, ravel/flatten, expand/squeeze, diag family, tile."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import all_splits, assert_array_equal
+
+
+rng = np.random.default_rng(61)
+A = rng.random((4, 6)).astype(np.float32)
+B = rng.random((4, 6)).astype(np.float32)
+
+
+def test_concatenate_every_axis_and_split():
+    for axis in range(2):
+        expected = np.concatenate([A, B], axis=axis)
+        for split in all_splits(2):
+            out = ht.concatenate([ht.array(A, split=split), ht.array(B, split=split)], axis=axis)
+            assert_array_equal(out, expected, rtol=1e-6)
+
+
+def test_stack_vstack_hstack_dstack_column_row():
+    for split in all_splits(2):
+        x, y = ht.array(A, split=split), ht.array(B, split=split)
+        assert_array_equal(ht.stack([x, y]), np.stack([A, B]), rtol=1e-6)
+        assert_array_equal(ht.stack([x, y], axis=2), np.stack([A, B], axis=2), rtol=1e-6)
+        assert_array_equal(ht.vstack([x, y]), np.vstack([A, B]), rtol=1e-6)
+        assert_array_equal(ht.hstack([x, y]), np.hstack([A, B]), rtol=1e-6)
+        assert_array_equal(ht.dstack([x, y]), np.dstack([A, B]), rtol=1e-6)
+        assert_array_equal(ht.column_stack([x, y]), np.column_stack([A, B]), rtol=1e-6)
+        assert_array_equal(ht.row_stack([x, y]), np.vstack([A, B]), rtol=1e-6)
+
+
+def test_split_functions():
+    a = np.arange(24, dtype=np.float32).reshape(4, 6)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        for h, n in zip(ht.vsplit(x, 2), np.vsplit(a, 2)):
+            assert_array_equal(h, n)
+        for h, n in zip(ht.hsplit(x, 3), np.hsplit(a, 3)):
+            assert_array_equal(h, n)
+        for h, n in zip(ht.split(x, 2, axis=0), np.split(a, 2, axis=0)):
+            assert_array_equal(h, n)
+    b = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    for h, n in zip(ht.dsplit(ht.array(b), 2), np.dsplit(b, 2)):
+        assert_array_equal(h, n)
+
+
+@pytest.mark.parametrize("mode", ["constant"])
+def test_pad_widths_and_values(mode):
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.pad(x, ((1, 2), (0, 1))), np.pad(a, ((1, 2), (0, 1))))
+        assert_array_equal(
+            ht.pad(x, ((1, 1), (2, 2)), constant_values=7),
+            np.pad(a, ((1, 1), (2, 2)), constant_values=7),
+        )
+        assert_array_equal(ht.pad(x, 2), np.pad(a, 2))
+
+
+def test_repeat_scalar_and_per_element():
+    a = np.array([[1, 2], [3, 4]], dtype=np.float32)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.repeat(x, 3), np.repeat(a, 3))
+        assert_array_equal(ht.repeat(x, 2, axis=0), np.repeat(a, 2, axis=0))
+        assert_array_equal(ht.repeat(x, 2, axis=1), np.repeat(a, 2, axis=1))
+
+
+def test_roll_single_and_multi_axis():
+    a = np.arange(24, dtype=np.float32).reshape(4, 6)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.roll(x, 2), np.roll(a, 2))
+        assert_array_equal(ht.roll(x, 1, axis=0), np.roll(a, 1, axis=0))
+        assert_array_equal(ht.roll(x, -2, axis=1), np.roll(a, -2, axis=1))
+        assert_array_equal(ht.roll(x, (1, 2), axis=(0, 1)), np.roll(a, (1, 2), axis=(0, 1)))
+
+
+def test_flip_family_and_rot90():
+    a = np.arange(24, dtype=np.float32).reshape(4, 6)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.flip(x, 0), np.flip(a, 0))
+        assert_array_equal(ht.flip(x, 1), np.flip(a, 1))
+        assert_array_equal(ht.flipud(x), np.flipud(a))
+        assert_array_equal(ht.fliplr(x), np.fliplr(a))
+        for k in range(4):
+            assert_array_equal(ht.rot90(x, k), np.rot90(a, k))
+
+
+def test_moveaxis_swapaxes_transpose():
+    a = rng.random((3, 4, 5)).astype(np.float32)
+    for split in all_splits(3):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.moveaxis(x, 0, 2), np.moveaxis(a, 0, 2), rtol=1e-6)
+        assert_array_equal(ht.swapaxes(x, 0, 1), np.swapaxes(a, 0, 1), rtol=1e-6)
+        assert_array_equal(x.transpose((2, 0, 1)), a.transpose((2, 0, 1)), rtol=1e-6)
+
+
+def test_ravel_flatten():
+    a = rng.random((4, 5)).astype(np.float32)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.ravel(x), a.ravel(), rtol=1e-6)
+        assert_array_equal(ht.flatten(x), a.flatten(), rtol=1e-6)
+
+
+def test_expand_dims_squeeze():
+    a = rng.random((3, 1, 5)).astype(np.float32)
+    for split in all_splits(3):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.expand_dims(x, 0), np.expand_dims(a, 0), rtol=1e-6)
+        assert_array_equal(ht.expand_dims(x, -1), np.expand_dims(a, -1), rtol=1e-6)
+        assert_array_equal(ht.squeeze(x), np.squeeze(a), rtol=1e-6)
+        assert_array_equal(ht.squeeze(x, axis=1), np.squeeze(a, axis=1), rtol=1e-6)
+
+
+def test_diag_diagonal():
+    a = rng.random((5, 5)).astype(np.float32)
+    v = rng.random(4).astype(np.float32)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.diag(x), np.diag(a), rtol=1e-6)
+        assert_array_equal(ht.diag(x, offset=1), np.diag(a, k=1), rtol=1e-6)
+        assert_array_equal(ht.diagonal(x), np.diagonal(a), rtol=1e-6)
+        assert_array_equal(ht.diagonal(x, offset=-1), np.diagonal(a, offset=-1), rtol=1e-6)
+    for split in all_splits(1):
+        d = ht.array(v, split=split)
+        assert_array_equal(ht.diag(d), np.diag(v), rtol=1e-6)
+        assert_array_equal(ht.diag(d, offset=-1), np.diag(v, k=-1), rtol=1e-6)
+
+
+def test_tile_reps():
+    a = np.arange(6, dtype=np.float32).reshape(2, 3)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.tile(x, (2, 1)), np.tile(a, (2, 1)))
+        assert_array_equal(ht.tile(x, (2, 3)), np.tile(a, (2, 3)))
+        assert_array_equal(ht.tile(x, 2), np.tile(a, 2))
+
+
+def test_reshape_across_splits():
+    a = np.arange(24, dtype=np.float32)
+    for split in all_splits(1):
+        x = ht.array(a, split=split)
+        for shape in [(4, 6), (2, 3, 4), (24,), (6, -1)]:
+            assert_array_equal(ht.reshape(x, shape), a.reshape(shape))
+    m = a.reshape(4, 6)
+    for split in all_splits(2):
+        assert_array_equal(ht.reshape(ht.array(m, split=split), (8, 3)), m.reshape(8, 3))
+
+
+def test_sort_values_and_indices_every_split():
+    a = rng.permutation(24).astype(np.float32).reshape(4, 6)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        for axis in (0, 1):
+            v, i = ht.sort(x, axis=axis)
+            assert_array_equal(v, np.sort(a, axis=axis))
+            assert_array_equal(i, np.argsort(a, axis=axis))
+        vd, _ = ht.sort(x, axis=0, descending=True)
+        assert_array_equal(vd, -np.sort(-a, axis=0))
+
+
+def test_unique_sorted_inverse_counts():
+    a = np.array([3, 1, 2, 3, 1, 1, 5], dtype=np.int32)
+    nu, ninv, ncnt = np.unique(a, return_inverse=True, return_counts=True)
+    for split in all_splits(1):
+        x = ht.array(a, split=split)
+        u = ht.unique(x, sorted=True)
+        np.testing.assert_array_equal(np.asarray(u.numpy()), nu)
+        u2, inv = ht.unique(x, return_inverse=True, sorted=True)
+        np.testing.assert_array_equal(np.asarray(u2.numpy()), nu)
+        np.testing.assert_array_equal(np.asarray(inv.numpy()).ravel(), ninv)
+        u3, cnt = ht.unique(x, return_counts=True, sorted=True)
+        np.testing.assert_array_equal(np.asarray(cnt.numpy()), ncnt)
+
+
+def test_resplit_matrix_all_transitions():
+    a = rng.random((6, 8)).astype(np.float32)
+    for s_from in all_splits(2):
+        for s_to in all_splits(2):
+            x = ht.array(a, split=s_from)
+            y = ht.resplit(x, s_to)
+            assert y.split == s_to
+            assert_array_equal(y, a, rtol=1e-6)
+            # in-place variant
+            z = ht.array(a, split=s_from)
+            z.resplit_(s_to)
+            assert z.split == s_to
+            assert_array_equal(z, a, rtol=1e-6)

@@ -1,0 +1,163 @@
+"""Statistics beyond the basics file: cov, moments (skew/kurtosis), average
+with weights, percentile interpolation modes, histogram family, topk
+(reference ``test_statistics.py``)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import all_splits, assert_array_equal
+
+
+def test_min_max_with_axis_and_keepdims():
+    rng = np.random.default_rng(51)
+    a = rng.random((6, 7)).astype(np.float32)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        np.testing.assert_allclose(np.asarray(ht.max(x)), a.max(), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(ht.min(x)), a.min(), rtol=1e-6)
+        for axis in range(2):
+            assert_array_equal(ht.max(x, axis=axis), a.max(axis=axis), rtol=1e-6)
+            assert_array_equal(ht.min(x, axis=axis), a.min(axis=axis), rtol=1e-6)
+            assert_array_equal(
+                ht.max(x, axis=axis, keepdims=True), a.max(axis=axis, keepdims=True), rtol=1e-6
+            )
+
+
+def test_argmax_argmin_flat_and_axis():
+    rng = np.random.default_rng(52)
+    a = rng.random((5, 8)).astype(np.float32)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert int(np.asarray(ht.argmax(x))) == int(a.argmax())
+        assert int(np.asarray(ht.argmin(x))) == int(a.argmin())
+        for axis in range(2):
+            assert_array_equal(ht.argmax(x, axis=axis), a.argmax(axis=axis))
+            assert_array_equal(ht.argmin(x, axis=axis), a.argmin(axis=axis))
+
+
+def test_mean_var_std_ddof_and_axes():
+    rng = np.random.default_rng(53)
+    a = rng.random((7, 5)).astype(np.float32)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        np.testing.assert_allclose(np.asarray(ht.mean(x)), a.mean(), rtol=1e-5)
+        for axis in range(2):
+            assert_array_equal(ht.mean(x, axis=axis), a.mean(axis=axis), rtol=1e-5)
+            assert_array_equal(ht.var(x, axis=axis), a.var(axis=axis), rtol=1e-4, atol=1e-6)
+            assert_array_equal(ht.std(x, axis=axis), a.std(axis=axis), rtol=1e-4, atol=1e-6)
+        # sample variance (reference default ddof semantics supported via kwarg)
+        assert_array_equal(ht.var(x, axis=0, ddof=1), a.var(axis=0, ddof=1), rtol=1e-4, atol=1e-6)
+
+
+def test_average_weights():
+    rng = np.random.default_rng(54)
+    a = rng.random((6, 4)).astype(np.float32)
+    w = rng.random(6).astype(np.float32) + 0.1
+    expected = np.average(a, axis=0, weights=w)
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        out = ht.average(x, axis=0, weights=ht.array(w))
+        assert_array_equal(out, expected, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ht.average(ht.array(a, split=0))), np.average(a), rtol=1e-5)
+
+
+def test_cov_matches_numpy():
+    rng = np.random.default_rng(55)
+    a = rng.random((4, 12)).astype(np.float32)  # 4 variables, 12 observations
+    for split in all_splits(2):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.cov(x), np.cov(a), rtol=1e-4, atol=1e-5)
+
+
+def test_skew_kurtosis_against_scipy_formulas():
+    rng = np.random.default_rng(56)
+    a = rng.random(50).astype(np.float64)
+    # Fisher-Pearson skewness / Fisher kurtosis (excess), biased — the
+    # reference's definitions (statistics.py skew/kurtosis)
+    m = a.mean()
+    m2 = ((a - m) ** 2).mean()
+    m3 = ((a - m) ** 3).mean()
+    m4 = ((a - m) ** 4).mean()
+    want_skew = m3 / m2 ** 1.5
+    want_kurt = m4 / m2 ** 2 - 3
+    n = a.size
+    # defaults are the reference's unbiased-corrected estimators
+    g1 = want_skew * np.sqrt(n * (n - 1)) / (n - 2)
+    G2 = ((n + 1) * want_kurt + 6) * (n - 1) / ((n - 2) * (n - 3))
+    for split in all_splits(1):
+        x = ht.array(a, split=split)
+        np.testing.assert_allclose(float(np.asarray(ht.skew(x))), g1, rtol=1e-5)
+        np.testing.assert_allclose(
+            float(np.asarray(ht.skew(x, unbiased=False))), want_skew, rtol=1e-5)
+        np.testing.assert_allclose(
+            float(np.asarray(ht.kurtosis(x, unbiased=False))), want_kurt, rtol=1e-5)
+        np.testing.assert_allclose(float(np.asarray(ht.kurtosis(x))), G2, rtol=1e-5)
+
+
+def test_median_percentile():
+    rng = np.random.default_rng(57)
+    a = rng.random(33).astype(np.float32)
+    for split in all_splits(1):
+        x = ht.array(a, split=split)
+        np.testing.assert_allclose(float(np.asarray(ht.median(x))), np.median(a), rtol=1e-5)
+        for q in (10, 25, 50, 90):
+            np.testing.assert_allclose(
+                float(np.asarray(ht.percentile(x, q))), np.percentile(a, q), rtol=1e-4
+            )
+
+
+def test_bincount_weights_minlength():
+    v = np.array([0, 1, 1, 3, 2, 1, 7], dtype=np.int32)
+    w = np.linspace(0.5, 2.0, 7).astype(np.float32)
+    for split in all_splits(1):
+        x = ht.array(v, split=split)
+        assert_array_equal(ht.bincount(x), np.bincount(v))
+        assert_array_equal(ht.bincount(x, minlength=10), np.bincount(v, minlength=10))
+        assert_array_equal(
+            ht.bincount(x, weights=ht.array(w, split=split)), np.bincount(v, weights=w), rtol=1e-5
+        )
+
+
+def test_histc_histogram():
+    rng = np.random.default_rng(58)
+    a = (rng.random(40) * 10).astype(np.float32)
+    want = np.histogram(a, bins=5, range=(0, 10))[0]
+    for split in all_splits(1):
+        x = ht.array(a, split=split)
+        out = ht.histc(x, bins=5, min=0, max=10)
+        np.testing.assert_array_equal(np.asarray(out.numpy()).astype(np.int64), want)
+
+
+def test_topk_values_and_indices():
+    rng = np.random.default_rng(59)
+    a = rng.permutation(20).astype(np.float32)
+    for split in all_splits(1):
+        x = ht.array(a, split=split)
+        vals, idx = ht.topk(x, 4)
+        np.testing.assert_array_equal(np.sort(np.asarray(vals.numpy()))[::-1],
+                                      np.sort(a)[::-1][:4])
+        np.testing.assert_array_equal(a[np.asarray(idx.numpy()).astype(int)],
+                                      np.asarray(vals.numpy()))
+    # largest=False
+    vals, _ = ht.topk(ht.array(a, split=0), 3, largest=False)
+    np.testing.assert_array_equal(np.sort(np.asarray(vals.numpy())), np.sort(a)[:3])
+
+
+def test_digitize_bucketize():
+    a = np.array([0.2, 6.4, 3.0, 1.6, 9.9], dtype=np.float32)
+    bins = np.array([0.0, 1.0, 2.5, 4.0, 10.0], dtype=np.float32)
+    for split in all_splits(1):
+        x = ht.array(a, split=split)
+        assert_array_equal(ht.digitize(x, ht.array(bins)), np.digitize(a, bins))
+
+
+def test_maximum_minimum_nan_propagation():
+    a = np.array([1.0, np.nan, 3.0], dtype=np.float32)
+    b = np.array([2.0, 2.0, np.nan], dtype=np.float32)
+    for split in all_splits(1):
+        out = ht.maximum(ht.array(a, split=split), ht.array(b, split=split)).numpy()
+        want = np.maximum(a, b)
+        np.testing.assert_array_equal(np.isnan(out), np.isnan(want))
+        np.testing.assert_allclose(out[~np.isnan(out)], want[~np.isnan(want)])
