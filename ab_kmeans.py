@@ -1,0 +1,23 @@
+import time, numpy as np, jax, jax.numpy as jnp
+import heat_tpu as ht
+from heat_tpu.cluster.kmeans import _lloyd_fori_fn
+from heat_tpu.core import pallas_kernels as pk
+
+n, d, k = 1 << 23, 64, 8
+ht.random.seed(0)
+x = ht.random.rand(n, d, dtype=ht.float32, split=0)
+xp = x.larray
+jdt = xp.dtype
+
+def run(pallas, iters):
+    pk.set_pallas(pallas)
+    fn = _lloyd_fori_fn(xp.shape, jdt, k, n, x.comm)
+    c0 = xp[:k]
+    out = fn(xp, c0, 2); float(np.asarray(out[1]))
+    t0 = time.perf_counter(); out = fn(xp, c0, 2); float(np.asarray(out[1])); t1 = time.perf_counter()
+    out = fn(xp, c0, 2 + iters); float(np.asarray(out[1])); t2 = time.perf_counter()
+    return iters / ((t2 - t1) - (t1 - t0))
+
+for pallas in (False, True, False, True):
+    print("pallas", pallas, "iter/s:", round(run(pallas, 50), 1), flush=True)
+

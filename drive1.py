@@ -1,0 +1,27 @@
+import numpy as np
+import heat_tpu as ht
+
+# minimum slice
+assert int(ht.arange(1000, split=0).sum().item()) == 499500
+# uneven over 8 devices
+x = ht.arange(10, split=0)
+assert int(x.sum().item()) == 45
+assert np.array_equal(x.lshape_map, x.create_lshape_map())  # property parity
+# batched matmul vs numpy
+rng = np.random.default_rng(0)
+a = rng.normal(size=(3, 4, 5)).astype(np.float32)
+b = rng.normal(size=(3, 5, 6)).astype(np.float32)
+for split in (None, 0, 1, 2):
+    out = ht.matmul(ht.array(a, split=split), ht.array(b, split=split))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-3, atol=1e-3)
+# broadcast batch
+c = rng.normal(size=(5, 6)).astype(np.float32)
+out = ht.matmul(ht.array(a, split=0), ht.array(c))
+np.testing.assert_allclose(out.numpy(), a @ c, rtol=1e-3, atol=1e-3)
+# mixed splits binary op + resplit roundtrip
+m = rng.normal(size=(7, 9)).astype(np.float32)
+y = ht.array(m, split=0) + ht.array(m, split=1)
+np.testing.assert_allclose(y.numpy(), m + m, rtol=1e-5)
+z = ht.array(m, split=0); z.resplit_(1); z.resplit_(None)
+np.testing.assert_allclose(z.numpy(), m, rtol=1e-6)
+print("drive OK")

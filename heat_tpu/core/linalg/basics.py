@@ -68,13 +68,32 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
     if a.ndim == 1 and b.ndim == 1:
         return dot(a, b)
     if a.ndim == 1:
+        # NumPy semantics: prepend a 1-axis, contract, drop axis -2 (works
+        # for batched b too, where the result keeps b's batch dims)
+        from .. import manipulations
+
         res = matmul(a.reshape((1, a.shape[0])), b)
-        return res.reshape((res.shape[-1],))
+        return manipulations.squeeze(res, axis=-2)
     if b.ndim == 1:
+        from .. import manipulations
+
         res = matmul(a, b.reshape((b.shape[0], 1)))
-        return res.reshape((res.shape[0],))
+        return manipulations.squeeze(res, axis=-1)
     if a.ndim != 2 or b.ndim != 2:
-        raise NotImplementedError("batched matmul: use ht.einsum-style composition")
+        # batched matmul (beyond the reference's 2-D-only ``basics.py:424``):
+        # contract the last two dims with NumPy broadcasting over the batch
+        # dims; GSPMD shards the batched GEMM from the operands' shardings
+        out = jnp.matmul(a._logical(), b._logical())
+        # preserve a batch-dim sharding when it maps onto the (right-aligned
+        # broadcast) output axis of the same extent; else replicate
+        split = None
+        for op in (a, b):
+            if op.split is not None and op.split < op.ndim - 2:
+                mapped = op.split + (out.ndim - op.ndim)
+                if op.shape[op.split] == out.shape[mapped]:
+                    split = mapped
+                    break
+        return DNDarray.from_logical(out, split=split, device=a.device, comm=a.comm)
     n, ka = a.shape
     kb, m = b.shape
     if ka != kb:

@@ -46,6 +46,11 @@ __all__ = [
 
 _NEG_BIG = -1e30  # finite stand-in for -inf so exp() of masked rows is safe
 
+# KMeans-kernel GEMM precision. DEFAULT (1-pass bf16 on the MXU) matches the
+# XLA Lloyd path, which calls `xp @ centroids.T` without a precision override;
+# HIGHEST would emulate f32 in multiple passes and dominates the kernel cost.
+_MM_PRECISION = jax.lax.Precision.DEFAULT
+
 _override: Optional[bool] = None
 
 
@@ -421,7 +426,7 @@ def _kmeans_kernel(x_ref, c_ref, mask_ref, sums_ref, counts_ref, stats_ref,
     xc = jax.lax.dot_general(
         x, c, dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=acc_dtype,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=_MM_PRECISION,
     )                                             # (bm, kp)
     scores = c2 - 2.0 * xc                        # d^2 minus the x^2 term
     # explicit int32 index dtype: under jax_enable_x64 jnp.argmin asks for
@@ -434,13 +439,18 @@ def _kmeans_kernel(x_ref, c_ref, mask_ref, sums_ref, counts_ref, stats_ref,
     acc_sums[...] += jax.lax.dot_general(
         onehot, x, dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=acc_dtype,
-        precision=jax.lax.Precision.HIGHEST,
+        precision=_MM_PRECISION,
     )                                             # (kp, d)
     acc_counts[...] += jnp.sum(onehot, axis=0, keepdims=True)  # (1, kp)
-    # inertia: min d^2 = min(scores) + x^2, both from the resident tile
-    x2 = jnp.sum(x * x, axis=1)                   # (bm,)
-    min_s = jnp.min(scores, axis=1)               # (bm,)
-    acc_inertia[0, 0] += jnp.sum((min_s + x2) * valid[:, 0])
+    # inertia: min d^2 = min(scores) + x^2, both from the resident tile.
+    # Mosaic forbids scalar stores to VMEM, so the scalar partial is
+    # broadcast-accumulated into every lane of a vector-shaped scratch; the
+    # flush reads one lane's worth (all lanes hold the same running sum).
+    # all 2-D with keepdims: Mosaic rejects 1-D offset-changing slices
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)        # (bm, 1)
+    min_s = jnp.min(scores, axis=1, keepdims=True)    # (bm, 1)
+    partial = jnp.sum((min_s + x2) * valid)
+    acc_inertia[...] += jnp.broadcast_to(partial, acc_inertia.shape)
 
     @pl.when(step == nsteps - 1)
     def _flush():
@@ -448,7 +458,7 @@ def _kmeans_kernel(x_ref, c_ref, mask_ref, sums_ref, counts_ref, stats_ref,
         counts_ref[...] = jnp.broadcast_to(
             acc_counts[...], counts_ref.shape).astype(counts_ref.dtype)
         stats_ref[...] = jnp.broadcast_to(
-            acc_inertia[0, 0], stats_ref.shape).astype(stats_ref.dtype)
+            acc_inertia[...], stats_ref.shape).astype(stats_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("block_rows",))
@@ -497,7 +507,7 @@ def kmeans_step_tile(x, centroids, valid_mask, block_rows: int = 1024):
         scratch_shapes=[
             pltpu.VMEM((kp, d), acc_dtype),
             pltpu.VMEM((1, kp), acc_dtype),
-            pltpu.VMEM((1, 1), acc_dtype),
+            pltpu.VMEM((8, 128), acc_dtype),  # scalar held in every lane (native tile)
         ],
         interpret=_interpret(),
     )(xp, cp, maskp)

@@ -1,0 +1,56 @@
+import functools, numpy as np, jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+import heat_tpu  # enables x64 etc., same env as real use
+
+def _i32(v): return jnp.asarray(v, jnp.int32)
+
+n, d, kp, bm = 1 << 20, 64, 128, 1024
+acc = jnp.float32
+
+def kern(x_ref, c_ref, m_ref, s_ref, cnt_ref, st_ref, a_s, a_c, a_i, *, stage):
+    step = pl.program_id(0); nsteps = pl.num_programs(0)
+    @pl.when(step == 0)
+    def _():
+        a_s[...] = jnp.zeros_like(a_s); a_c[...] = jnp.zeros_like(a_c); a_i[...] = jnp.zeros_like(a_i)
+    x = x_ref[...].astype(acc); c = c_ref[...].astype(acc); valid = m_ref[...].astype(acc)
+    c2 = jnp.sum(c*c, axis=1)[None, :]
+    xc = jax.lax.dot_general(x, c, dimension_numbers=(((1,),(1,)),((),())), preferred_element_type=acc, precision=PREC)
+    scores = c2 - 2.0*xc
+    if stage >= 1:
+        labels = jax.lax.argmin(scores, 1, jnp.int32)
+        onehot = (labels[:, None] == jax.lax.broadcasted_iota(jnp.int32, (bm, kp), 1)).astype(acc) * valid
+        a_s[...] += jax.lax.dot_general(onehot, x, dimension_numbers=(((0,),(0,)),((),())), preferred_element_type=acc, precision=PREC)
+        a_c[...] += jnp.sum(onehot, axis=0, keepdims=True)
+    if stage >= 2:
+        x2 = jnp.sum(x*x, axis=1, keepdims=True)
+        min_s = jnp.min(scores, axis=1, keepdims=True)
+        a_i[...] += jnp.broadcast_to(jnp.sum((min_s + x2)*valid), a_i.shape)
+    @pl.when(step == nsteps - 1)
+    def _():
+        s_ref[...] = a_s[...].astype(s_ref.dtype)
+        cnt_ref[...] = jnp.broadcast_to(a_c[...], cnt_ref.shape).astype(cnt_ref.dtype)
+        st_ref[...] = jnp.broadcast_to(a_i[...], st_ref.shape).astype(st_ref.dtype)
+
+x = jnp.ones((n, d), jnp.float32); c = jnp.ones((kp, d), jnp.float32); m = jnp.ones((n, 1), jnp.float32)
+import sys
+PREC = getattr(jax.lax.Precision, sys.argv[1])
+for stage in (0, 1, 2):
+    try:
+        out = pl.pallas_call(
+            functools.partial(kern, stage=stage),
+            grid=(n // bm,),
+            in_specs=[pl.BlockSpec((bm, d), lambda i: (_i32(i), _i32(0))),
+                      pl.BlockSpec((kp, d), lambda i: (_i32(0), _i32(0))),
+                      pl.BlockSpec((bm, 1), lambda i: (_i32(i), _i32(0)))],
+            out_specs=[pl.BlockSpec((kp, d), lambda i: (_i32(0), _i32(0))),
+                       pl.BlockSpec((8, kp), lambda i: (_i32(0), _i32(0))),
+                       pl.BlockSpec((8, 128), lambda i: (_i32(0), _i32(0)))],
+            out_shape=[jax.ShapeDtypeStruct((kp, d), acc), jax.ShapeDtypeStruct((8, kp), acc), jax.ShapeDtypeStruct((8, 128), acc)],
+            scratch_shapes=[pltpu.VMEM((kp, d), acc), pltpu.VMEM((1, kp), acc), pltpu.VMEM((8, 128), acc)],
+        )(x, c, m)
+        jax.block_until_ready(out)
+        print("stage", stage, "OK", flush=True)
+    except Exception as e:
+        msg = str(e)
+        print("stage", stage, "FAIL:", msg[:200].replace("\n", " "), flush=True)

@@ -1,0 +1,53 @@
+import functools, sys, numpy as np, jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+import heat_tpu
+
+def _i32(v): return jnp.asarray(v, jnp.int32)
+n, d, kp, bm = 1 << 20, 64, 128, 1024
+acc = jnp.float32
+PREC = jax.lax.Precision.DEFAULT
+
+def kern(x_ref, c_ref, m_ref, s_ref, a_s, *, sub):
+    step = pl.program_id(0); nsteps = pl.num_programs(0)
+    @pl.when(step == 0)
+    def _():
+        a_s[...] = jnp.zeros_like(a_s)
+    x = x_ref[...].astype(acc); c = c_ref[...].astype(acc); valid = m_ref[...].astype(acc)
+    c2 = jnp.sum(c*c, axis=1)[None, :]
+    xc = jax.lax.dot_general(x, c, dimension_numbers=(((1,),(1,)),((),())), preferred_element_type=acc, precision=PREC)
+    scores = c2 - 2.0*xc
+    labels = jax.lax.argmin(scores, 1, jnp.int32)
+    if sub == "argmin_only":
+        a_s[...] += jnp.broadcast_to(labels.astype(acc).sum(), a_s.shape)
+    elif sub == "onehot_sum":
+        onehot = (labels[:, None] == jax.lax.broadcasted_iota(jnp.int32, (bm, kp), 1)).astype(acc) * valid
+        a_s[...] += jnp.broadcast_to(jnp.sum(onehot), a_s.shape)
+    elif sub == "dot_rev":
+        onehot = (labels[:, None] == jax.lax.broadcasted_iota(jnp.int32, (bm, kp), 1)).astype(acc) * valid
+        a_s[...] += jax.lax.dot_general(onehot, x, dimension_numbers=(((0,),(0,)),((),())), preferred_element_type=acc, precision=PREC)[:, :128][: a_s.shape[0]]
+    elif sub == "dot_t":
+        oh_t = (jax.lax.broadcasted_iota(jnp.int32, (kp, bm), 0) == labels[None, :]).astype(acc) * valid[None, :, 0] if False else (jax.lax.broadcasted_iota(jnp.int32, (kp, bm), 0) == jnp.broadcast_to(labels[None, :], (kp, bm))).astype(acc)
+        a_s[...] += jax.lax.dot_general(oh_t, x, dimension_numbers=(((1,),(0,)),((),())), preferred_element_type=acc, precision=PREC)[: a_s.shape[0]]
+    @pl.when(step == nsteps - 1)
+    def _():
+        s_ref[...] = a_s[...].astype(s_ref.dtype)
+
+x = jnp.ones((n, d), jnp.float32); c = jnp.ones((kp, d), jnp.float32); m = jnp.ones((n, 1), jnp.float32)
+
+for sub in ("argmin_only", "onehot_sum", "dot_t", "dot_rev"):
+    try:
+        out = pl.pallas_call(
+            functools.partial(kern, sub=sub),
+            grid=(n // bm,),
+            in_specs=[pl.BlockSpec((bm, d), lambda i: (_i32(i), _i32(0))),
+                      pl.BlockSpec((kp, d), lambda i: (_i32(0), _i32(0))),
+                      pl.BlockSpec((bm, 1), lambda i: (_i32(i), _i32(0)))],
+            out_specs=[pl.BlockSpec((kp, d), lambda i: (_i32(0), _i32(0)))],
+            out_shape=[jax.ShapeDtypeStruct((kp, d), acc)],
+            scratch_shapes=[pltpu.VMEM((kp, d), acc)],
+        )(x, c, m)
+        jax.block_until_ready(out)
+        print(sub, "OK", flush=True)
+    except Exception as e:
+        print(sub, "FAIL:", str(e)[:150].replace("\n", " "), flush=True)

@@ -1,0 +1,200 @@
+"""Wider linalg coverage: norms (all ords), cross/outer/trace/vdot/vecdot,
+tri ops, einsum contractions, solvers on larger systems, batched matmul
+(reference ``heat/core/linalg/tests/test_basics.py``)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import all_splits, assert_array_equal
+
+
+rng = np.random.default_rng(71)
+
+
+class TestNorms:
+    a = rng.normal(size=(6, 8)).astype(np.float32)
+    v = rng.normal(size=12).astype(np.float32)
+
+    @pytest.mark.parametrize("ord", [None, "fro", 1, -1, np.inf, -np.inf])
+    def test_matrix_norm_ords(self, ord):
+        want = np.linalg.norm(self.a, ord=ord)
+        for split in all_splits(2):
+            x = ht.array(self.a, split=split)
+            got = float(np.asarray(ht.matrix_norm(x, ord=ord)))
+            np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    @pytest.mark.parametrize("ord", [None, 1, 2, 3, np.inf, -np.inf])
+    def test_vector_norm_ords(self, ord):
+        want = np.linalg.norm(self.v, ord=ord)
+        for split in all_splits(1):
+            x = ht.array(self.v, split=split)
+            got = float(np.asarray(ht.vector_norm(x, ord=ord)))
+            np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_norm_dispatch(self):
+        np.testing.assert_allclose(
+            float(np.asarray(ht.norm(ht.array(self.a, split=0)))),
+            np.linalg.norm(self.a), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(np.asarray(ht.norm(ht.array(self.v, split=0)))),
+            np.linalg.norm(self.v), rtol=1e-4)
+
+
+class TestProducts:
+    def test_cross(self):
+        a = rng.normal(size=(5, 3)).astype(np.float32)
+        b = rng.normal(size=(5, 3)).astype(np.float32)
+        for split in all_splits(2):
+            out = ht.cross(ht.array(a, split=split), ht.array(b, split=split))
+            assert_array_equal(out, np.cross(a, b), rtol=1e-4, atol=1e-5)
+
+    def test_outer_splits(self):
+        a = rng.normal(size=7).astype(np.float32)
+        b = rng.normal(size=5).astype(np.float32)
+        for sa in all_splits(1):
+            for sb in all_splits(1):
+                out = ht.outer(ht.array(a, split=sa), ht.array(b, split=sb))
+                assert_array_equal(out, np.outer(a, b), rtol=1e-4, atol=1e-5)
+
+    def test_vdot_vecdot(self):
+        a = rng.normal(size=9).astype(np.float32)
+        b = rng.normal(size=9).astype(np.float32)
+        for split in all_splits(1):
+            np.testing.assert_allclose(
+                float(np.asarray(ht.vdot(ht.array(a, split=split), ht.array(b, split=split)))),
+                np.vdot(a, b), rtol=1e-4)
+        m = rng.normal(size=(4, 9)).astype(np.float32)
+        out = ht.vecdot(ht.array(m, split=0), ht.array(b), axis=1)
+        np.testing.assert_allclose(np.asarray(out.numpy()), (m * b).sum(1), rtol=1e-4)
+
+    def test_trace_offsets(self):
+        a = rng.normal(size=(6, 6)).astype(np.float32)
+        for split in all_splits(2):
+            x = ht.array(a, split=split)
+            for off in (-1, 0, 2):
+                np.testing.assert_allclose(
+                    float(np.asarray(ht.trace(x, offset=off))), np.trace(a, offset=off),
+                    rtol=1e-4, atol=1e-5)
+
+    def test_einsum_contractions(self):
+        a = rng.normal(size=(4, 5)).astype(np.float32)
+        b = rng.normal(size=(5, 6)).astype(np.float32)
+        v = rng.normal(size=5).astype(np.float32)
+        cases = [
+            ("ij,jk->ik", (a, b)),
+            ("ij,j->i", (a, v)),
+            ("ij->ji", (a,)),
+            ("ij->", (a,)),
+            ("ij,ij->ij", (a, a)),
+        ]
+        for expr, ops in cases:
+            want = np.einsum(expr, *ops)
+            got = ht.einsum(expr, *[ht.array(o, split=0) for o in ops])
+            np.testing.assert_allclose(np.asarray(got.numpy()), want, rtol=1e-4, atol=1e-4)
+
+
+class TestTriSolve:
+    def test_tril_triu_offsets(self):
+        a = rng.normal(size=(5, 7)).astype(np.float32)
+        for split in all_splits(2):
+            x = ht.array(a, split=split)
+            for k in (-2, 0, 1):
+                assert_array_equal(ht.tril(x, k=k), np.tril(a, k=k), rtol=1e-6)
+                assert_array_equal(ht.triu(x, k=k), np.triu(a, k=k), rtol=1e-6)
+
+    def test_det_inv_wellconditioned(self):
+        a = (np.eye(5) * 4 + rng.normal(size=(5, 5)) * 0.3).astype(np.float32)
+        for split in all_splits(2):
+            x = ht.array(a, split=split)
+            np.testing.assert_allclose(
+                float(np.asarray(ht.det(x))), np.linalg.det(a), rtol=1e-3)
+            assert_array_equal(ht.inv(x), np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+
+    def test_solve_and_cholesky(self):
+        a = rng.normal(size=(6, 6)).astype(np.float64)
+        spd = a @ a.T + 6 * np.eye(6)
+        b = rng.normal(size=(6, 2)).astype(np.float64)
+        for split in all_splits(2):
+            xs = ht.linalg.solve(ht.array(spd, split=split), ht.array(b, split=split))
+            np.testing.assert_allclose(np.asarray(xs.numpy()), np.linalg.solve(spd, b),
+                                       rtol=1e-6, atol=1e-8)
+            L = ht.linalg.cholesky(ht.array(spd, split=split))
+            np.testing.assert_allclose(np.asarray(L.numpy()) @ np.asarray(L.numpy()).T, spd,
+                                       rtol=1e-6, atol=1e-8)
+
+    def test_eigh_symmetric(self):
+        a = rng.normal(size=(7, 7)).astype(np.float64)
+        sym = (a + a.T) / 2
+        w_want = np.linalg.eigvalsh(sym)
+        for split in all_splits(2):
+            w, v = ht.linalg.eigh(ht.array(sym, split=split))
+            np.testing.assert_allclose(np.sort(np.asarray(w.numpy())), w_want, rtol=1e-8, atol=1e-8)
+            vn = np.asarray(v.numpy())
+            np.testing.assert_allclose(vn @ np.diag(np.asarray(w.numpy())) @ vn.T, sym,
+                                       rtol=1e-6, atol=1e-8)
+
+    def test_lstsq_tall(self):
+        a = rng.normal(size=(64, 5)).astype(np.float64)
+        b = rng.normal(size=64).astype(np.float64)
+        want = np.linalg.lstsq(a, b, rcond=None)[0]
+        x = ht.linalg.lstsq(ht.array(a, split=0), ht.array(b, split=0))
+        np.testing.assert_allclose(np.asarray(x.numpy()), want, rtol=1e-6, atol=1e-8)
+
+    def test_cg_lanczos_larger(self):
+        n = 24
+        a = rng.normal(size=(n, n))
+        spd = (a @ a.T + n * np.eye(n)).astype(np.float64)
+        b = rng.normal(size=n).astype(np.float64)
+        x0 = ht.zeros(n, dtype=ht.float64, split=0)
+        x = ht.linalg.cg(ht.array(spd, split=0), ht.array(b, split=0), x0)
+        np.testing.assert_allclose(np.asarray(x.numpy()), np.linalg.solve(spd, b),
+                                   rtol=1e-4, atol=1e-5)
+        V, T = ht.linalg.lanczos(ht.array(spd, split=0), m=n)
+        Vn, Tn = np.asarray(V.numpy()), np.asarray(T.numpy())
+        # Lanczos relation: A V = V T on the Krylov space it built
+        np.testing.assert_allclose(spd @ Vn, Vn @ Tn, rtol=1e-4, atol=1e-5)
+
+
+class TestMatmulMore:
+    def test_batched_matmul(self):
+        a = rng.normal(size=(3, 4, 5)).astype(np.float32)
+        b = rng.normal(size=(3, 5, 6)).astype(np.float32)
+        for split in all_splits(3):
+            out = ht.matmul(ht.array(a, split=split), ht.array(b, split=split))
+            np.testing.assert_allclose(np.asarray(out.numpy()), a @ b, rtol=1e-4, atol=1e-4)
+
+    def test_matmul_dtype_promotion(self):
+        a = np.arange(12, dtype=np.int32).reshape(3, 4)
+        b = rng.normal(size=(4, 2)).astype(np.float32)
+        out = ht.matmul(ht.array(a, split=0), ht.array(b, split=0))
+        np.testing.assert_allclose(np.asarray(out.numpy()), a @ b, rtol=1e-4)
+
+    def test_uneven_tall_matmul(self):
+        a = rng.normal(size=(67, 9)).astype(np.float32)
+        b = rng.normal(size=(9, 3)).astype(np.float32)
+        out = ht.matmul(ht.array(a, split=0), ht.array(b))
+        np.testing.assert_allclose(np.asarray(out.numpy()), a @ b, rtol=1e-4, atol=1e-4)
+
+
+class TestBatchedMatmulEdge:
+    def test_vector_times_batched(self):
+        v = rng.normal(size=5).astype(np.float32)
+        t = rng.normal(size=(3, 5, 6)).astype(np.float32)
+        out = ht.matmul(ht.array(v), ht.array(t, split=0))
+        np.testing.assert_allclose(np.asarray(out.numpy()), v @ t, rtol=1e-4, atol=1e-4)
+        t2 = rng.normal(size=(3, 6, 5)).astype(np.float32)
+        out2 = ht.matmul(ht.array(t2, split=0), ht.array(v))
+        np.testing.assert_allclose(np.asarray(out2.numpy()), t2 @ v, rtol=1e-4, atol=1e-4)
+
+    def test_broadcast_batch_split_mapping(self):
+        a = rng.normal(size=(4, 7, 5)).astype(np.float32)
+        b = rng.normal(size=(2, 4, 5, 6)).astype(np.float32)
+        out = ht.matmul(ht.array(a, split=0), ht.array(b))
+        np.testing.assert_allclose(np.asarray(out.numpy()), a @ b, rtol=1e-4, atol=1e-4)
+        # a's batch axis (size 4) maps to output axis 1 under right alignment
+        assert out.split in (None, 1)
+        out2 = ht.matmul(ht.array(a), ht.array(b, split=0))
+        np.testing.assert_allclose(np.asarray(out2.numpy()), a @ b, rtol=1e-4, atol=1e-4)
+        assert out2.split in (None, 0)
