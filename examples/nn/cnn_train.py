@@ -12,7 +12,13 @@ import argparse
 
 import numpy as np
 
-import heat_tpu as ht
+try:
+    import heat_tpu as ht
+except ModuleNotFoundError:  # running from a source checkout without install
+    import os, sys
+
+    sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+    import heat_tpu as ht
 
 
 def main():

@@ -25,7 +25,15 @@ class _KCluster(ClusteringMixin, BaseEstimator):
     """Base class for KMeans/KMedians/KMedoids (reference ``_kcluster.py:16``)."""
 
     def __init__(self, metric: Callable, n_clusters: int, init, max_iter: int, tol: float, random_state):
-        self.n_clusters = n_clusters
+        import numbers
+
+        if (
+            isinstance(n_clusters, bool)
+            or not isinstance(n_clusters, numbers.Integral)
+            or n_clusters < 1
+        ):
+            raise ValueError(f"n_clusters must be a positive int, got {n_clusters!r}")
+        self.n_clusters = int(n_clusters)
         self.init = init
         self.max_iter = max_iter
         self.tol = tol

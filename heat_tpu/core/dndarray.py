@@ -27,6 +27,7 @@ process-local torch shard.
 
 from __future__ import annotations
 
+import builtins
 import math
 from typing import List, Optional, Tuple, Union
 
@@ -933,8 +934,56 @@ def _normalize_key(x, key):
         return k
 
     if isinstance(key, tuple):
-        return tuple(conv(k) for k in key)
-    return conv(key)
+        key = tuple(conv(k) for k in key)
+    else:
+        key = conv(key)
+    _check_int_bounds(x, key)
+    return key
+
+
+def _index_axis_span(k) -> builtins.int:
+    """How many array axes one key element consumes (NumPy arity rules):
+    a boolean mask consumes ``mask.ndim`` axes, a scalar bool / None consume
+    none, everything else (int, slice, integer array) consumes one."""
+    if k is None or isinstance(k, builtins.bool):
+        return 0
+    if isinstance(k, (np.ndarray, jnp.ndarray)) and k.dtype == np.bool_:
+        return k.ndim
+    return 1
+
+
+def _check_int_bounds(x, key):
+    """NumPy/reference semantics: out-of-range static integer indices raise
+    IndexError (jax would silently clamp them). Integers are checked against
+    the axis they address; dims after an Ellipsis count from the right."""
+    keys = key if isinstance(key, tuple) else (key,)
+    n_addr = sum(_index_axis_span(k) for k in keys if k is not Ellipsis)
+    if n_addr > x.ndim:
+        raise IndexError(
+            f"too many indices: array is {x.ndim}-dimensional, key addresses {n_addr}"
+        )
+    pre, post, seen_ellipsis = [], [], False
+    for k in keys:
+        if k is Ellipsis:
+            seen_ellipsis = True
+        elif seen_ellipsis:
+            post.append(k)
+        else:
+            pre.append(k)
+
+    def check(segment, start_axis):
+        axis = start_axis
+        for k in segment:
+            if isinstance(k, builtins.int) and not isinstance(k, builtins.bool):
+                n = x.gshape[axis]
+                if not -n <= k < n:
+                    raise IndexError(
+                        f"index {k} is out of bounds for axis {axis} with size {n}"
+                    )
+            axis += _index_axis_span(k)
+
+    check(pre, 0)
+    check(post, x.ndim - sum(_index_axis_span(k) for k in post))
 
 
 def _basic_key_fast_path(x: DNDarray, key) -> bool:

@@ -113,6 +113,15 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
         if not isinstance(a, DNDarray):
             raise TypeError(f"inputs must be DNDarrays, found {type(a)}")
     axis = sanitize_axis(arrays[0].shape, axis)
+    lead = arrays[0].shape
+    for a in arrays[1:]:
+        if a.ndim != len(lead) or any(
+            a.shape[i] != lead[i] for i in range(a.ndim) if i != axis
+        ):
+            raise ValueError(
+                "all input array dimensions except the concatenation axis "
+                f"must match exactly: {lead} vs {tuple(a.shape)} on axis {axis}"
+            )
     out_split = arrays[0].split
     for a in arrays[1:]:
         if a.split != out_split:

@@ -19,6 +19,7 @@ Differences by design:
 from __future__ import annotations
 
 import builtins
+import math
 from typing import Tuple, Union
 
 import numpy as np
@@ -360,57 +361,185 @@ def isreal(x):
     return logical.logical_not(iscomplex(x))
 
 
+# The reference's promotion ladder (``types.py:754-761``): the FIRST type in
+# this order both operands can "intuitively" cast to. This is neither NumPy
+# (int32+f32→f64 there) nor torch (int64+f32→f32 there): same-bit-length
+# int→float casts are allowed (int32→f32) but int64 only fits f64.
+_PROMOTION_ORDER = None  # filled lazily below (after all classes exist)
+
+
+def _promotion_order():
+    global _PROMOTION_ORDER
+    if _PROMOTION_ORDER is None:
+        _PROMOTION_ORDER = [
+            bool, uint8, int8, int16, int32, int64,
+            bfloat16, float16, float32, float64, complex64, complex128,
+        ]
+    return _PROMOTION_ORDER
+
+
 def promote_types(type1, type2) -> type:
-    """Smallest common safe type (reference ``types.py:836``), NumPy rules."""
+    """Smallest common intuitively-castable type (reference ``types.py:836``,
+    derived from the same intuitive-cast table + ladder walk ``:754-761``)."""
     t1 = canonical_heat_type(type1)
     t2 = canonical_heat_type(type2)
-    return canonical_heat_type(jnp.promote_types(t1.jax_type(), t2.jax_type()))
+    if {t1, t2} == {bfloat16, float16}:
+        return float32  # neither holds the other's values (JAX convention)
+    for target in _promotion_order():
+        if can_cast(t1, target, "intuitive") and can_cast(t2, target, "intuitive"):
+            return target
+    return float64
+
+
+def _kind_rank(t) -> builtins.int:
+    if issubclass(t, complexfloating):
+        return 3
+    if issubclass(t, floating):
+        return 2
+    if issubclass(t, integer):
+        return 1
+    return 0
 
 
 def result_type(*arrays_and_types) -> type:
-    """Promotion over arrays and dtypes (reference ``types.py:868``)."""
+    """Promotion over arrays, dtypes and scalars (reference ``types.py:868``:
+    precedence array(0) > type(1) > 0-d array(2) > python scalar(3); same
+    kind → higher precedence wins, different kind → higher kind wins)."""
     from .dndarray import DNDarray
 
-    args = []
-    for a in arrays_and_types:
-        if isinstance(a, DNDarray):
-            args.append(a.dtype.jax_type())
-        elif isinstance(a, type) and issubclass(a, datatype):
-            args.append(a.jax_type())
-        elif isinstance(a, (builtins.bool, builtins.int, builtins.float, builtins.complex)):
-            args.append(a)
+    def classify(arg):
+        if isinstance(arg, DNDarray):
+            return arg.dtype, (0 if arg.ndim > 0 else 2)
+        if isinstance(arg, np.ndarray) or hasattr(arg, "dtype"):
+            t = canonical_heat_type(arg.dtype)
+            return t, (0 if len(getattr(arg, "shape", (1,))) > 0 else 2)
+        try:
+            return canonical_heat_type(arg), 1
+        except TypeError:
+            return canonical_heat_type(type(arg)), 3
+
+    t1, p1 = classify(arrays_and_types[0])
+    for arg in arrays_and_types[1:]:
+        t2, p2 = classify(arg)
+        if t1 == t2:
+            p1 = min(p1, p2)
+            continue
+        if p1 == p2:
+            t1 = promote_types(t1, t2)
+            continue
+        k1, k2 = _kind_rank(t1), _kind_rank(t2)
+        if k1 == k2:
+            t1 = t1 if p1 < p2 else t2
         else:
-            args.append(jnp.dtype(a))
-    return canonical_heat_type(jnp.result_type(*args))
+            t1 = t1 if k1 > k2 else t2
+        p1 = min(p1, p2)
+    return t1
+
+
+# --------------------------------------------------------------------------- #
+# cast tables — the reference's explicit tables (``types.py:621-664``),
+# extended with bfloat16 and float16 rows/columns. Encoded as per-source-type
+# sets of permitted targets. "safe" preserves values exactly (mantissa rule
+# for floats: int16 fits f32's 24-bit mantissa but not bf16's 8-bit one;
+# int64→f64 follows the reference, which permits it). "intuitive" adds the
+# reference's same-bit-length int→float casts (int32→f32, int16→f16/bf16).
+# --------------------------------------------------------------------------- #
+
+
+def _cast_tables():
+    order = [bool, uint8, int8, int16, int32, int64,
+             bfloat16, float16, float32, float64, complex64, complex128]
+    floats_up = {float32, float64, complex64, complex128}
+    safe = {
+        bool: set(order),
+        uint8: {uint8, int16, int32, int64, bfloat16, float16} | floats_up,
+        int8: {int8, int16, int32, int64, bfloat16, float16} | floats_up,
+        int16: {int16, int32, int64} | floats_up,
+        int32: {int32, int64, float64, complex128},
+        int64: {int64, float64, complex128},
+        bfloat16: {bfloat16} | floats_up,
+        float16: {float16} | floats_up,
+        float32: floats_up,
+        float64: {float64, complex128},
+        complex64: {complex64, complex128},
+        complex128: {complex128},
+    }
+    intuitive = {k: set(v) for k, v in safe.items()}
+    intuitive[int16] |= {bfloat16, float16}
+    intuitive[int32] |= {float32, complex64}
+    kinds = {bool: 0}
+    for t in (uint8, int8, int16, int32, int64):
+        kinds[t] = 1
+    for t in (bfloat16, float16, float32, float64):
+        kinds[t] = 2
+    for t in (complex64, complex128):
+        kinds[t] = 3
+    return order, safe, intuitive, kinds
+
+
+_CAST_TABLES = None
+
+
+def _get_cast_tables():
+    global _CAST_TABLES
+    if _CAST_TABLES is None:
+        _CAST_TABLES = _cast_tables()
+    return _CAST_TABLES
+
+
+def _scalar_fits(value, to_t) -> builtins.bool:
+    """Value-based scalar cast check (reference/legacy-NumPy semantics:
+    ``can_cast(1024, int8) is False`` because the value overflows)."""
+    if isinstance(value, builtins.bool):
+        return True
+    jt = np.dtype(to_t.np_type()) if to_t is not bfloat16 else None
+    if isinstance(value, builtins.int):
+        if jt is not None and jt.kind in "iu":
+            info = np.iinfo(jt)
+            return info.min <= value <= info.max
+        return jt is None or jt.kind in "fc"  # any int fits a float's range
+    if isinstance(value, builtins.float):
+        if to_t is bfloat16:
+            return True  # bf16 range ≈ f32 range
+        if jt.kind == "f":
+            return math.isinf(value) or math.isnan(value) or abs(value) <= np.finfo(jt).max
+        return jt.kind == "c"
+    if isinstance(value, builtins.complex):
+        if jt is None or jt.kind != "c":
+            return False
+        comp = np.finfo(np.float32 if jt.itemsize == 8 else np.float64)
+        return abs(value.real) <= comp.max and abs(value.imag) <= comp.max
+    return False
 
 
 def can_cast(from_, to, casting: str = "intuitive") -> builtins.bool:
-    """Cast-safety test (reference ``types.py:671``).
-
-    Supports numpy casting kinds plus the reference's ``"intuitive"`` kind,
-    which additionally allows int64→float32-style value-range-lossy but
-    kind-sensible casts.
+    """Cast-safety test (reference ``types.py:671``): casting kinds
+    ``no``/``safe``/``same_kind``/``unsafe`` plus the reference's
+    ``intuitive``, which adds same-bit-length int→float casts (int32→f32
+    yes; int64→f32 no — f32's mantissa cannot hold it).
+    Python scalars are checked by VALUE (``can_cast(1024, int8) → False``).
     """
+    if casting not in ("no", "safe", "same_kind", "unsafe", "intuitive"):
+        raise ValueError(f"unknown casting kind {casting!r}")
+    to_t = canonical_heat_type(to)
     if hasattr(from_, "dtype"):
         from_ = from_.dtype
-    try:
-        from_t = canonical_heat_type(from_)
-        np_from = np.dtype(from_t.np_type()) if from_t is not bfloat16 else np.dtype(np.float32)
-    except TypeError:
-        np_from = from_
-    to_t = canonical_heat_type(to)
-    np_to = np.dtype(to_t.np_type()) if to_t is not bfloat16 else np.dtype(np.float32)
-    if casting == "intuitive":
-        if np.can_cast(np_from, np_to, "safe"):
+    if isinstance(from_, (builtins.bool, builtins.int, builtins.float, builtins.complex)) and not isinstance(from_, type):
+        if casting == "unsafe":
             return True
-        # allow within-kind downcasts and int→float
-        kind_order = {"b": 0, "u": 1, "i": 1, "f": 2, "c": 3}
-        kf = np.dtype(np_from).kind if not isinstance(np_from, (builtins.int, builtins.float)) else None
-        if kf is None:
-            return np.can_cast(np_from, np_to, "same_kind")
-        kt = np.dtype(np_to).kind
-        return kind_order.get(kt, -1) >= kind_order.get(kf, 99)
-    return np.can_cast(np_from, np_to, casting)
+        return _scalar_fits(from_, to_t)
+    from_t = canonical_heat_type(from_)
+    if casting == "unsafe":
+        return True
+    if casting == "no":
+        return from_t is to_t
+    _order, safe, intuitive, kinds = _get_cast_tables()
+    if casting == "safe":
+        return to_t in safe[from_t]
+    if casting == "intuitive":
+        return to_t in intuitive[from_t]
+    # same_kind: safe casts plus any cast within the same kind family
+    return to_t in safe[from_t] or kinds[from_t] == kinds[to_t]
 
 
 class finfo:

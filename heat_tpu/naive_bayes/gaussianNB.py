@@ -42,6 +42,10 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         """Incremental fit (reference ``gaussianNB.py:200``)."""
         if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
             raise TypeError("x and y need to be DNDarrays")
+        if y.shape[0] != x.shape[0]:
+            raise ValueError(
+                f"y has {y.shape[0]} samples but x has {x.shape[0]}"
+            )
         xl = x._logical().astype(jnp.float64)
         yl = y._logical().reshape(-1)
 

@@ -1,0 +1,104 @@
+"""Error-path coverage: the reference's suites assert TypeError/ValueError
+on bad inputs throughout (e.g. ``test_factories.py``, ``test_dndarray.py``,
+``test_manipulations.py``). Mirrors that discipline for this API."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+class TestFactoryErrors:
+    def test_bad_split_axis(self):
+        with pytest.raises(ValueError):
+            ht.zeros((3, 4), split=2)
+        with pytest.raises(ValueError):
+            ht.array([[1, 2]], split=-3)
+
+    def test_bad_shape(self):
+        with pytest.raises(ValueError):
+            ht.ones((-2, 3))
+        with pytest.raises(TypeError):
+            ht.ones("nope")
+
+    def test_split_is_split_exclusive(self):
+        with pytest.raises(ValueError):
+            ht.array([1, 2, 3], split=0, is_split=0)
+
+    def test_bad_dtype(self):
+        with pytest.raises(TypeError):
+            ht.zeros((2, 2), dtype="not_a_dtype")
+
+
+class TestOpErrors:
+    def test_binary_op_bad_operand(self):
+        x = ht.ones((2, 2))
+        with pytest.raises(TypeError):
+            ht.add(x, "text")
+
+    def test_broadcast_incompatible(self):
+        a = ht.ones((3, 4))
+        b = ht.ones((2, 4))
+        with pytest.raises(ValueError):
+            _ = a + b
+
+    def test_reduce_bad_axis(self):
+        x = ht.ones((2, 3))
+        with pytest.raises(ValueError):
+            ht.sum(x, axis=5)
+
+    def test_matmul_shape_mismatch(self):
+        with pytest.raises(ValueError):
+            ht.matmul(ht.ones((3, 4)), ht.ones((5, 6)))
+        with pytest.raises(TypeError):
+            ht.matmul(ht.ones((3, 4)), np.ones((4, 2)))
+
+    def test_concatenate_mismatched_dims(self):
+        a = ht.ones((2, 3))
+        b = ht.ones((2, 4))
+        with pytest.raises(ValueError):
+            ht.concatenate([a, b], axis=0)
+
+
+class TestIndexErrors:
+    def test_out_of_bounds_integer(self):
+        x = ht.arange(5, split=0)
+        with pytest.raises(IndexError):
+            _ = x[7]
+
+    def test_too_many_indices(self):
+        x = ht.arange(6, split=0)
+        with pytest.raises(IndexError):
+            _ = x[0, 0]
+
+
+class TestEstimatorErrors:
+    def test_kmeans_bad_k(self):
+        with pytest.raises(ValueError):
+            ht.cluster.KMeans(n_clusters=0)
+
+    def test_knn_predict_before_fit(self):
+        from heat_tpu.classification import KNeighborsClassifier
+
+        knn = KNeighborsClassifier(n_neighbors=3)
+        with pytest.raises((RuntimeError, AttributeError, ValueError)):
+            knn.predict(ht.ones((4, 2)))
+
+    def test_gaussiannb_mismatched_lengths(self):
+        from heat_tpu.naive_bayes import GaussianNB
+
+        nb = GaussianNB()
+        with pytest.raises(ValueError):
+            nb.fit(ht.ones((4, 2)), ht.ones(3))
+
+
+class TestCommErrors:
+    def test_split_bad_ranks(self):
+        comm = ht.get_comm()
+        with pytest.raises((ValueError, IndexError)):
+            comm.Split([comm.size + 5])
+
+    def test_resplit_bad_axis(self):
+        x = ht.ones((4, 4), split=0)
+        with pytest.raises(ValueError):
+            x.resplit(3)

@@ -1,0 +1,102 @@
+"""RNG depth (reference ``test_random.py``): distributions' moments and
+ranges, split invariance, state round-trips, permutation properties."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import all_splits
+
+
+def test_rand_range_and_moments():
+    ht.random.seed(11)
+    x = ht.random.rand(2000, split=0)
+    v = x.numpy()
+    assert v.min() >= 0 and v.max() < 1
+    assert abs(v.mean() - 0.5) < 0.03
+    assert abs(v.var() - 1 / 12) < 0.01
+
+
+def test_randn_moments():
+    ht.random.seed(12)
+    x = ht.random.randn(4000, split=0)
+    v = x.numpy()
+    assert abs(v.mean()) < 0.06
+    assert abs(v.std() - 1) < 0.06
+
+
+def test_normal_loc_scale():
+    ht.random.seed(13)
+    x = ht.random.normal(mean=3.0, std=0.5, shape=(3000,), split=0)
+    v = x.numpy()
+    assert abs(v.mean() - 3.0) < 0.08
+    assert abs(v.std() - 0.5) < 0.05
+
+
+def test_randint_bounds_dtype():
+    ht.random.seed(14)
+    x = ht.random.randint(5, 20, size=(500,), split=0)
+    v = x.numpy()
+    assert v.min() >= 5 and v.max() < 20
+    assert np.issubdtype(v.dtype, np.integer)
+
+
+def test_seed_reproducibility_across_splits():
+    outs = []
+    for split in all_splits(2):
+        ht.random.seed(99)
+        outs.append(ht.random.rand(6, 8, split=split).numpy())
+    for o in outs[1:]:
+        np.testing.assert_allclose(o, outs[0], rtol=1e-6)
+
+
+def test_get_set_state_roundtrip():
+    ht.random.seed(123)
+    _ = ht.random.rand(10)
+    state = ht.random.get_state()
+    a = ht.random.rand(20, split=0).numpy()
+    ht.random.set_state(state)
+    b = ht.random.rand(20, split=0).numpy()
+    np.testing.assert_allclose(a, b)
+
+
+def test_permutation_and_randperm():
+    ht.random.seed(15)
+    p = ht.random.permutation(16)
+    v = np.sort(p.numpy().astype(int))
+    np.testing.assert_array_equal(v, np.arange(16))
+    r = ht.random.randperm(9)
+    np.testing.assert_array_equal(np.sort(r.numpy().astype(int)), np.arange(9))
+    # permutation of an array permutes along axis 0 preserving rows
+    a = np.arange(12, dtype=np.float32).reshape(6, 2)
+    pa = ht.random.permutation(ht.array(a, split=0)).numpy()
+    np.testing.assert_allclose(np.sort(pa[:, 0]), a[:, 0])
+
+
+def test_different_seeds_differ():
+    ht.random.seed(1)
+    a = ht.random.rand(100).numpy()
+    ht.random.seed(2)
+    b = ht.random.rand(100).numpy()
+    assert not np.allclose(a, b)
+
+
+def test_sequential_draws_differ():
+    ht.random.seed(3)
+    a = ht.random.rand(64).numpy()
+    b = ht.random.rand(64).numpy()
+    assert not np.allclose(a, b)
+
+
+def test_standard_normal_alias_and_sample_shape():
+    ht.random.seed(16)
+    x = ht.random.standard_normal((4, 5), split=0)
+    assert tuple(x.shape) == (4, 5)
+
+
+@pytest.mark.parametrize("dtype", [ht.float32, ht.float64])
+def test_rand_dtypes(dtype):
+    ht.random.seed(17)
+    x = ht.random.rand(8, 8, dtype=dtype, split=0)
+    assert x.dtype == dtype

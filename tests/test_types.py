@@ -38,7 +38,12 @@ class TestTypeLattice:
 
     def test_result_type(self):
         a = ht.ones(3, dtype=ht.float32)
-        assert ht.result_type(a, ht.float64) is ht.float64
+        # reference precedence semantics (``types.py:927-933``): an ARRAY's
+        # dtype outranks a bare type of the same kind (unlike NumPy)
+        assert ht.result_type(a, ht.float64) is ht.float32
+        assert ht.result_type(a, ht.ones(3, dtype=ht.float64)) is ht.float64
+        assert ht.result_type(a, 2) is ht.float32
+        assert ht.result_type("i8", "f4") is ht.float64
 
     def test_finfo_iinfo(self):
         fi = ht.finfo(ht.float32)
@@ -54,7 +59,11 @@ class TestTypeLattice:
 
     def test_can_cast(self):
         assert ht.can_cast(ht.int32, ht.int64)
-        assert ht.can_cast(ht.int64, ht.float32, casting="intuitive")
+        # reference intuitive table (``types.py:643``): int64 does NOT fit
+        # float32's 24-bit mantissa; int32 does fit float32
+        assert not ht.can_cast(ht.int64, ht.float32, casting="intuitive")
+        assert ht.can_cast(ht.int32, ht.float32, casting="intuitive")
+        assert ht.can_cast(ht.int64, ht.float64, casting="intuitive")
         assert not ht.can_cast(ht.float32, ht.int32, casting="safe")
 
     def test_type_call_creates_array(self):

@@ -1,0 +1,109 @@
+"""Type-system depth (reference ``test_types.py``): promotion lattice,
+can_cast rules, finfo/iinfo values, char-code and torch/numpy interop,
+astype behavior across splits."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import all_splits
+
+
+class TestPromotion:
+    @pytest.mark.parametrize("a,b,want", [
+        (ht.uint8, ht.int16, ht.int16),
+        (ht.int32, ht.int64, ht.int64),
+        (ht.int64, ht.float32, ht.float64),
+        (ht.float32, ht.float64, ht.float64),
+        (ht.bool, ht.int8, ht.int8),
+        (ht.bfloat16, ht.float32, ht.float32),
+        (ht.float32, ht.complex64, ht.complex64),
+    ])
+    def test_promote_types(self, a, b, want):
+        assert ht.promote_types(a, b) == want
+        assert ht.promote_types(b, a) == want
+
+    def test_result_type_with_scalars(self):
+        x = ht.ones(3, dtype=ht.float32)
+        assert ht.result_type(x, 2) == ht.float32
+        assert ht.result_type(x, x) == ht.float32
+
+    @pytest.mark.parametrize("frm,to,ok", [
+        (ht.int32, ht.int64, True),
+        (ht.int64, ht.int32, False),
+        (ht.float32, ht.float64, True),
+        (ht.float64, ht.float32, False),
+        (ht.int8, ht.float32, True),
+        (ht.bool, ht.int8, True),
+    ])
+    def test_can_cast_safe(self, frm, to, ok):
+        assert ht.can_cast(frm, to, casting="safe") == ok
+
+    def test_can_cast_unsafe_always(self):
+        assert ht.can_cast(ht.float64, ht.int8, casting="unsafe")
+
+
+class TestInfo:
+    def test_finfo(self):
+        for dt, npdt in [(ht.float32, np.float32), (ht.float64, np.float64)]:
+            fi, nfi = ht.finfo(dt), np.finfo(npdt)
+            assert fi.bits == nfi.bits
+            np.testing.assert_allclose(float(fi.eps), float(nfi.eps))
+            np.testing.assert_allclose(float(fi.max), float(nfi.max))
+            np.testing.assert_allclose(float(fi.min), float(nfi.min))
+
+    def test_iinfo(self):
+        for dt, npdt in [(ht.int32, np.int32), (ht.int64, np.int64), (ht.uint8, np.uint8)]:
+            ii, nii = ht.iinfo(dt), np.iinfo(npdt)
+            assert ii.bits == nii.bits
+            assert int(ii.max) == int(nii.max)
+            assert int(ii.min) == int(nii.min)
+
+    def test_bfloat16_finfo(self):
+        fi = ht.finfo(ht.bfloat16)
+        assert fi.bits == 16
+
+
+class TestInterop:
+    def test_canonical_from_numpy_and_strings(self):
+        assert ht.canonical_heat_type(np.float32) == ht.float32
+        assert ht.canonical_heat_type("float32") == ht.float32
+        assert ht.canonical_heat_type(np.dtype("int64")) == ht.int64
+        assert ht.canonical_heat_type(float) in (ht.float32, ht.float64)
+        assert ht.canonical_heat_type(int) in (ht.int32, ht.int64)
+        assert ht.canonical_heat_type(bool) == ht.bool
+
+    def test_aliases(self):
+        assert ht.float_ == ht.float32 or ht.float_ == ht.float64
+        assert ht.half == ht.float16
+        assert ht.double == ht.float64
+        assert ht.byte == ht.int8
+        assert ht.ubyte == ht.uint8
+        assert ht.short == ht.int16
+        assert ht.csingle == ht.complex64
+
+    def test_heat_type_of(self):
+        assert ht.heat_type_of(np.zeros(3, np.float64)) == ht.float64
+        assert ht.heat_type_of(ht.ones(2, dtype=ht.int32)) == ht.int32
+
+
+class TestAstype:
+    def test_astype_across_splits(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4) + 0.7
+        for split in all_splits(2):
+            x = ht.array(a, split=split)
+            y = x.astype(ht.int32)
+            assert y.dtype == ht.int32
+            assert y.split == split
+            np.testing.assert_array_equal(y.numpy(), a.astype(np.int32))
+
+    def test_astype_bool(self):
+        a = np.array([0.0, 1.5, 0.0, -2.0], dtype=np.float32)
+        x = ht.array(a, split=0).astype(ht.bool)
+        np.testing.assert_array_equal(x.numpy(), a.astype(bool))
+
+    def test_type_constructor_call(self):
+        # heat types are callable as converters (reference datatype __call__)
+        x = ht.float32(np.array([1, 2, 3]))
+        assert x.dtype == ht.float32
