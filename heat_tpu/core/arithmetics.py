@@ -94,8 +94,10 @@ def cumsum(a: DNDarray, axis: int, dtype=None, out=None) -> DNDarray:
     return _operations._cum_op(a, jnp.cumsum, axis, 0, out, dtype)
 
 
-def diff(a: DNDarray, n: int = 1, axis: int = -1) -> DNDarray:
-    """n-th discrete difference along ``axis`` (reference ``:377``)."""
+def diff(a: DNDarray, n: int = 1, axis: int = -1, prepend=None, append=None) -> DNDarray:
+    """n-th discrete difference along ``axis``, with optional values
+    prepended/appended before differencing (reference ``:377``)."""
+    from .dndarray import DNDarray as _D
     from .stride_tricks import sanitize_axis
 
     if n == 0:
@@ -104,7 +106,11 @@ def diff(a: DNDarray, n: int = 1, axis: int = -1) -> DNDarray:
         raise ValueError(f"diff requires that n be a positive number, got {n}")
     axis = sanitize_axis(a.shape, axis)
     logical = a._logical()
-    res = jnp.diff(logical, n=n, axis=axis)
+    kwargs = {}
+    for name, val in (("prepend", prepend), ("append", append)):
+        if val is not None:
+            kwargs[name] = val._logical() if isinstance(val, _D) else jnp.asarray(val)
+    res = jnp.diff(logical, n=n, axis=axis, **kwargs)
     split = a.split
     if split is not None and res.shape[split] == 0:
         split = None
@@ -188,9 +194,11 @@ def pow(t1, t2, out=None, where=None) -> DNDarray:  # noqa: A001
 power = pow
 
 
-def prod(a: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:
+def prod(a: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:
     """Product reduction (reference ``:902``): local product + ``psum``-style
     all-multiply when the split axis is reduced."""
+    if keepdim is not None:  # reference/torch keyword name
+        keepdims = keepdim
     return _operations._reduce_op(a, jnp.prod, 1, axis=axis, out=out, keepdims=keepdims)
 
 
@@ -208,8 +216,10 @@ def sub(t1, t2, out=None, where=None) -> DNDarray:
 subtract = sub
 
 
-def sum(a: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:  # noqa: A001
+def sum(a: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:  # noqa: A001
     """Sum reduction (reference ``:946``): the canonical local-reduce +
     ``Allreduce`` stack of the reference (``_operations.py:440-445``) becomes
     one XLA program with a ``psum`` over the mesh."""
+    if keepdim is not None:  # reference/torch keyword name
+        keepdims = keepdim
     return _operations._reduce_op(a, jnp.sum, 0, axis=axis, out=out, keepdims=keepdims)

@@ -45,14 +45,14 @@ def log1p(x: DNDarray, out=None) -> DNDarray:
     return _operations._local_op(jnp.log1p, x, out)
 
 
-def logaddexp(t1, t2, out=None, where=None) -> DNDarray:
+def logaddexp(x1, x2, out=None, where=None) -> DNDarray:
     """log(exp(x1) + exp(x2)) (reference ``:250``)."""
-    return _operations._binary_op(jnp.logaddexp, t1, t2, out, where)
+    return _operations._binary_op(jnp.logaddexp, x1, x2, out, where)
 
 
-def logaddexp2(t1, t2, out=None, where=None) -> DNDarray:
+def logaddexp2(x1, x2, out=None, where=None) -> DNDarray:
     """log2(2**x1 + 2**x2) (reference ``:270``)."""
-    return _operations._binary_op(jnp.logaddexp2, t1, t2, out, where)
+    return _operations._binary_op(jnp.logaddexp2, x1, x2, out, where)
 
 
 def sqrt(x: DNDarray, out=None) -> DNDarray:

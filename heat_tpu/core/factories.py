@@ -16,7 +16,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import devices, types
+from . import devices, memory, types
 from .communication import sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis, sanitize_shape
@@ -118,6 +118,19 @@ def array(
         else:
             arr = jnp.asarray(obj)
         dtype = types.canonical_heat_type(arr.dtype)
+    # on a single CPU device jnp.asarray may zero-copy-alias the caller's
+    # NumPy buffer (alignment-dependent); honor copy=True with a real copy,
+    # but only when the buffer is actually shared — big device arrays that
+    # jax already copied shouldn't pay a second pass
+    if copy and isinstance(obj, np.ndarray) and arr.size:
+        try:
+            aliased = (
+                arr.addressable_data(0).unsafe_buffer_pointer() == obj.ctypes.data
+            )
+        except Exception:
+            aliased = True
+        if aliased:
+            arr = jnp.array(arr, copy=True)
 
     while arr.ndim < ndmin:
         arr = arr[jnp.newaxis]
@@ -129,6 +142,7 @@ def array(
 
 def asarray(obj, dtype=None, copy=None, order="C", is_split=None, device=None) -> DNDarray:
     """No-copy-when-possible array creation (reference ``factories.py:434``)."""
+    memory.sanitize_memory_order(order)
     return array(obj, dtype=dtype, copy=bool(copy), is_split=is_split, device=device)
 
 
@@ -188,21 +202,25 @@ def __factory(shape, dtype, split, device, comm, fill_tag, make) -> DNDarray:
 
 def empty(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
     """Uninitialized (here: zero) array (reference ``factories.py:488``)."""
+    memory.sanitize_memory_order(order)
     return __factory(shape, dtype, split, device, comm, "empty", lambda s, d: jnp.zeros(s, d))
 
 
 def zeros(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
     """Zeros (reference ``factories.py:1246``)."""
+    memory.sanitize_memory_order(order)
     return __factory(shape, dtype, split, device, comm, "zeros", lambda s, d: jnp.zeros(s, d))
 
 
 def ones(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
     """Ones (reference ``factories.py:1118``)."""
+    memory.sanitize_memory_order(order)
     return __factory(shape, dtype, split, device, comm, "ones", lambda s, d: jnp.ones(s, d))
 
 
 def full(shape, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
     """Constant fill (reference ``factories.py:786``)."""
+    memory.sanitize_memory_order(order)
     if dtype is None:
         dtype = types.heat_type_of(fill_value)
     fv = float(fill_value) if not isinstance(fill_value, complex) else fill_value
@@ -225,29 +243,31 @@ def __factory_like(a, dtype, split, device, comm, factory, **kwargs) -> DNDarray
     return factory(shape, dtype=dtype, split=split, device=device, comm=comm, **kwargs)
 
 
-def empty_like(a, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+def empty_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    memory.sanitize_memory_order(order)
     return __factory_like(a, dtype, split, device, comm, empty)
 
 
-def zeros_like(a, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+def zeros_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    memory.sanitize_memory_order(order)
     return __factory_like(a, dtype, split, device, comm, zeros)
 
 
-def ones_like(a, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+def ones_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    memory.sanitize_memory_order(order)
     return __factory_like(a, dtype, split, device, comm, ones)
 
 
-def full_like(a, fill_value, dtype=None, split=None, device=None, comm=None) -> DNDarray:
-    shape = a.shape if hasattr(a, "shape") else np.asarray(a).shape
-    if dtype is None:
-        dtype = a.dtype if isinstance(a, DNDarray) else types.heat_type_of(fill_value)
-    if split is None and isinstance(a, DNDarray):
-        split = a.split
-    return full(shape, fill_value, dtype=dtype, split=split, device=device, comm=comm)
+def full_like(a, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    memory.sanitize_memory_order(order)
+    if dtype is None and not isinstance(a, DNDarray):
+        dtype = types.heat_type_of(fill_value)
+    return __factory_like(a, dtype, split, device, comm, full, fill_value=fill_value)
 
 
-def eye(shape, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+def eye(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
     """Identity-like matrix (reference ``factories.py:586``)."""
+    memory.sanitize_memory_order(order)
     if isinstance(shape, (int, np.integer)):
         n, m = int(shape), int(shape)
     else:

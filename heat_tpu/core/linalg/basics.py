@@ -173,8 +173,9 @@ def inv(a: DNDarray) -> DNDarray:
     return DNDarray.from_logical(res, a.split, a.device, a.comm)
 
 
-def matrix_norm(a: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
+def matrix_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
     """Matrix norm (reference ``basics.py:1095``)."""
+    a = x
     if a.ndim < 2:
         raise ValueError("matrix_norm requires at least a 2-D array")
     if axis is None:
@@ -208,8 +209,9 @@ def matrix_norm(a: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DND
     raise ValueError(f"unsupported matrix norm order {ord}")
 
 
-def norm(a: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
+def norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
     """Vector/matrix norm dispatch (reference ``basics.py:1235``)."""
+    a = x
     if axis is None and a.ndim <= 1:
         return vector_norm(a, axis=None, keepdims=keepdims, ord=ord)
     if axis is None and ord is None:
@@ -223,8 +225,9 @@ def norm(a: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
     return matrix_norm(a, axis=axis, keepdims=keepdims, ord=ord)
 
 
-def vector_norm(a: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
+def vector_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
     """Vector norm (reference ``basics.py:1372``)."""
+    a = x
     from .. import exponential, logical
 
     if ord is None or ord == 2:
@@ -323,25 +326,27 @@ def _tri_op(a: DNDarray, k: int, op) -> DNDarray:
     return DNDarray(res, a.gshape, a.dtype, a.split, a.device, a.comm)
 
 
-def tril(a: DNDarray, k: int = 0) -> DNDarray:
+def tril(m: DNDarray, k: int = 0) -> DNDarray:
     """Lower-triangular part (reference ``basics.py:2213``)."""
-    return _tri_op(a, k, jnp.tril)
+    return _tri_op(m, k, jnp.tril)
 
 
-def triu(a: DNDarray, k: int = 0) -> DNDarray:
+def triu(m: DNDarray, k: int = 0) -> DNDarray:
     """Upper-triangular part (reference ``basics.py:2250``)."""
-    return _tri_op(a, k, jnp.triu)
+    return _tri_op(m, k, jnp.triu)
 
 
-def vdot(a: DNDarray, b: DNDarray) -> DNDarray:
+def vdot(x1: DNDarray, x2: DNDarray) -> DNDarray:
     """Conjugated dot product (reference ``basics.py:2290``)."""
     from .. import complex_math
 
-    return dot(complex_math.conj(a).flatten(), b.flatten())
+    return dot(complex_math.conj(x1).flatten(), x2.flatten())
 
 
-def vecdot(x1: DNDarray, x2: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
+def vecdot(x1: DNDarray, x2: DNDarray, axis=None, keepdims: bool = False, keepdim=None) -> DNDarray:
     """Vector dot along an axis (reference ``basics.py:2340``)."""
+    if keepdim is not None:  # reference/torch keyword name
+        keepdims = keepdim
     from .. import complex_math
 
     m = arithmetics.mul(complex_math.conj(x1), x2)

@@ -29,10 +29,12 @@ __all__ = [
 ]
 
 
-def all(x: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:  # noqa: A001
+def all(x: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:  # noqa: A001
     """Test whether all elements evaluate True (reference ``logical.py:38``):
     local reduce + ``Allreduce(LAND)`` in the reference, one fused reduce
     here."""
+    if keepdim is not None:  # reference/torch keyword name
+        keepdims = keepdim
     return _operations._reduce_op(
         x, lambda a, axis=None, keepdims=False: jnp.all(a != 0, axis=axis, keepdims=keepdims),
         1, axis=axis, out=out, keepdims=keepdims,
@@ -45,8 +47,10 @@ def allclose(x: DNDarray, y: DNDarray, rtol: float = 1e-05, atol: float = 1e-08,
     return bool(all(close).item())
 
 
-def any(x: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:  # noqa: A001
+def any(x: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:  # noqa: A001
     """Test whether any element evaluates True (reference ``:190``)."""
+    if keepdim is not None:  # reference/torch keyword name
+        keepdims = keepdim
     return _operations._reduce_op(
         x, lambda a, axis=None, keepdims=False: jnp.any(a != 0, axis=axis, keepdims=keepdims),
         0, axis=axis, out=out, keepdims=keepdims,
@@ -85,24 +89,24 @@ def isposinf(x: DNDarray, out=None) -> DNDarray:
     return _operations._local_op(jnp.isposinf, x, out)
 
 
-def logical_and(t1, t2) -> DNDarray:
+def logical_and(x, y) -> DNDarray:
     """Element-wise logical AND (reference ``:440``)."""
-    return _operations._binary_op(jnp.logical_and, t1, t2)
+    return _operations._binary_op(jnp.logical_and, x, y)
 
 
-def logical_not(t: DNDarray, out=None) -> DNDarray:
+def logical_not(x: DNDarray, out=None) -> DNDarray:
     """Element-wise logical NOT (reference ``:460``)."""
-    return _operations._local_op(jnp.logical_not, t, out)
+    return _operations._local_op(jnp.logical_not, x, out)
 
 
-def logical_or(t1, t2) -> DNDarray:
+def logical_or(x, y) -> DNDarray:
     """Element-wise logical OR (reference ``:480``)."""
-    return _operations._binary_op(jnp.logical_or, t1, t2)
+    return _operations._binary_op(jnp.logical_or, x, y)
 
 
-def logical_xor(t1, t2) -> DNDarray:
+def logical_xor(x, y) -> DNDarray:
     """Element-wise logical XOR (reference ``:500``)."""
-    return _operations._binary_op(jnp.logical_xor, t1, t2)
+    return _operations._binary_op(jnp.logical_xor, x, y)
 
 
 def signbit(x: DNDarray, out=None) -> DNDarray:

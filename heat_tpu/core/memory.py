@@ -6,7 +6,18 @@ import jax.numpy as jnp
 
 from .dndarray import DNDarray
 
-__all__ = ["copy", "sanitize_memory_layout"]
+__all__ = ["copy", "sanitize_memory_layout", "sanitize_memory_order"]
+
+
+def sanitize_memory_order(order: str) -> str:
+    """Validate an ``order=`` keyword without an array (factory signatures
+    carry it for reference parity, ``factories.py:488-1322``). ``C``/``K``/
+    ``A`` all mean the row-major layout XLA owns; ``F`` is rejected."""
+    if order not in ("C", "F", "K", "A"):
+        raise ValueError(f"order must be one of 'C', 'F', 'K', 'A', got {order!r}")
+    if order == "F":
+        raise NotImplementedError("column-major layout is not supported on the XLA backend")
+    return order
 
 
 def copy(x: DNDarray) -> DNDarray:
@@ -22,10 +33,5 @@ def sanitize_memory_layout(x, order: str = "C"):
     XLA owns physical layout on TPU; only the default row-major view is
     meaningful, so ``order='F'`` is rejected rather than silently ignored.
     """
-    if order == "K":
-        raise NotImplementedError("Internal usage of torch.clone() means losing original memory layout for now.")
-    if order not in ("C", "F"):
-        raise ValueError(f"order must be 'C' or 'F', got {order}")
-    if order == "F":
-        raise NotImplementedError("column-major layout is not supported on the XLA backend")
+    sanitize_memory_order(order)
     return x

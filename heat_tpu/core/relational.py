@@ -10,66 +10,66 @@ from .dndarray import DNDarray
 __all__ = ["eq", "equal", "ge", "greater_equal", "gt", "greater", "le", "less_equal", "lt", "less", "ne", "not_equal"]
 
 
-def eq(t1, t2) -> DNDarray:
+def eq(x, y) -> DNDarray:
     """Element-wise == (reference ``relational.py:35``)."""
-    return _operations._binary_op(jnp.equal, t1, t2)
+    return _operations._binary_op(jnp.equal, x, y)
 
 
-def equal(t1, t2) -> bool:
+def equal(x, y) -> bool:
     """Global three-way equality: True iff all elements equal (reference ``:85``,
     implemented there as a local test + ``Allreduce(LAND)``; here the psum is
     implicit in the global ``all``)."""
     from . import logical
     from .stride_tricks import broadcast_shape
 
-    if not isinstance(t1, DNDarray) and not isinstance(t2, DNDarray):
-        return bool(jnp.all(jnp.equal(jnp.asarray(t1), jnp.asarray(t2))))
+    if not isinstance(x, DNDarray) and not isinstance(y, DNDarray):
+        return bool(jnp.all(jnp.equal(jnp.asarray(x), jnp.asarray(y))))
     try:
         broadcast_shape(
-            t1.shape if isinstance(t1, DNDarray) else jnp.shape(t1),
-            t2.shape if isinstance(t2, DNDarray) else jnp.shape(t2),
+            x.shape if isinstance(x, DNDarray) else jnp.shape(x),
+            y.shape if isinstance(y, DNDarray) else jnp.shape(y),
         )
     except ValueError:
         return False
-    result = eq(t1, t2)
+    result = eq(x, y)
     return bool(logical.all(result).item())
 
 
-def ge(t1, t2) -> DNDarray:
+def ge(x, y) -> DNDarray:
     """Element-wise >= (reference ``:131``)."""
-    return _operations._binary_op(jnp.greater_equal, t1, t2)
+    return _operations._binary_op(jnp.greater_equal, x, y)
 
 
 greater_equal = ge
 
 
-def gt(t1, t2) -> DNDarray:
+def gt(x, y) -> DNDarray:
     """Element-wise > (reference ``:189``)."""
-    return _operations._binary_op(jnp.greater, t1, t2)
+    return _operations._binary_op(jnp.greater, x, y)
 
 
 greater = gt
 
 
-def le(t1, t2) -> DNDarray:
+def le(x, y) -> DNDarray:
     """Element-wise <= (reference ``:247``)."""
-    return _operations._binary_op(jnp.less_equal, t1, t2)
+    return _operations._binary_op(jnp.less_equal, x, y)
 
 
 less_equal = le
 
 
-def lt(t1, t2) -> DNDarray:
+def lt(x, y) -> DNDarray:
     """Element-wise < (reference ``:305``)."""
-    return _operations._binary_op(jnp.less, t1, t2)
+    return _operations._binary_op(jnp.less, x, y)
 
 
 less = lt
 
 
-def ne(t1, t2) -> DNDarray:
+def ne(x, y) -> DNDarray:
     """Element-wise != (reference ``:363``)."""
-    return _operations._binary_op(jnp.not_equal, t1, t2)
+    return _operations._binary_op(jnp.not_equal, x, y)
 
 
 not_equal = ne

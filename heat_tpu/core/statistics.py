@@ -271,8 +271,10 @@ def _central_moment(x: DNDarray, k: int, axis):
     return mean(powed, axis)
 
 
-def max(x: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:  # noqa: A001
+def max(x: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:  # noqa: A001
     """Maximum reduction (reference ``statistics.py:900``)."""
+    if keepdim is not None:  # reference/torch keyword name
+        keepdims = keepdim
     return _operations._reduce_op(x, jnp.max, _max_neutral(x), axis=axis, out=out, keepdims=keepdims)
 
 
@@ -292,13 +294,17 @@ def mean(x: DNDarray, axis=None) -> DNDarray:
     return arithmetics.div(s, float(n) if n else 1.0)
 
 
-def median(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
+def median(x: DNDarray, axis=None, keepdims: bool = False, keepdim=None) -> DNDarray:
     """Median (reference ``statistics.py:867``) — 50th percentile."""
+    if keepdim is not None:  # reference/torch keyword name
+        keepdims = keepdim
     return percentile(x, 50.0, axis=axis, keepdims=keepdims)
 
 
-def min(x: DNDarray, axis=None, out=None, keepdims=False) -> DNDarray:  # noqa: A001
+def min(x: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:  # noqa: A001
     """Minimum reduction (reference ``statistics.py:1050``)."""
+    if keepdim is not None:  # reference/torch keyword name
+        keepdims = keepdim
     return _operations._reduce_op(x, jnp.min, _min_neutral(x), axis=axis, out=out, keepdims=keepdims)
 
 
@@ -307,12 +313,14 @@ def minimum(x1, x2, out=None) -> DNDarray:
     return _operations._binary_op(jnp.minimum, x1, x2, out)
 
 
-def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False) -> DNDarray:
+def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False, keepdim=None) -> DNDarray:
     """q-th percentile (reference ``statistics.py:1256``).
 
     Gather-based: percentiles are order statistics with data-dependent
     communication; the logical array is materialized and reduced by XLA.
     """
+    if keepdim is not None:  # reference/torch keyword name
+        keepdims = keepdim
     logical = x._logical()
     qa = jnp.asarray(q, dtype=jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
     axis_s = sanitize_axis(x.shape, axis)

@@ -64,9 +64,9 @@ def hypot(t1, t2) -> DNDarray:
     return _operations._binary_op(jnp.hypot, t1, t2)
 
 
-def arctan2(t1, t2) -> DNDarray:
+def arctan2(x1, x2) -> DNDarray:
     """Element-wise two-argument arctangent (reference ``:200``)."""
-    return _operations._binary_op(jnp.arctan2, t1, t2)
+    return _operations._binary_op(jnp.arctan2, x1, x2)
 
 
 atan2 = arctan2

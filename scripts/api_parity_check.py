@@ -69,6 +69,75 @@ def reference_dndarray_methods(ref_root: str):
     return methods
 
 
+def reference_signatures(ref_root: str, names):
+    """name -> ordered parameter-name list, statically parsed. Only records
+    defs found in the file that *exports* the name via ``__all__`` (the
+    ``names`` map from :func:`reference_exports`), so same-named private
+    helpers in other files cannot shadow the public signature."""
+    sigs = {}
+    for name, rel in names.items():
+        path = os.path.join(ref_root, rel)
+        try:
+            tree = ast.parse(open(path).read())
+        except (OSError, SyntaxError):
+            continue
+        for node in tree.body:
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == name
+            ):
+                a = node.args
+                params = [p.arg for p in a.posonlyargs + a.args]
+                if a.vararg:
+                    params.append("*" + a.vararg.arg)
+                params += [p.arg for p in a.kwonlyargs]
+                if a.kwarg:
+                    params.append("**" + a.kwarg.arg)
+                sigs[name] = params
+    return sigs
+
+
+def signature_drift(names, ref_sigs, search_modules):
+    """Compare reference parameter names against ours for shared callables.
+
+    Only reports DROPPED reference parameters (we may add TPU-specific
+    keywords freely; a missing reference kwarg breaks migrating user code).
+    """
+    import inspect
+
+    drift = []
+    for name in sorted(names):
+        if name not in ref_sigs:
+            continue
+        obj = None
+        for m in search_modules:
+            obj = getattr(m, name, None)
+            if callable(obj):
+                break
+        if obj is None or not callable(obj):
+            continue
+        try:
+            mine = [
+                ("*" if p.kind is inspect.Parameter.VAR_POSITIONAL else
+                 "**" if p.kind is inspect.Parameter.VAR_KEYWORD else "") + p.name
+                for p in inspect.signature(obj).parameters.values()
+            ]
+        except (ValueError, TypeError):
+            continue
+        mine_clean = {p.lstrip("*") for p in mine}
+        has_kwargs = any(p.startswith("**") for p in mine)
+        dropped = [
+            p for p in ref_sigs[name]
+            if not p.startswith("*")
+            and p not in mine_clean
+            and not has_kwargs
+            and p != "self"
+        ]
+        if dropped:
+            drift.append((name, dropped, ref_sigs[name], mine))
+    return drift
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--reference", default="/root/reference/heat")
@@ -114,7 +183,13 @@ def main() -> int:
     print(f"reference DNDarray methods: {len(ref_methods)}; missing: {len(missing_methods)}")
     for m in missing_methods:
         print(f"  MISSING METHOD  DNDarray.{m}")
-    return 1 if (missing or missing_methods) else 0
+
+    ref_sigs = reference_signatures(args.reference, names)
+    drift = signature_drift(names, ref_sigs, search_modules)
+    print(f"signature drift (dropped reference params): {len(drift)}")
+    for name, dropped, ref_p, my_p in drift:
+        print(f"  DRIFT  {name}: dropped {dropped}  (ref {ref_p} -> ours {my_p})")
+    return 1 if (missing or missing_methods or drift) else 0
 
 
 if __name__ == "__main__":

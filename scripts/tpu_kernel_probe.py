@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Bisect + A/B harness for the fused KMeans Pallas kernel on a real TPU.
+
+The kernel compiles and validates in interpreter mode (CPU test mesh), but
+on v5e Mosaic reported a scoped-VMEM stack OOM (~66M against a 16M limit)
+once the one-hot update GEMM (contraction over the row-block dim) is
+included; the XLA Lloyd path then serves the benchmark. This script, run on
+the real chip, isolates which kernel stage triggers the allocation and
+times kernel-vs-XLA at bench size.
+
+Usage (repo root, real TPU):
+    python scripts/tpu_kernel_probe.py bisect       # per-stage compile check
+    python scripts/tpu_kernel_probe.py ab           # XLA vs Pallas iter/s
+
+Per the verify notes: first TPU run after a tunnel incident must be tiny —
+`bisect` uses n=64k and 2-minute timeouts per stage.
+"""
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, ".")
+import heat_tpu as ht  # noqa: E402  (x64 + matmul-precision config)
+
+
+def _i32(v):
+    return jnp.asarray(v, jnp.int32)
+
+
+def bisect(n=1 << 16, d=64, kp=128, bm=1024):
+    acc = jnp.float32
+    PREC = jax.lax.Precision.DEFAULT
+
+    def kern(x_ref, c_ref, m_ref, s_ref, a_s, *, sub):
+        step = pl.program_id(0)
+        nsteps = pl.num_programs(0)
+
+        @pl.when(step == 0)
+        def _():
+            a_s[...] = jnp.zeros_like(a_s)
+
+        x = x_ref[...].astype(acc)
+        c = c_ref[...].astype(acc)
+        valid = m_ref[...].astype(acc)
+        c2 = jnp.sum(c * c, axis=1)[None, :]
+        xc = jax.lax.dot_general(
+            x, c, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=acc, precision=PREC)
+        scores = c2 - 2.0 * xc
+        if sub == "scores":
+            a_s[...] += jnp.zeros_like(a_s) + jnp.sum(scores)
+        else:
+            labels = jax.lax.argmin(scores, 1, jnp.int32)
+            if sub == "argmin":
+                a_s[...] += jnp.zeros_like(a_s) + jnp.sum(labels.astype(acc))
+            else:
+                onehot = (labels[:, None] == jax.lax.broadcasted_iota(
+                    jnp.int32, (bm, kp), 1)).astype(acc) * valid
+                if sub == "onehot":
+                    a_s[...] += jnp.zeros_like(a_s) + jnp.sum(onehot)
+                elif sub == "counts":
+                    a_s[...] += jnp.broadcast_to(
+                        jnp.sum(onehot, axis=0, keepdims=True), a_s.shape)
+                elif sub == "dot_rev":
+                    a_s[...] += jax.lax.dot_general(
+                        onehot, x, dimension_numbers=(((0,), (0,)), ((), ())),
+                        preferred_element_type=acc, precision=PREC)
+                elif sub == "dot_via_transpose":
+                    a_s[...] += jax.lax.dot_general(
+                        onehot.T, x, dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=acc, precision=PREC)
+
+        @pl.when(step == nsteps - 1)
+        def _():
+            s_ref[...] = a_s[...].astype(s_ref.dtype)
+
+    x = jnp.ones((n, d), jnp.float32)
+    c = jnp.ones((kp, d), jnp.float32)
+    m = jnp.ones((n, 1), jnp.float32)
+    for sub in ("scores", "argmin", "onehot", "counts", "dot_rev",
+                "dot_via_transpose"):
+        try:
+            out = pl.pallas_call(
+                functools.partial(kern, sub=sub),
+                grid=(n // bm,),
+                in_specs=[
+                    pl.BlockSpec((bm, d), lambda i: (_i32(i), _i32(0))),
+                    pl.BlockSpec((kp, d), lambda i: (_i32(0), _i32(0))),
+                    pl.BlockSpec((bm, 1), lambda i: (_i32(i), _i32(0))),
+                ],
+                out_specs=[pl.BlockSpec((kp, d), lambda i: (_i32(0), _i32(0)))],
+                out_shape=[jax.ShapeDtypeStruct((kp, d), acc)],
+                scratch_shapes=[pltpu.VMEM((kp, d), acc)],
+            )(x, c, m)
+            jax.block_until_ready(out)
+            print(sub, "OK", flush=True)
+        except Exception as e:  # noqa: BLE001 — report and continue bisecting
+            print(sub, "FAIL:", str(e)[:160].replace("\n", " "), flush=True)
+
+
+def ab(n=1 << 23, d=64, k=8, iters=50):
+    from heat_tpu.cluster.kmeans import _lloyd_fori_fn
+    from heat_tpu.core import pallas_kernels as pk
+
+    ht.random.seed(0)
+    x = ht.random.rand(n, d, dtype=ht.float32, split=0)
+    xp = x.larray
+
+    def run(pallas):
+        pk.set_pallas(pallas)
+        fn = _lloyd_fori_fn(xp.shape, xp.dtype, k, n, x.comm)
+        c0 = xp[:k]
+        fn(xp, c0, 2)[1].item()
+        t0 = time.perf_counter()
+        fn(xp, c0, 2)[1].item()
+        t1 = time.perf_counter()
+        fn(xp, c0, 2 + iters)[1].item()
+        t2 = time.perf_counter()
+        return iters / ((t2 - t1) - (t1 - t0))
+
+    for pallas in (False, True, False, True):
+        try:
+            print("pallas", pallas, "iter/s:", round(run(pallas), 1), flush=True)
+        except Exception as e:  # noqa: BLE001
+            print("pallas", pallas, "FAILED:", str(e)[:160].replace("\n", " "),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "bisect"
+    (bisect if mode == "bisect" else ab)()

@@ -295,3 +295,37 @@ def test_nan_propagation_logical():
         assert_array_equal(ht.isfinite(a), np.isfinite(x))
         assert_array_equal(ht.isposinf(a), np.isposinf(x))
         assert_array_equal(ht.isneginf(a), np.isneginf(x))
+
+
+class TestReferenceKeywordParity:
+    """Reference (torch-style) keyword names must keep working: ``keepdim``
+    on reductions (reference ``arithmetics.py``/``statistics.py``
+    signatures) and reference positional parameter names by keyword."""
+
+    def test_keepdim_alias(self):
+        import numpy as np
+
+        a = ht.array(np.arange(12, dtype=np.float32).reshape(3, 4), split=0)
+        assert ht.sum(a, axis=0, keepdim=True).shape == (1, 4)
+        assert ht.prod(a + 1, axis=1, keepdim=True).shape == (3, 1)
+        assert ht.max(a, axis=0, keepdim=True).shape == (1, 4)
+        assert ht.min(a, axis=1, keepdim=True).shape == (3, 1)
+        assert ht.all(a > -1, axis=0, keepdim=True).shape == (1, 4)
+        assert ht.any(a > 5, axis=1, keepdim=True).shape == (3, 1)
+
+    def test_reference_keyword_names(self):
+        import numpy as np
+
+        a = ht.array(np.arange(6, dtype=np.float32).reshape(2, 3), split=0)
+        assert ht.eq(x=a, y=a).numpy().all()
+        assert not ht.ne(x=a, y=a).numpy().any()
+        assert ht.le(x=a, y=a).numpy().all()
+        np.testing.assert_allclose(
+            ht.arctan2(x1=a, x2=a + 1).numpy(), np.arctan2(a.numpy(), a.numpy() + 1),
+            rtol=1e-5)
+        sq = ht.ones((3, 3))
+        assert ht.tril(m=sq).numpy().sum() == 6
+        assert ht.triu(m=sq).numpy().sum() == 6
+        np.testing.assert_allclose(
+            float(np.asarray(ht.vdot(x1=ht.arange(3, dtype=ht.float32),
+                                     x2=ht.arange(3, dtype=ht.float32)))), 5.0)
