@@ -784,6 +784,150 @@ class DNDarray:
 
         return rounding.clip(self, a_min, a_max, out)
 
+    # -- reference method attachments (``DNDarray.x = ...`` throughout the
+    # reference's op modules, e.g. ``rounding.py:120``, ``basics.py:2210``) --
+    def absolute(self, out=None, dtype=None):
+        from . import rounding
+
+        return rounding.abs(self, out, dtype)
+
+    def acos(self, out=None):
+        from . import trigonometrics
+
+        return trigonometrics.arccos(self, out)
+
+    def asin(self, out=None):
+        from . import trigonometrics
+
+        return trigonometrics.arcsin(self, out)
+
+    def atan(self, out=None):
+        from . import trigonometrics
+
+        return trigonometrics.arctan(self, out)
+
+    def atan2(self, x2):
+        from . import trigonometrics
+
+        return trigonometrics.arctan2(self, x2)
+
+    def allclose(self, other, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False):
+        from . import logical
+
+        return logical.allclose(self, other, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+    def isclose(self, other, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False):
+        from . import logical
+
+        return logical.isclose(self, other, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+    def average(self, axis=None, weights=None, returned: bool = False):
+        from . import statistics
+
+        return statistics.average(self, axis=axis, weights=weights, returned=returned)
+
+    def ceil(self, out=None):
+        from . import rounding
+
+        return rounding.ceil(self, out)
+
+    def floor(self, out=None):
+        from . import rounding
+
+        return rounding.floor(self, out)
+
+    def trunc(self, out=None):
+        from . import rounding
+
+        return rounding.trunc(self, out)
+
+    def round(self, decimals: int = 0, out=None, dtype=None):
+        from . import rounding
+
+        return rounding.round(self, decimals, out, dtype)
+
+    def fabs(self, out=None):
+        from . import rounding
+
+        return rounding.fabs(self, out)
+
+    def modf(self, out=None):
+        from . import rounding
+
+        return rounding.modf(self, out)
+
+    def sign(self, out=None):
+        from . import rounding
+
+        return rounding.sign(self, out)
+
+    def sgn(self, out=None):
+        from . import rounding
+
+        return rounding.sgn(self, out)
+
+    def tan(self, out=None):
+        from . import trigonometrics
+
+        return trigonometrics.tan(self, out)
+
+    def sinh(self, out=None):
+        from . import trigonometrics
+
+        return trigonometrics.sinh(self, out)
+
+    def cosh(self, out=None):
+        from . import trigonometrics
+
+        return trigonometrics.cosh(self, out)
+
+    def tanh(self, out=None):
+        from . import trigonometrics
+
+        return trigonometrics.tanh(self, out)
+
+    def kurtosis(self, axis=None, unbiased: bool = True, Fischer: bool = True):
+        from . import statistics
+
+        return statistics.kurtosis(self, axis=axis, unbiased=unbiased, Fischer=Fischer)
+
+    def skew(self, axis=None, unbiased: bool = True):
+        from . import statistics
+
+        return statistics.skew(self, axis=axis, unbiased=unbiased)
+
+    def median(self, axis=None, keepdim: bool = False, keepdims=None):
+        from . import statistics
+
+        return statistics.median(
+            self, axis=axis, keepdims=keepdim if keepdims is None else keepdims)
+
+    def norm(self):
+        from .linalg import norm as _norm
+
+        return _norm(self)
+
+    def qr(self, tiles_per_proc: int = 1, calc_q: bool = True, overwrite_a: bool = False):
+        from .linalg import qr as _qr
+
+        return _qr(self, tiles_per_proc=tiles_per_proc, calc_q=calc_q,
+                   overwrite_a=overwrite_a)
+
+    def trace(self, offset: int = 0, axis1: int = 0, axis2: int = 1, dtype=None, out=None):
+        from .linalg import trace as _trace
+
+        return _trace(self, offset=offset, axis1=axis1, axis2=axis2, dtype=dtype, out=out)
+
+    def tril(self, k: int = 0):
+        from .linalg import tril as _tril
+
+        return _tril(self, k)
+
+    def triu(self, k: int = 0):
+        from .linalg import triu as _triu
+
+        return _triu(self, k)
+
     def copy(self):
         from . import memory
 

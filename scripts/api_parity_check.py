@@ -64,7 +64,9 @@ def reference_dndarray_methods(ref_root: str):
         for fname in files:
             if fname.endswith(".py"):
                 src = open(os.path.join(root, fname)).read()
-                for m in re.finditer(r"^DNDarray\.(\w+)\s*=", src, re.M):
+                # plain and type-annotated assignments, including multi-line
+                # annotations: DNDarray.x = ... / DNDarray.x: Callable[ ...
+                for m in re.finditer(r"^DNDarray\.(\w+)\s*[:=]", src, re.M):
                     methods.add(m.group(1))
     return methods
 
