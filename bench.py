@@ -115,14 +115,54 @@ def _pallas_kmeans_safe() -> bool:
         return False
 
 
+def _require_live_backend(timeout_s: float = 600.0) -> None:
+    """Fail fast (non-zero exit, clear stderr) when the TPU tunnel is wedged.
+
+    A killed TPU job can wedge the remote tunnel so that the FIRST backend
+    touch blocks indefinitely in every process; probing ``jax.devices`` in a
+    daemon thread bounds the wait so the driver sees a diagnosable failure
+    instead of an infinite hang."""
+    import os
+    import sys
+    import threading
+
+    result: list = []
+    error: list = []
+
+    def probe():
+        try:
+            import jax
+
+            result.append(jax.devices())
+        except BaseException as exc:  # noqa: BLE001 — reported to stderr below
+            error.append(exc)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if error:
+        sys.stderr.write(f"bench: jax backend failed to initialize: {error[0]!r}\n")
+        os._exit(4)
+    if not result:
+        sys.stderr.write(
+            f"bench: jax backend did not come up within {timeout_s:.0f}s — the "
+            "accelerator runtime/tunnel looks hung; restart it (or check device "
+            "ownership) and re-run. Aborting instead of hanging.\n"
+        )
+        os._exit(3)
+
+
 def main() -> None:
     n = 1 << 23  # 8.4M points × 64 features ≈ 2.1 GB float32
     n_torch = 1 << 19  # small torch sample, extrapolated linearly
 
     import os
 
+    # subprocess probe FIRST: it must be the first backend touch (exclusive
+    # TPUs admit one client), and it is itself time-bounded
     if os.environ.get("HEAT_TPU_PALLAS") is None and not _pallas_kmeans_safe():
         os.environ["HEAT_TPU_PALLAS"] = "0"  # read before heat_tpu import below
+    _require_live_backend()
 
     ips = tpu_kmeans_iter_per_s(n)
     t_torch_small = torch_kmeans_time_per_iter(n_torch)
