@@ -114,3 +114,68 @@ class TestSetitem:
             x[0, 0] = 5
             assert x.split == split
             assert x.dtype == ht.float32
+
+
+class TestAdvancedMixes:
+    """Mixed advanced-indexing keys (reference ``dndarray.py:656-912`` hardest
+    cases): integer arrays combined with slices/ints, index-pair selection,
+    full boolean masks."""
+
+    a = np.arange(120, dtype=np.float32).reshape(6, 5, 4)
+
+    def _check(self, key):
+        expected = self.a[key]
+        for split in all_splits(3):
+            x = ht.array(self.a, split=split)
+            out = x[key]
+            if isinstance(out, ht.DNDarray):
+                assert_array_equal(out, expected, rtol=1e-6)  # exact shape too
+            else:
+                got = np.asarray(out)
+                assert got.shape == expected.shape
+                np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+    def test_intarray_then_int(self):
+        self._check((np.array([0, 2, 4]), 2))
+
+    def test_intarray_then_slice(self):
+        self._check((np.array([1, 3]), slice(1, 4)))
+
+    def test_slice_then_intarray(self):
+        self._check((slice(None), np.array([0, 3])))
+
+    def test_two_intarrays_paired(self):
+        self._check((np.array([0, 2, 5]), np.array([1, 1, 3])))
+
+    def test_three_intarrays_paired(self):
+        self._check((np.array([0, 2]), np.array([1, 4]), np.array([3, 0])))
+
+    def test_full_boolean_mask(self):
+        mask = self.a > 60
+        self._check(mask)
+
+    def test_boolean_mask_2d_with_int(self):
+        mask = np.zeros((6, 5), bool)
+        mask[1, 2] = mask[4, 0] = True
+        self._check((mask, 3))
+
+    def test_negative_int_arrays(self):
+        self._check((np.array([-1, -3]),))
+
+    def test_setitem_with_int_array(self):
+        idx = np.array([0, 3])
+        for split in all_splits(3):
+            x = ht.array(self.a, split=split)
+            x[idx] = -1.0
+            b = self.a.copy()
+            b[idx] = -1.0
+            np.testing.assert_allclose(x.numpy(), b, rtol=1e-6)
+
+    def test_setitem_boolean_mask(self):
+        mask = self.a > 100
+        for split in all_splits(3):
+            x = ht.array(self.a, split=split)
+            x[mask] = 0.0
+            b = self.a.copy()
+            b[mask] = 0.0
+            np.testing.assert_allclose(x.numpy(), b, rtol=1e-6)

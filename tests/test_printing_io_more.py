@@ -1,0 +1,99 @@
+"""Printing modes and I/O option depth (reference ``test_printing.py``,
+``test_io.py``)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+class TestPrinting:
+    def test_repr_contains_metadata(self):
+        x = ht.arange(5, split=0)
+        r = repr(x)
+        assert "DNDarray" in r and "dtype=ht.int64" in r and "split=0" in r
+
+    def test_str_of_split_matches_replicated(self):
+        # gathered content printed by a split array must equal the replicated
+        # array's printout (reference global_printing semantics); only the
+        # split metadata tag may differ
+        a = np.arange(20, dtype=np.float32).reshape(4, 5)
+        s_split = str(ht.array(a, split=0))
+        s_repl = str(ht.array(a))
+        assert s_split.replace("split=0", "split=None") == s_repl
+
+    def test_summarized_large_array(self):
+        x = ht.arange(100000, split=0)
+        r = repr(x)
+        assert "..." in r  # numpy-style summarization
+        assert len(r) < 2000
+
+    def test_local_global_modes_roundtrip(self):
+        x = ht.arange(8, split=0)
+        ht.local_printing()
+        local = str(x)
+        ht.global_printing()
+        glob = str(x)
+        assert isinstance(local, str) and isinstance(glob, str)
+
+    def test_print0(self, capsys):
+        ht.print0("zzz", 1, sep="-")
+        out = capsys.readouterr().out
+        assert "zzz" in out
+
+    def test_set_get_printoptions(self):
+        try:
+            ht.set_printoptions(precision=3, threshold=10)
+            opts = ht.get_printoptions()
+            assert opts["precision"] == 3
+        finally:
+            ht.set_printoptions(profile="default")
+
+
+class TestIOOptions:
+    def test_csv_sep_and_dtype(self, tmp_path):
+        p = str(tmp_path / "sep.csv")
+        with open(p, "w") as f:
+            f.write("1;2;3\n4;5;6\n")
+        x = ht.load_csv(p, sep=";")
+        np.testing.assert_allclose(x.numpy(), [[1, 2, 3], [4, 5, 6]])
+
+    def test_csv_split_column(self, tmp_path):
+        data = np.random.default_rng(3).random((6, 8)).astype(np.float32)
+        p = str(tmp_path / "c.csv")
+        ht.save_csv(ht.array(data), p)
+        y = ht.load_csv(p, split=1)
+        assert y.split == 1
+        np.testing.assert_allclose(y.numpy(), data, rtol=1e-4, atol=1e-5)
+
+    def test_hdf5_multiple_datasets(self, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        p = str(tmp_path / "multi.h5")
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        b = np.arange(10, dtype=np.float32)
+        with h5py.File(p, "w") as f:
+            f["a"] = a
+            f["b"] = b
+        np.testing.assert_allclose(ht.load_hdf5(p, "a").numpy(), a)
+        np.testing.assert_allclose(ht.load_hdf5(p, "b", split=0).numpy(), b)
+
+    def test_save_load_dispatch_by_extension(self, tmp_path):
+        pytest.importorskip("h5py")
+        a = np.arange(6, dtype=np.float32)
+        p = str(tmp_path / "x.h5")
+        ht.save(ht.array(a, split=0), p, "data")
+        y = ht.load(p, dataset="data")
+        np.testing.assert_allclose(y.numpy(), a)
+
+    def test_save_csv_roundtrip_int(self, tmp_path):
+        a = np.arange(12).reshape(4, 3)
+        p = str(tmp_path / "i.csv")
+        ht.save_csv(ht.array(a, split=0), p)
+        y = ht.load_csv(p)
+        np.testing.assert_allclose(y.numpy().astype(int), a)
+
+    def test_load_npy_single_file(self, tmp_path):
+        a = np.random.default_rng(0).random((5, 2)).astype(np.float32)
+        np.save(tmp_path / "one.npy", a)
+        y = ht.io.load_npy_from_path(str(tmp_path), split=0)
+        np.testing.assert_allclose(y.numpy(), a)
