@@ -329,3 +329,29 @@ class TestReferenceKeywordParity:
         np.testing.assert_allclose(
             float(np.asarray(ht.vdot(x1=ht.arange(3, dtype=ht.float32),
                                      x2=ht.arange(3, dtype=ht.float32)))), 5.0)
+
+
+class TestWhereKeyword:
+    """``where=`` masking in the op engine (reference ``_operations.py:24``:
+    requires ``out=``; unmasked positions keep out's prior values)."""
+
+    def test_where_with_out(self):
+        import numpy as np
+
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        m = (a % 2 == 0)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            out = ht.full((3, 4), -5.0, dtype=ht.float32, split=split)
+            r = ht.add(x, 10, out=out, where=ht.array(m, split=split))
+            expected = np.full((3, 4), -5.0, np.float32)
+            np.add(a, 10, out=expected, where=m)
+            np.testing.assert_allclose(r.numpy(), expected, rtol=1e-6)
+
+    def test_where_without_out_raises(self):
+        import numpy as np
+        import pytest
+
+        a = ht.ones((2, 2))
+        with pytest.raises(ValueError):
+            ht.add(a, 1, where=a > 0)
