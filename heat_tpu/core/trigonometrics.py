@@ -24,6 +24,7 @@ acos = arccos
 
 
 def arccosh(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise inverse hyperbolic cosine (reference ``trigonometrics.py:78``)."""
     return _operations._local_op(jnp.arccosh, x, out)
 
 
@@ -31,6 +32,7 @@ acosh = arccosh
 
 
 def arcsin(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise inverse sine (reference ``trigonometrics.py:104``)."""
     return _operations._local_op(jnp.arcsin, x, out)
 
 
@@ -38,6 +40,7 @@ asin = arcsin
 
 
 def arcsinh(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise inverse hyperbolic sine (reference ``trigonometrics.py:136``)."""
     return _operations._local_op(jnp.arcsinh, x, out)
 
 
@@ -45,6 +48,7 @@ asinh = arcsinh
 
 
 def arctan(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise inverse tangent (reference ``trigonometrics.py:162``)."""
     return _operations._local_op(jnp.arctan, x, out)
 
 
@@ -52,6 +56,7 @@ atan = arctan
 
 
 def arctanh(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise inverse hyperbolic tangent (reference ``trigonometrics.py:230``)."""
     return _operations._local_op(jnp.arctanh, x, out)
 
 
@@ -73,14 +78,17 @@ atan2 = arctan2
 
 
 def cos(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise cosine (reference ``trigonometrics.py:256``)."""
     return _operations._local_op(jnp.cos, x, out)
 
 
 def cosh(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise hyperbolic cosine (reference ``trigonometrics.py:282``)."""
     return _operations._local_op(jnp.cosh, x, out)
 
 
 def deg2rad(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise degrees to radians (reference ``trigonometrics.py:310``)."""
     return _operations._local_op(jnp.deg2rad, x, out)
 
 
@@ -88,6 +96,7 @@ radians = deg2rad
 
 
 def rad2deg(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise radians to degrees (reference ``trigonometrics.py:350``)."""
     return _operations._local_op(jnp.rad2deg, x, out)
 
 
@@ -95,16 +104,20 @@ degrees = rad2deg
 
 
 def sin(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise sine (reference ``trigonometrics.py:390``)."""
     return _operations._local_op(jnp.sin, x, out)
 
 
 def sinh(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise hyperbolic sine (reference ``trigonometrics.py:418``)."""
     return _operations._local_op(jnp.sinh, x, out)
 
 
 def tan(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise tangent (reference ``trigonometrics.py:446``)."""
     return _operations._local_op(jnp.tan, x, out)
 
 
 def tanh(x: DNDarray, out=None) -> DNDarray:
+    """Element-wise hyperbolic tangent (reference ``trigonometrics.py:475``)."""
     return _operations._local_op(jnp.tanh, x, out)
