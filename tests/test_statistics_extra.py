@@ -161,3 +161,14 @@ def test_maximum_minimum_nan_propagation():
         want = np.maximum(a, b)
         np.testing.assert_array_equal(np.isnan(out), np.isnan(want))
         np.testing.assert_allclose(out[~np.isnan(out)], want[~np.isnan(want)])
+
+
+def test_percentile_interpolation_modes():
+    a = np.random.default_rng(5).random(37).astype(np.float64)
+    for split in all_splits(1):
+        x = ht.array(a, split=split)
+        for interp in ("linear", "lower", "higher", "midpoint", "nearest"):
+            for q in (10, 47.5, 90):
+                want = np.percentile(a, q, method=interp)
+                got = float(np.asarray(ht.percentile(x, q, interpolation=interp)))
+                np.testing.assert_allclose(got, want, rtol=1e-12)
