@@ -1,0 +1,128 @@
+"""Edge cases: zero-size arrays, bf16 end-to-end, complex dtypes, scalars
+(0-d) — the corners the padded canonical layout must not break."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import all_splits
+
+
+class TestZeroSize:
+    def test_factories_zero(self):
+        for shape in [(0,), (0, 3), (4, 0)]:
+            for split in all_splits(len(shape)):
+                x = ht.zeros(shape, split=split)
+                assert tuple(x.shape) == shape
+                assert x.numpy().shape == shape
+
+    def test_ops_on_zero_size(self):
+        x = ht.zeros((0, 4), split=0)
+        y = x + 1
+        assert tuple(y.shape) == (0, 4)
+        s = ht.sum(x)
+        assert float(np.asarray(s)) == 0.0
+        c = ht.concatenate([x, ht.ones((2, 4), split=0)], axis=0)
+        np.testing.assert_allclose(c.numpy(), np.concatenate([np.zeros((0, 4)), np.ones((2, 4))]))
+
+    def test_reduce_empty_axis_matches_numpy(self):
+        a = np.zeros((0, 5), np.float32)
+        x = ht.array(a, split=1)
+        np.testing.assert_allclose(ht.sum(x, axis=0).numpy(), a.sum(axis=0))
+        # prod of empty axis is ones
+        np.testing.assert_allclose(ht.prod(x, axis=0).numpy(), a.prod(axis=0))
+
+    def test_getitem_empty_result(self):
+        x = ht.arange(10, split=0)
+        out = x[3:3]
+        assert tuple(out.shape) == (0,)
+        assert out.numpy().shape == (0,)
+
+
+class TestBF16:
+    def test_elementwise_and_reduce(self):
+        a = np.linspace(0, 2, 24, dtype=np.float32).reshape(4, 6)
+        for split in all_splits(2):
+            x = ht.array(a, dtype=ht.bfloat16, split=split)
+            assert x.dtype == ht.bfloat16
+            y = (x * 2 + 1).sum()
+            np.testing.assert_allclose(float(np.asarray(y)), (a * 2 + 1).sum(), rtol=2e-2)
+
+    def test_bf16_matmul(self):
+        rng = np.random.default_rng(9)
+        a = rng.normal(size=(16, 8)).astype(np.float32)
+        b = rng.normal(size=(8, 12)).astype(np.float32)
+        out = ht.matmul(ht.array(a, dtype=ht.bfloat16, split=0),
+                        ht.array(b, dtype=ht.bfloat16, split=0))
+        assert out.dtype == ht.bfloat16
+        np.testing.assert_allclose(out.numpy().astype(np.float32), a @ b, rtol=0.1, atol=0.3)
+
+    def test_bf16_astype_roundtrip(self):
+        a = np.array([1.0, 2.5, -3.25], np.float32)
+        x = ht.array(a, split=0).astype(ht.bfloat16).astype(ht.float32)
+        np.testing.assert_allclose(x.numpy(), a, rtol=1e-2)
+
+
+class TestComplex:
+    def test_complex_arithmetic(self):
+        rng = np.random.default_rng(10)
+        a = (rng.normal(size=(3, 4)) + 1j * rng.normal(size=(3, 4))).astype(np.complex64)
+        b = (rng.normal(size=(3, 4)) + 1j * rng.normal(size=(3, 4))).astype(np.complex64)
+        for split in all_splits(2):
+            x, y = ht.array(a, split=split), ht.array(b, split=split)
+            np.testing.assert_allclose((x * y).numpy(), a * b, rtol=1e-5)
+            np.testing.assert_allclose((x + y).numpy(), a + b, rtol=1e-5)
+            np.testing.assert_allclose(ht.abs(x).numpy(), np.abs(a), rtol=1e-5)
+
+    def test_complex_reduction_and_matmul(self):
+        rng = np.random.default_rng(11)
+        a = (rng.normal(size=(4, 5)) + 1j * rng.normal(size=(4, 5))).astype(np.complex64)
+        x = ht.array(a, split=0)
+        np.testing.assert_allclose(np.asarray(ht.sum(x)), a.sum(), rtol=1e-4)
+        out = ht.matmul(x, ht.array(a.conj().T, split=0))
+        np.testing.assert_allclose(out.numpy(), a @ a.conj().T, rtol=1e-4, atol=1e-5)
+
+    def test_complex128(self):
+        a = np.array([1 + 2j, 3 - 1j], np.complex128)
+        x = ht.array(a, split=0)
+        assert x.dtype == ht.complex128
+        np.testing.assert_allclose((x * x).numpy(), a * a)
+
+
+class TestScalars0d:
+    def test_zero_d_ops(self):
+        s = ht.array(2.5)
+        t = ht.array(4.0)
+        assert float(np.asarray(s + t)) == 6.5
+        assert float(np.asarray(ht.sqrt(t))) == 2.0
+        assert tuple((s + t).shape) == ()
+
+    def test_zero_d_from_reduction_interacts(self):
+        x = ht.arange(5, dtype=ht.float32, split=0)
+        total = x.sum()
+        y = x / total
+        np.testing.assert_allclose(y.numpy(), np.arange(5, dtype=np.float32) / 10.0, rtol=1e-6)
+
+
+class TestUneven:
+    """Deliberately prime-sized shapes over 8 devices (the padded layout's
+    worst case)."""
+
+    @pytest.mark.parametrize("n", [1, 7, 13, 17, 31])
+    def test_prime_lengths(self, n):
+        a = np.arange(n, dtype=np.float32)
+        x = ht.array(a, split=0)
+        np.testing.assert_allclose(float(np.asarray(x.sum())), a.sum())
+        np.testing.assert_allclose(x[::-1].numpy(), a[::-1])
+        v, i = ht.sort(x, axis=0)
+        np.testing.assert_allclose(v.numpy(), np.sort(a))
+        y = x.resplit(None).resplit(0)
+        np.testing.assert_allclose(y.numpy(), a)
+
+    def test_prime_matrix_reductions(self):
+        a = np.random.default_rng(13).random((13, 11)).astype(np.float32)
+        for split in all_splits(2):
+            x = ht.array(a, split=split)
+            np.testing.assert_allclose(ht.mean(x, axis=0).numpy(), a.mean(axis=0), rtol=1e-5)
+            np.testing.assert_allclose(ht.std(x, axis=1).numpy(), a.std(axis=1), rtol=1e-4)
