@@ -183,3 +183,42 @@ class TestSubCommunicators:
         sub = comm.Split([0])
         x = ht.ones(4, split=0, comm=sub)
         assert int(x.sum().item()) == 4
+
+
+class TestCollectiveDtypes:
+    """Collectives across dtypes incl. bf16 — the reference must bit-cast
+    bf16 through int16 for MPI (``communication.py:137-138``); here bf16 is
+    natively reducible, which this test pins."""
+
+    @pytest.mark.parametrize("dtype", ["float32", "float64", "int32", "int64", "bfloat16"])
+    def test_psum_dtype(self, dtype):
+        comm = ht.get_comm()
+        n = comm.size
+        x = ht.arange(n, dtype=getattr(ht, dtype), split=0)
+
+        def body(blk):
+            return jnp.broadcast_to(comm.psum(jnp.sum(blk)), blk.shape)
+
+        spec = comm.spec(1, 0)
+        fn = shard_map(body, mesh=comm.mesh, in_specs=spec, out_specs=spec,
+                       check_vma=False)
+        out = np.asarray(jax.jit(fn)(x.larray)).astype(np.float64)
+        np.testing.assert_allclose(out, n * (n - 1) / 2)
+
+    @pytest.mark.parametrize("axis", [0, 1])
+    def test_all_gather_2d_axes(self, axis):
+        comm = ht.get_comm()
+        n = comm.size
+        a = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        x = ht.array(a, split=0)
+
+        def body(blk):
+            return comm.all_gather(blk, axis=axis)
+
+        fn = shard_map(body, mesh=comm.mesh, in_specs=comm.spec(2, 0),
+                       out_specs=comm.spec(2, 0), check_vma=False)
+        out = np.asarray(jax.jit(fn)(x.larray))
+        if axis == 0:
+            assert out.shape == (n * n, 3)  # each device's gather stacked
+        else:
+            assert out.shape == (n, 3 * n)
