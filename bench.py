@@ -81,40 +81,6 @@ def torch_kmeans_time_per_iter(n: int, d: int = 64, k: int = 8, iters: int = 3) 
     return (t1 - t0) / iters
 
 
-def _pallas_kmeans_safe() -> bool:
-    """Compile-probe the fused KMeans kernel in a SUBPROCESS with a hard
-    timeout. A Mosaic/compile pathology (or a wedged device) then cannot
-    hang the benchmark itself — the probe fails and the XLA Lloyd path is
-    used instead."""
-    import os
-    import subprocess
-    import sys
-
-    code = (
-        "import numpy as np, jax, jax.numpy as jnp\n"
-        "from heat_tpu.core.pallas_kernels import kmeans_step_tile\n"
-        "x = jnp.asarray(np.random.default_rng(0).random((4096, 64), np.float32))\n"
-        "c = jnp.asarray(np.random.default_rng(1).random((8, 64), np.float32))\n"
-        "m = jnp.ones((4096, 1), jnp.float32)\n"
-        "r = kmeans_step_tile(x, c, m)\n"
-        "jax.block_until_ready(r)\n"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code], timeout=240,
-            capture_output=True, cwd=os.path.dirname(os.path.abspath(__file__)))
-        if proc.returncode != 0:
-            sys.stderr.write(
-                "bench: Pallas KMeans probe failed; falling back to the XLA "
-                "Lloyd path. Probe stderr:\n" + proc.stderr.decode(errors="replace"))
-        return proc.returncode == 0
-    except Exception as exc:
-        sys.stderr.write(
-            f"bench: Pallas KMeans probe errored ({exc!r}); falling back to "
-            "the XLA Lloyd path.\n")
-        return False
-
-
 def _require_live_backend(timeout_s: float = 600.0) -> None:
     """Fail fast (non-zero exit, clear stderr) when the TPU tunnel is wedged.
 
@@ -158,10 +124,12 @@ def main() -> None:
 
     import os
 
-    # subprocess probe FIRST: it must be the first backend touch (exclusive
-    # TPUs admit one client), and it is itself time-bounded
-    if os.environ.get("HEAT_TPU_PALLAS") is None and not _pallas_kmeans_safe():
-        os.environ["HEAT_TPU_PALLAS"] = "0"  # read before heat_tpu import below
+    # The benchmark measures the fused XLA Lloyd program — the production
+    # KMeans path (the Pallas kernel is gated behind HEAT_TPU_PALLAS=1 until
+    # its large-shape VMEM issue is fixed, see NEXT.md). Avoiding the old
+    # subprocess compile-probe also avoids killing a mid-flight compile on a
+    # slow tunnel, which can wedge the backend for the measurement itself.
+    os.environ.setdefault("HEAT_TPU_PALLAS", "0")
     _require_live_backend()
 
     ips = tpu_kmeans_iter_per_s(n)

@@ -30,7 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.dndarray import DNDarray
 from ..core import types
-from ..core.pallas_kernels import kmeans_step_tile, pallas_enabled
+from ..core.pallas_kernels import kmeans_step_tile, kmeans_pallas_enabled
 from ._kcluster import _KCluster
 
 __all__ = ["KMeans"]
@@ -49,7 +49,7 @@ def _finish_update(sums, counts, centroids):
 
 def _make_step_body(phys_shape, jdt, k, n_valid, comm):
     """(xp, centroids) -> (new_centroids, inertia, shift); one Lloyd step."""
-    if pallas_enabled():
+    if kmeans_pallas_enabled():
         chunk = phys_shape[0] // comm.size
         axis = comm.axis_name
 
@@ -91,7 +91,7 @@ def _make_step_body(phys_shape, jdt, k, n_valid, comm):
 
 
 def _lloyd_step_fn(phys_shape, jdt, k, n_valid, comm):
-    key = (phys_shape, str(jdt), k, n_valid, comm.cache_key, pallas_enabled())
+    key = (phys_shape, str(jdt), k, n_valid, comm.cache_key, kmeans_pallas_enabled())
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = jax.jit(_make_step_body(phys_shape, jdt, k, n_valid, comm))
@@ -133,10 +133,10 @@ def _lloyd_fori_fn(phys_shape, jdt, k, n_valid, comm):
     trip counts with the same executable and differences them to cancel
     constant dispatch/transfer overhead."""
     key = ("fori", phys_shape, str(jdt), k, n_valid, comm.cache_key,
-           pallas_enabled())
+           kmeans_pallas_enabled())
     fn = _STEP_CACHE.get(key)
     if fn is None:
-        if pallas_enabled():
+        if kmeans_pallas_enabled():
             # shard_map OUTSIDE the loop: the valid mask is computed once
             # and the whole iteration sequence is one per-device program
             chunk = phys_shape[0] // comm.size

@@ -21,8 +21,11 @@ GB/s-critical tiles the framework runs in its hot loops:
 On non-TPU backends every wrapper falls back to the interpreter
 (``interpret=True``), so the CPU test mesh exercises the same kernel code
 path; the jnp reference implementations remain available for equivalence
-checks. Enablement: by default Pallas is used iff the active backend is TPU;
-override with :func:`set_pallas` or ``HEAT_TPU_PALLAS=0/1``.
+checks. Enablement: by default the cdist/attention kernels are used iff the
+active backend is TPU; override with :func:`set_pallas` or
+``HEAT_TPU_PALLAS=0/1``. The fused KMeans kernel is the exception — it is
+OPT-IN only (:func:`kmeans_pallas_enabled`) until its large-shape scoped-VMEM
+issue is resolved (NEXT.md).
 """
 
 from __future__ import annotations
@@ -38,6 +41,7 @@ from jax.experimental import pallas as pl
 
 __all__ = [
     "pallas_enabled",
+    "kmeans_pallas_enabled",
     "set_pallas",
     "cdist_tile",
     "flash_attention",
@@ -70,6 +74,17 @@ def pallas_enabled() -> bool:
     if env in ("1", "true", "True"):
         return True
     return jax.default_backend() == "tpu"
+
+
+def kmeans_pallas_enabled() -> bool:
+    """The fused KMeans kernel is OPT-IN (explicit ``set_pallas(True)`` or
+    ``HEAT_TPU_PALLAS=1``) rather than backend-autoselected: its large-shape
+    Mosaic compile currently exceeds the scoped-VMEM budget on v5e (NEXT.md),
+    and auto-selection would turn a working fit into a compile error. The
+    cdist/attention kernels keep the backend-default behavior."""
+    if _override is not None:
+        return _override
+    return os.environ.get("HEAT_TPU_PALLAS") in ("1", "true", "True")
 
 
 def _interpret() -> bool:
