@@ -124,11 +124,14 @@ def main() -> None:
 
     import os
 
-    # The benchmark measures the fused XLA Lloyd program — the production
-    # KMeans path (the Pallas kernel is gated behind HEAT_TPU_PALLAS=1 until
-    # its large-shape VMEM issue is fixed, see NEXT.md). Avoiding the old
-    # subprocess compile-probe also avoids killing a mid-flight compile on a
-    # slow tunnel, which can wedge the backend for the measurement itself.
+    # Pin the non-Pallas path for ALL kernels in this process: the benchmark
+    # measures the fused XLA Lloyd program — the production KMeans path (the
+    # KMeans kernel is opt-in behind HEAT_TPU_PALLAS=1 until its large-shape
+    # VMEM issue is fixed, see NEXT.md), and the auto-selected cdist/attention
+    # kernels are irrelevant here but would otherwise add tunnel compiles.
+    # Avoiding the old subprocess compile-probe also avoids killing a
+    # mid-flight compile on a slow tunnel, which can wedge the backend for
+    # the measurement itself.
     os.environ.setdefault("HEAT_TPU_PALLAS", "0")
     _require_live_backend()
 

@@ -37,6 +37,9 @@ def _i32(v):
 
 def bisect(n=1 << 16, d=64, kp=128, bm=1024):
     acc = jnp.float32
+    # Must match the kernel under diagnosis (pallas_kernels._MM_PRECISION).
+    # Explicit (not None): an omitted precision resolves to the package-level
+    # jax_default_matmul_precision=HIGH, which Mosaic rejects.
     PREC = jax.lax.Precision.DEFAULT
 
     def kern(x_ref, c_ref, m_ref, s_ref, a_s, *, sub):
@@ -68,7 +71,7 @@ def bisect(n=1 << 16, d=64, kp=128, bm=1024):
                     a_s[...] += jnp.zeros_like(a_s) + jnp.sum(onehot)
                 elif sub == "counts":
                     a_s[...] += jnp.broadcast_to(
-                        jnp.sum(onehot, axis=0, keepdims=True), a_s.shape)
+                        jnp.sum(onehot, axis=0)[:, None], a_s.shape)
                 elif sub == "dot_rev":
                     a_s[...] += jax.lax.dot_general(
                         onehot, x, dimension_numbers=(((0,), (0,)), ((), ())),
