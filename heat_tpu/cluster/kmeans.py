@@ -30,7 +30,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..core.dndarray import DNDarray
 from ..core import types
-from ..core.pallas_kernels import kmeans_step_tile, kmeans_pallas_enabled
+from ..core.pallas_kernels import (kmeans_step_tile, kmeans_pallas_enabled,
+                                   _kmeans_sums_mode)
 from ._kcluster import _KCluster
 
 __all__ = ["KMeans"]
@@ -91,7 +92,8 @@ def _make_step_body(phys_shape, jdt, k, n_valid, comm):
 
 
 def _lloyd_step_fn(phys_shape, jdt, k, n_valid, comm):
-    key = (phys_shape, str(jdt), k, n_valid, comm.cache_key, kmeans_pallas_enabled())
+    key = (phys_shape, str(jdt), k, n_valid, comm.cache_key,
+           kmeans_pallas_enabled() and _kmeans_sums_mode())
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = jax.jit(_make_step_body(phys_shape, jdt, k, n_valid, comm))
@@ -133,7 +135,7 @@ def _lloyd_fori_fn(phys_shape, jdt, k, n_valid, comm):
     trip counts with the same executable and differences them to cancel
     constant dispatch/transfer overhead."""
     key = ("fori", phys_shape, str(jdt), k, n_valid, comm.cache_key,
-           kmeans_pallas_enabled())
+           kmeans_pallas_enabled() and _kmeans_sums_mode())
     fn = _STEP_CACHE.get(key)
     if fn is None:
         if kmeans_pallas_enabled():

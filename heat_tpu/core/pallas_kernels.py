@@ -415,7 +415,7 @@ def flash_attention(
 
 def _kmeans_kernel(x_ref, c_ref, mask_ref, sums_ref, counts_ref, stats_ref,
                    acc_sums, acc_counts, acc_inertia, *, block_rows: int,
-                   acc_dtype):
+                   acc_dtype, sums_mode: str, k: int):
     """One X row-block of the fused Lloyd step.
 
     The assignment GEMM, argmin, one-hot update GEMM and the inertia terms
@@ -423,6 +423,20 @@ def _kmeans_kernel(x_ref, c_ref, mask_ref, sums_ref, counts_ref, stats_ref,
     Lloyd iteration streams X from HBM exactly once (the jnp path reads it
     three times: the x^2 pass and both GEMMs). Scratch accumulators persist
     across the sequential 1-D grid; outputs are written on the last step.
+
+    ``sums_mode`` selects how the centroid-sum update is computed (the stage
+    whose Mosaic compile blew the scoped-VMEM budget at bench shapes,
+    NEXT.md #1):
+
+    * ``"dot_rev"`` — ``onehotᵀ·x`` expressed as a dim-0 contraction of the
+      ``(bm, kp)`` one-hot (the original formulation; Mosaic materializes
+      transpose temporaries for it).
+    * ``"dot_t"`` — build the transposed one-hot ``(kp, bm)`` directly from
+      the label vector and run a standard dim-1×dim-0 GEMM; no transpose
+      temporaries.
+    * ``"loop"`` — ``k`` masked VPU reductions of the resident tile
+      (no update GEMM at all; attractive because k is tiny for Lloyd
+      benchmarks, k=8).
     """
     step = pl.program_id(0)
     nsteps = pl.num_programs(0)
@@ -448,15 +462,40 @@ def _kmeans_kernel(x_ref, c_ref, mask_ref, sums_ref, counts_ref, stats_ref,
     # int64 indices, which Mosaic's reduce-index lowering rejects
     labels = jax.lax.argmin(scores, 1, jnp.int32)  # (bm,)
     kp = scores.shape[1]
-    onehot = (labels[:, None] == jax.lax.broadcasted_iota(
-        jnp.int32, (block_rows, kp), 1)).astype(acc_dtype) * valid
 
-    acc_sums[...] += jax.lax.dot_general(
-        onehot, x, dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=acc_dtype,
-        precision=_MM_PRECISION,
-    )                                             # (kp, d)
-    acc_counts[...] += jnp.sum(onehot, axis=0, keepdims=True)  # (1, kp)
+    # Each mode is fully self-contained — sums AND counts come from its own
+    # representation, so the VMEM A/B on real TPU isolates the formulation
+    # (a shared (bm, kp) one-hot would keep the dot_rev operand live in every
+    # mode). acc_counts is (1, kp) for dot_rev, (kp, 1) otherwise.
+    if sums_mode == "dot_rev":
+        onehot = (labels[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (block_rows, kp), 1)).astype(acc_dtype) * valid
+        acc_sums[...] += jax.lax.dot_general(
+            onehot, x, dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=acc_dtype,
+            precision=_MM_PRECISION,
+        )                                         # (kp, d)
+        acc_counts[...] += jnp.sum(onehot, axis=0, keepdims=True)  # (1, kp)
+    elif sums_mode == "dot_t":
+        # invalid (padding) rows get the out-of-range label kp so the row
+        # iota never matches them — masking without a (1, bm) transpose of
+        # the valid column
+        labels_m = jnp.where(mask_ref[...][:, 0] > 0, labels, kp)
+        onehot_t = (labels_m[None, :] == jax.lax.broadcasted_iota(
+            jnp.int32, (kp, block_rows), 0)).astype(acc_dtype)  # (kp, bm)
+        acc_sums[...] += jax.lax.dot_general(
+            onehot_t, x, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=acc_dtype,
+            precision=_MM_PRECISION,
+        )                                         # (kp, d)
+        acc_counts[...] += jnp.sum(onehot_t, axis=1, keepdims=True)  # (kp, 1)
+    elif sums_mode == "loop":
+        for j in range(k):
+            w = jnp.where(labels[:, None] == j, valid, 0.0)      # (bm, 1)
+            acc_sums[j:j + 1, :] += jnp.sum(w * x, axis=0, keepdims=True)
+            acc_counts[j:j + 1, :] += jnp.sum(w, axis=0, keepdims=True)
+    else:  # pragma: no cover — guarded by kmeans_step_tile
+        raise ValueError(f"unknown sums_mode {sums_mode!r}")
     # inertia: min d^2 = min(scores) + x^2, both from the resident tile.
     # Mosaic forbids scalar stores to VMEM, so the scalar partial is
     # broadcast-accumulated into every lane of a vector-shaped scratch; the
@@ -470,14 +509,28 @@ def _kmeans_kernel(x_ref, c_ref, mask_ref, sums_ref, counts_ref, stats_ref,
     @pl.when(step == nsteps - 1)
     def _flush():
         sums_ref[...] = acc_sums[...].astype(sums_ref.dtype)
+        cnt = acc_counts[...]
+        if sums_mode != "dot_rev":
+            cnt = cnt.T  # (kp, 1) accumulator -> (1, kp); one tiny transpose
         counts_ref[...] = jnp.broadcast_to(
-            acc_counts[...], counts_ref.shape).astype(counts_ref.dtype)
+            cnt, counts_ref.shape).astype(counts_ref.dtype)
         stats_ref[...] = jnp.broadcast_to(
             acc_inertia[...], stats_ref.shape).astype(stats_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_rows",))
-def kmeans_step_tile(x, centroids, valid_mask, block_rows: int = 1024):
+def _kmeans_sums_mode() -> str:
+    """Centroid-sum formulation inside the KMeans kernel; A/B on real TPU via
+    ``HEAT_TPU_KMEANS_SUMS=dot_rev|dot_t|loop`` (default: transposed GEMM —
+    the candidate that avoids Mosaic's dim-0-contraction temporaries)."""
+    mode = os.environ.get("HEAT_TPU_KMEANS_SUMS", "dot_t")
+    if mode not in ("dot_rev", "dot_t", "loop"):
+        raise ValueError(
+            f"HEAT_TPU_KMEANS_SUMS={mode!r}: expected dot_rev|dot_t|loop")
+    return mode
+
+
+def kmeans_step_tile(x, centroids, valid_mask, block_rows: int = 1024,
+                     sums_mode: Optional[str] = None):
     """Fused Lloyd iteration over a local X shard: ONE HBM pass.
 
     ``x``: ``(N_pad, d)``; ``centroids``: ``(k, d)``; ``valid_mask``:
@@ -486,7 +539,20 @@ def kmeans_step_tile(x, centroids, valid_mask, block_rows: int = 1024):
     the per-shard partials the caller psums over the mesh. Labels are not
     produced here; the fit computes them once after convergence (a single
     extra assignment pass) instead of writing N int32s every iteration.
+    ``sums_mode`` (default ``HEAT_TPU_KMEANS_SUMS``) picks the centroid-sum
+    formulation, see :func:`_kmeans_kernel`.
     """
+    # resolve the env-selected mode OUTSIDE the jit so it is part of the
+    # cache key (a None default baked in at trace time would go stale if the
+    # env var changes between calls)
+    if sums_mode is None:
+        sums_mode = _kmeans_sums_mode()
+    return _kmeans_step_tile(x, centroids, valid_mask, block_rows, sums_mode)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "sums_mode"))
+def _kmeans_step_tile(x, centroids, valid_mask, block_rows: int,
+                      sums_mode: str):
     n, d = x.shape
     k = centroids.shape[0]
     acc_dtype = jnp.float64 if jnp.promote_types(x.dtype, jnp.float32) == jnp.float64 else jnp.float32
@@ -502,7 +568,8 @@ def kmeans_step_tile(x, centroids, valid_mask, block_rows: int = 1024):
     from jax.experimental.pallas import tpu as pltpu
 
     sums, counts, stats = pl.pallas_call(
-        functools.partial(_kmeans_kernel, block_rows=bm, acc_dtype=acc_dtype),
+        functools.partial(_kmeans_kernel, block_rows=bm, acc_dtype=acc_dtype,
+                          sums_mode=sums_mode, k=k),
         grid=(npad // bm,),
         in_specs=[
             pl.BlockSpec((bm, d), lambda i: (_i32(i), _i32(0))),
@@ -521,7 +588,8 @@ def kmeans_step_tile(x, centroids, valid_mask, block_rows: int = 1024):
         ],
         scratch_shapes=[
             pltpu.VMEM((kp, d), acc_dtype),
-            pltpu.VMEM((1, kp), acc_dtype),
+            pltpu.VMEM((1, kp) if sums_mode == "dot_rev" else (kp, 1),
+                       acc_dtype),
             pltpu.VMEM((8, 128), acc_dtype),  # scalar held in every lane (native tile)
         ],
         interpret=_interpret(),

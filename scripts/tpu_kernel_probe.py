@@ -110,6 +110,8 @@ def bisect(n=1 << 16, d=64, kp=128, bm=1024):
 
 
 def ab(n=1 << 23, d=64, k=8, iters=50):
+    import os
+
     from heat_tpu.cluster.kmeans import _lloyd_fori_fn
     from heat_tpu.core import pallas_kernels as pk
 
@@ -117,8 +119,10 @@ def ab(n=1 << 23, d=64, k=8, iters=50):
     x = ht.random.rand(n, d, dtype=ht.float32, split=0)
     xp = x.larray
 
-    def run(pallas):
+    def run(pallas, sums_mode=None):
         pk.set_pallas(pallas)
+        # always set explicitly so no mode leaks from a previous variant
+        os.environ["HEAT_TPU_KMEANS_SUMS"] = sums_mode or "dot_t"
         fn = _lloyd_fori_fn(xp.shape, xp.dtype, k, n, x.comm)
         c0 = xp[:k]
         fn(xp, c0, 2)[1].item()
@@ -129,12 +133,16 @@ def ab(n=1 << 23, d=64, k=8, iters=50):
         t2 = time.perf_counter()
         return iters / ((t2 - t1) - (t1 - t0))
 
-    for pallas in (False, True, False, True):
+    # XLA baseline first; then each kernel sums-mode candidate (NEXT.md #1),
+    # then XLA again to bracket drift
+    variants = [(False, None), (True, "dot_t"), (True, "loop"),
+                (True, "dot_rev"), (False, None)]
+    for pallas, mode in variants:
+        tag = f"pallas={pallas}" + (f" sums={mode}" if mode else "")
         try:
-            print("pallas", pallas, "iter/s:", round(run(pallas), 1), flush=True)
+            print(tag, "iter/s:", round(run(pallas, mode), 1), flush=True)
         except Exception as e:  # noqa: BLE001
-            print("pallas", pallas, "FAILED:", str(e)[:160].replace("\n", " "),
-                  flush=True)
+            print(tag, "FAILED:", str(e)[:160].replace("\n", " "), flush=True)
 
 
 if __name__ == "__main__":

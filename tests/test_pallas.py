@@ -227,7 +227,8 @@ class TestFlashBackward:
 
 
 class TestKMeansStepTile:
-    def test_matches_reference(self):
+    @pytest.mark.parametrize("sums_mode", ["dot_rev", "dot_t", "loop"])
+    def test_matches_reference(self, sums_mode):
         rng = np.random.default_rng(11)
         n, d, k, nv = 2048 + 77, 48, 8, 2048 + 13  # uneven rows + padding
         x = rng.standard_normal((n, d)).astype(np.float32)
@@ -235,7 +236,8 @@ class TestKMeansStepTile:
         mask = (np.arange(n) < nv).astype(np.float32)[:, None]
 
         sums, counts, inertia = pk.kmeans_step_tile(
-            jnp.asarray(x), jnp.asarray(c), jnp.asarray(mask))
+            jnp.asarray(x), jnp.asarray(c), jnp.asarray(mask),
+            sums_mode=sums_mode)
 
         d2 = ((x[:, None, :] - c[None, :, :]) ** 2).sum(-1)
         lab = d2.argmin(1)
@@ -244,6 +246,13 @@ class TestKMeansStepTile:
         np.testing.assert_allclose(np.asarray(counts), oh.sum(0), rtol=0, atol=0)
         np.testing.assert_allclose(
             float(inertia), (d2.min(1) * mask[:, 0]).sum(), rtol=1e-5)
+
+    def test_sums_mode_env_knob(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_KMEANS_SUMS", "bogus")
+        with pytest.raises(ValueError, match="HEAT_TPU_KMEANS_SUMS"):
+            pk._kmeans_sums_mode()
+        monkeypatch.setenv("HEAT_TPU_KMEANS_SUMS", "loop")
+        assert pk._kmeans_sums_mode() == "loop"
 
     def test_kmeans_pallas_path_matches_xla(self, force_pallas):
         """Full KMeans fit through the fused kernel (interpret mode on the
