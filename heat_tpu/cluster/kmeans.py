@@ -48,9 +48,14 @@ def _finish_update(sums, counts, centroids):
     return new_centroids, shift
 
 
-def _make_step_body(phys_shape, jdt, k, n_valid, comm):
-    """(xp, centroids) -> (new_centroids, inertia, shift); one Lloyd step."""
-    if kmeans_pallas_enabled():
+def _make_step_body(phys_shape, jdt, k, n_valid, comm, sums_mode):
+    """(xp, centroids) -> (new_centroids, inertia, shift); one Lloyd step.
+
+    ``sums_mode`` is resolved by the CALLER and passed down explicitly so the
+    step cache key and the traced kernel can never disagree (resolving the
+    env var again at trace time could bake a different mode into an entry
+    keyed under the lookup-time mode)."""
+    if sums_mode:
         chunk = phys_shape[0] // comm.size
         axis = comm.axis_name
 
@@ -59,7 +64,8 @@ def _make_step_body(phys_shape, jdt, k, n_valid, comm):
             row = rank * chunk + jax.lax.broadcasted_iota(
                 jnp.int32, (chunk, 1), 0)
             mask = (row < n_valid).astype(xp_blk.dtype)
-            sums, counts, inertia = kmeans_step_tile(xp_blk, centroids, mask)
+            sums, counts, inertia = kmeans_step_tile(xp_blk, centroids, mask,
+                                                     sums_mode=sums_mode)
             sums = jax.lax.psum(sums, axis)
             counts = jax.lax.psum(counts, axis)
             inertia = jax.lax.psum(inertia, axis)
@@ -92,11 +98,12 @@ def _make_step_body(phys_shape, jdt, k, n_valid, comm):
 
 
 def _lloyd_step_fn(phys_shape, jdt, k, n_valid, comm):
-    key = (phys_shape, str(jdt), k, n_valid, comm.cache_key,
-           kmeans_pallas_enabled() and _kmeans_sums_mode())
+    sums_mode = kmeans_pallas_enabled() and _kmeans_sums_mode()
+    key = (phys_shape, str(jdt), k, n_valid, comm.cache_key, sums_mode)
     fn = _STEP_CACHE.get(key)
     if fn is None:
-        fn = jax.jit(_make_step_body(phys_shape, jdt, k, n_valid, comm))
+        fn = jax.jit(_make_step_body(phys_shape, jdt, k, n_valid, comm,
+                                     sums_mode))
         _STEP_CACHE[key] = fn
     return fn
 
@@ -134,11 +141,12 @@ def _lloyd_fori_fn(phys_shape, jdt, k, n_valid, comm):
     hard part 5). Used by the benchmark driver, which times two different
     trip counts with the same executable and differences them to cancel
     constant dispatch/transfer overhead."""
+    sums_mode = kmeans_pallas_enabled() and _kmeans_sums_mode()
     key = ("fori", phys_shape, str(jdt), k, n_valid, comm.cache_key,
-           kmeans_pallas_enabled() and _kmeans_sums_mode())
+           sums_mode)
     fn = _STEP_CACHE.get(key)
     if fn is None:
-        if kmeans_pallas_enabled():
+        if sums_mode:
             # shard_map OUTSIDE the loop: the valid mask is computed once
             # and the whole iteration sequence is one per-device program
             chunk = phys_shape[0] // comm.size
@@ -152,7 +160,8 @@ def _lloyd_fori_fn(phys_shape, jdt, k, n_valid, comm):
 
                 def body(_, carry):
                     c, _, _ = carry
-                    sums, counts, inertia = kmeans_step_tile(xp_blk, c, mask)
+                    sums, counts, inertia = kmeans_step_tile(
+                        xp_blk, c, mask, sums_mode=sums_mode)
                     sums = jax.lax.psum(sums, axis)
                     counts = jax.lax.psum(counts, axis)
                     inertia = jax.lax.psum(inertia, axis)
@@ -168,7 +177,8 @@ def _lloyd_fori_fn(phys_shape, jdt, k, n_valid, comm):
                 out_specs=(P(), P(), P()),
                 check_vma=False))
         else:
-            single = _make_step_body(phys_shape, jdt, k, n_valid, comm)
+            single = _make_step_body(phys_shape, jdt, k, n_valid, comm,
+                                     sums_mode)
 
             def _run(xp, centroids, iters):
                 def body(_, carry):

@@ -112,10 +112,22 @@ def array(
         dtype = types.canonical_heat_type(dtype)
         arr = jnp.asarray(obj, dtype=dtype.jax_type())
     else:
-        # NumPy-faithful inference for python ints (64-bit when x64 enabled)
-        if isinstance(obj, (list, tuple, int, float, bool, complex)):
-            arr = jnp.asarray(np.asarray(obj))
+        if (isinstance(obj, (list, tuple, int, float, bool, complex))
+                and not isinstance(obj, np.generic)):
+            # np.float64/np.complex128 scalars subclass python float/complex
+            # but must keep their dtype like any other NumPy input
+            # reference-parity inference for python data (the torch.tensor
+            # ladder, factories.py:318-331): floats -> float32, complex ->
+            # complex64, ints stay 64-bit. Also the TPU-right default —
+            # float64 would double HBM traffic and fall off the MXU.
+            arr = np.asarray(obj)
+            if arr.dtype == np.float64:
+                arr = arr.astype(np.float32)
+            elif arr.dtype == np.complex128:
+                arr = arr.astype(np.complex64)
+            arr = jnp.asarray(arr)
         else:
+            # array-like inputs (NumPy/jax/DNDarray buffers) keep their dtype
             arr = jnp.asarray(obj)
         dtype = types.canonical_heat_type(arr.dtype)
     # on a single CPU device jnp.asarray may zero-copy-alias the caller's
@@ -218,8 +230,13 @@ def ones(shape, dtype=types.float32, split=None, device=None, comm=None, order="
     return __factory(shape, dtype, split, device, comm, "ones", lambda s, d: jnp.ones(s, d))
 
 
-def full(shape, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
-    """Constant fill (reference ``factories.py:786``)."""
+def full(shape, fill_value, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Constant fill (reference ``factories.py:786``).
+
+    The reference defaults ``dtype`` to float32 regardless of the fill's
+    type (``factories.py:792``; ``ht.full((2,), 4)`` is float32, pinned by
+    its ``test_full``) — pass ``dtype=None`` to infer from ``fill_value``.
+    """
     memory.sanitize_memory_order(order)
     if dtype is None:
         dtype = types.heat_type_of(fill_value)
@@ -258,9 +275,11 @@ def ones_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> D
     return __factory_like(a, dtype, split, device, comm, ones)
 
 
-def full_like(a, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+def full_like(a, fill_value, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Reference parity: like ``full``, dtype defaults to float32 — NOT to
+    ``a.dtype`` (``factories.py:849``); ``dtype=None`` infers from the fill."""
     memory.sanitize_memory_order(order)
-    if dtype is None and not isinstance(a, DNDarray):
+    if dtype is None:
         dtype = types.heat_type_of(fill_value)
     return __factory_like(a, dtype, split, device, comm, full, fill_value=fill_value)
 

@@ -1,0 +1,256 @@
+"""Public test harness for downstream/user test suites.
+
+The reference ships a reusable ``TestCase`` base class
+(``heat/core/tests/test_suites/basic_test.py:12-367``) that its entire suite
+— and downstream users — build on: ``assert_array_equal`` validates both the
+distribution (per-rank local shapes against the balanced chunk formula) and
+the gathered values, and ``assert_func_equal`` is the property-style "run the
+heat function for every split and compare against the NumPy implementation"
+idiom (SURVEY.md §4). This module provides the same surface for heat_tpu:
+distribution checks go against :meth:`TPUCommunication.chunk` logical shards
+instead of MPI-rank ``larray`` shapes, and the gather is a
+``jax.device_get``.
+
+Works under plain ``unittest`` and pytest alike::
+
+    import heat_tpu as ht
+    from heat_tpu.testing import TestCase
+
+    class TestMyOp(TestCase):
+        def test_exp(self):
+            self.assert_func_equal((4, 5), ht.exp, np.exp)
+"""
+
+from __future__ import annotations
+
+import unittest
+from typing import Callable, Optional, Sequence, Union
+
+import numpy as np
+
+from .core import factories, types
+from .core.communication import get_comm
+from .core.devices import get_device
+from .core.dndarray import DNDarray
+
+__all__ = ["TestCase", "assert_array_equal", "assert_func_equal",
+           "assert_func_equal_for_tensor"]
+
+
+def _random_array(shape, dtype=np.float32, low=-10000, high=10000,
+                  rng: Optional[np.random.Generator] = None) -> np.ndarray:
+    """Random NumPy array: ``randn`` for floats, ``integers`` for ints
+    (the reference's generation policy, ``basic_test.py:326-367``)."""
+    rng = rng or np.random.default_rng()
+    dtype = np.dtype(dtype)
+    if np.issubdtype(dtype, np.floating):
+        return rng.standard_normal(shape).astype(dtype)
+    if np.issubdtype(dtype, np.integer):
+        return rng.integers(low, high, size=shape).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        return (rng.standard_normal(shape)
+                + 1j * rng.standard_normal(shape)).astype(dtype)
+    if dtype == np.bool_:
+        return rng.integers(0, 2, size=shape).astype(dtype)
+    raise TypeError(
+        f"unsupported dtype {dtype}: expected floating, integer, complex or bool")
+
+
+
+def _compare(actual: np.ndarray, desired: np.ndarray, err_msg: str) -> None:
+    """Exact for integer/bool data; tight ULP-scaled ``allclose`` for
+    float/complex (XLA's libm may differ from NumPy's by an ulp, which the
+    reference never sees because both of its sides are torch).
+
+    The ground truth is quantized to the dtype the library returned before
+    comparing: heat promotes ints to float32 (the reference's torch-style
+    ladder) where NumPy goes to float64, so a float64 ground truth may be
+    finite where the correct float32 answer over/underflows to inf/0.
+    """
+    import jax.numpy as jnp
+
+    def _kind(dt):
+        # jnp.issubdtype sees extended float dtypes (bfloat16 has NumPy
+        # kind 'V', which np-kind checks misclassify)
+        if jnp.issubdtype(dt, jnp.complexfloating):
+            return "c"
+        if jnp.issubdtype(dt, jnp.floating):
+            return "f"
+        return dt.kind
+
+    actual = np.asarray(actual)
+    desired = np.asarray(desired)
+    ak, dk = _kind(actual.dtype), _kind(desired.dtype)
+    if {ak, dk} <= set("iub?"):
+        np.testing.assert_array_equal(actual, desired, err_msg=err_msg)
+        return
+    if ak in "fc" and not (ak == "f" and dk == "c"):
+        # quantize the ground truth to the returned precision — but never
+        # real-cast a complex expectation (that would silently drop the
+        # imaginary part and wrong-pass); a real actual vs a truly complex
+        # desired must fail in the complex128 comparison below
+        desired = desired.astype(actual.dtype)
+    eps = float(jnp.finfo(actual.dtype).eps if ak in "fc"
+                else jnp.finfo(desired.dtype).eps)
+    cplx = "c" in {ak, dk}
+    np.testing.assert_allclose(
+        actual.astype(np.complex128 if cplx else np.float64),
+        desired.astype(np.complex128 if cplx else np.float64),
+        rtol=16 * eps, atol=16 * eps, err_msg=err_msg)
+
+
+def assert_array_equal(heat_array: DNDarray, expected_array,
+                       check_dtype: bool = True) -> None:
+    """Assert a DNDarray equals a NumPy reference — distribution first.
+
+    Checks, in order (mirroring ``basic_test.py:68-141``): the object is a
+    ``DNDarray``; the global shape matches; the dtype corresponds
+    (``check_dtype=False`` skips this — used by :func:`assert_func_equal`,
+    whose NumPy ground truth is deliberately computed at NumPy's own
+    promotion and quantized for comparison); each logical shard of a split
+    array matches the balanced chunk formula AND the corresponding slice of
+    the expected array; the full gather equals the expected array.
+    """
+    if not isinstance(heat_array, DNDarray):
+        raise AssertionError(
+            f"not a DNDarray: {type(heat_array)}; the public API must return "
+            "wrapped distributed arrays")
+    expected_array = np.asarray(expected_array)
+    if tuple(heat_array.shape) != tuple(expected_array.shape):
+        raise AssertionError(
+            f"global shape mismatch: {tuple(heat_array.shape)} vs expected "
+            f"{tuple(expected_array.shape)}")
+    ht_np_dtype = types.canonical_heat_type(heat_array.dtype).char()
+    if check_dtype and expected_array.dtype.kind not in "OUS":
+        exp_ht = types.canonical_heat_type(expected_array.dtype)
+        if types.canonical_heat_type(heat_array.dtype) is not exp_ht:
+            raise AssertionError(
+                f"dtype mismatch: {heat_array.dtype} vs expected "
+                f"{expected_array.dtype} (heat type {exp_ht})")
+    split = heat_array.split
+    comm = heat_array.comm
+    if split is not None and len(heat_array.shape) > 0:
+        # distribution check: every device's physical rows must hold exactly
+        # the chunk-formula slice of the expected array (padding rows are
+        # unconstrained)
+        lmap = np.asarray(heat_array.lshape_map)
+        phys = np.asarray(heat_array.larray)
+        c = comm.chunk_size(heat_array.shape[split])
+        for rank in range(comm.size):
+            offset, lshape, slices = comm.chunk(heat_array.shape, split,
+                                                rank=rank)
+            if tuple(lmap[rank]) != tuple(lshape):
+                raise AssertionError(
+                    f"rank {rank}: lshape_map row {tuple(lmap[rank])} != "
+                    f"balanced chunk {tuple(lshape)} (split={split})")
+            nloc = lshape[split]
+            phys_slices = tuple(
+                slice(rank * c, rank * c + nloc) if i == split else slice(None)
+                for i in range(phys.ndim))
+            _compare(phys[phys_slices], expected_array[slices],
+                     f"rank {rank} shard content mismatch (split={split})")
+    _compare(heat_array.numpy(), expected_array,
+             f"gathered content mismatch (dtype {ht_np_dtype})")
+
+
+def assert_func_equal_for_tensor(
+    tensor,
+    heat_func: Callable,
+    numpy_func: Callable,
+    heat_args: Optional[dict] = None,
+    numpy_args: Optional[dict] = None,
+    distributed_result: bool = True,
+) -> None:
+    """Run ``heat_func`` with ``split=None`` and every split axis on
+    ``tensor`` and compare each result against ``numpy_func`` on the same
+    data (``basic_test.py:219-307``).
+
+    ``distributed_result=False`` marks functions whose result is replicated
+    (e.g. global reductions): only the gathered value is compared, never the
+    per-shard distribution.
+    """
+    heat_args = dict(heat_args or {})
+    numpy_args = dict(numpy_args or {})
+    tensor = np.asarray(tensor)
+    expected = np.asarray(numpy_func(tensor, **numpy_args))
+
+    for split in (None, *range(tensor.ndim)):
+        a = factories.array(tensor, split=split)
+        result = heat_func(a, **heat_args)
+        if np.isscalar(result) or not isinstance(result, DNDarray):
+            _compare(np.asarray(result), expected,
+                     f"scalar result mismatch for split={split}")
+            continue
+        if distributed_result and result.split is not None:
+            assert_array_equal(result, expected, check_dtype=False)
+        else:
+            _compare(result.numpy(), expected,
+                     f"result mismatch for split={split}")
+
+
+def assert_func_equal(
+    shape: Union[Sequence[int], tuple],
+    heat_func: Callable,
+    numpy_func: Callable,
+    distributed_result: bool = True,
+    heat_args: Optional[dict] = None,
+    numpy_args: Optional[dict] = None,
+    data_types: Sequence = (np.int32, np.int64, np.float32, np.float64),
+    low: int = -10000,
+    high: int = 10000,
+    seed: Optional[int] = None,
+) -> None:
+    """Property-style check: random tensors of ``shape`` for every dtype in
+    ``data_types``, each run through :func:`assert_func_equal_for_tensor`
+    (``basic_test.py:142-218``). ``seed`` (an addition over the reference,
+    whose generation is made rank-consistent by a broadcast we don't need —
+    every device sees the same host program) makes failures reproducible.
+    """
+    if not isinstance(shape, (tuple, list)):
+        raise ValueError(f"shape must be a list or tuple, got {type(shape)}")
+    rng = np.random.default_rng(seed)
+    for dtype in data_types:
+        tensor = _random_array(shape, dtype=dtype, low=low, high=high, rng=rng)
+        assert_func_equal_for_tensor(
+            tensor=tensor, heat_func=heat_func, numpy_func=numpy_func,
+            heat_args=heat_args, numpy_args=numpy_args,
+            distributed_result=distributed_result)
+
+
+class TestCase(unittest.TestCase):
+    """Drop-in base class for user test suites (``basic_test.py:12``)."""
+
+    @property
+    def comm(self):
+        return get_comm()
+
+    @property
+    def device(self):
+        return get_device()
+
+    def get_rank(self) -> int:
+        # process index; all devices are addressable from one host program
+        return self.comm.rank
+
+    def get_size(self) -> int:
+        return self.comm.size
+
+    def assert_array_equal(self, heat_array, expected_array,
+                           check_dtype: bool = True):
+        assert_array_equal(heat_array, expected_array,
+                           check_dtype=check_dtype)
+
+    def assert_func_equal(self, shape, heat_func, numpy_func, **kwargs):
+        assert_func_equal(shape, heat_func, numpy_func, **kwargs)
+
+    def assert_func_equal_for_tensor(self, tensor, heat_func, numpy_func,
+                                     **kwargs):
+        assert_func_equal_for_tensor(tensor, heat_func, numpy_func, **kwargs)
+
+    def assertTrue_memory_layout(self, tensor, order):
+        """Layout assertion (``basic_test.py:308``): XLA owns physical
+        layout, so this validates the *logical* order attribute recorded by
+        ``sanitize_memory_layout`` rather than torch strides."""
+        recorded = getattr(tensor, "order", "C")
+        self.assertEqual(recorded, order,
+                         f"memory layout {recorded!r} != expected {order!r}")

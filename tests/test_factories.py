@@ -46,6 +46,31 @@ def test_like_factories_inherit_shape_dtype_split():
     assert_array_equal(fl, np.full((6, 3), 9.0), rtol=1e-6)
 
 
+def test_reference_dtype_ladder():
+    """Inference parity with the reference's torch ladder for python data
+    (``factories.py:318-331``; ``test_full`` pins float32 for int fills)."""
+    assert ht.array([1.5, 2.5]).dtype is ht.float32
+    assert ht.array(3.5).dtype is ht.float32
+    assert ht.array([1 + 2j]).dtype is ht.complex64
+    assert ht.array([1, 2]).dtype is ht.int64
+    assert ht.arange(2.5).dtype is ht.float32
+    assert ht.linspace(0, 1, 5).dtype is ht.float32
+    # full defaults to float32 regardless of the fill (reference quirk);
+    # dtype=None opts into fill-based inference — also for *_like on arrays
+    assert ht.full((4,), 4).dtype is ht.float32
+    assert ht.full((4,), 4, dtype=None).dtype is ht.int64
+    assert ht.full_like(ht.ones((4,), dtype=ht.int32), 2).dtype is ht.float32
+    fl = ht.full_like(ht.arange(4), 1.5, dtype=None)
+    assert fl.dtype is ht.float32
+    np.testing.assert_allclose(fl.numpy(), np.full(4, 1.5))
+    # NumPy inputs — scalars included — keep their own dtype
+    assert ht.array(np.ones(3)).dtype is ht.float64
+    assert ht.array(np.ones(3, np.int32)).dtype is ht.int32
+    assert ht.array(np.float64(1.5)).dtype is ht.float64
+    assert ht.array(np.complex128(1 + 2j)).dtype is ht.complex128
+    assert ht.array(np.int32(5)).dtype is ht.int32
+
+
 def test_eye_rect_and_split():
     for split in all_splits(2):
         assert_array_equal(ht.eye(5, split=split), np.eye(5))

@@ -1,0 +1,118 @@
+"""The public test harness (``heat_tpu/testing.py``) — parity with the
+reference's reusable ``TestCase`` (``heat/core/tests/test_suites/
+basic_test.py``), including that it catches the failure classes it exists
+to catch."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import testing as httest
+
+
+class TestAssertArrayEqual:
+    def test_accepts_matching_split_array(self):
+        a = np.arange(31 * 3, dtype=np.float32).reshape(31, 3)
+        for split in (None, 0, 1):
+            httest.assert_array_equal(ht.array(a, split=split), a)
+
+    def test_rejects_non_dndarray(self):
+        with pytest.raises(AssertionError, match="not a DNDarray"):
+            httest.assert_array_equal(np.ones(3), np.ones(3))
+
+    def test_rejects_wrong_shape(self):
+        x = ht.ones((4, 5), split=0)
+        with pytest.raises(AssertionError, match="global shape"):
+            httest.assert_array_equal(x, np.ones((5, 4)))
+
+    def test_rejects_wrong_values(self):
+        x = ht.ones((4, 5), split=1)
+        with pytest.raises(AssertionError):
+            httest.assert_array_equal(x, np.zeros((4, 5)))
+
+    def test_scalar_and_zero_size(self):
+        # ht.array(3.5) is float32 (the reference's torch-style scalar
+        # ladder) where bare np.asarray(3.5) would be float64
+        httest.assert_array_equal(ht.array(3.5), np.float32(3.5))
+        httest.assert_array_equal(ht.zeros((0, 4), split=0),
+                                  np.zeros((0, 4), dtype=np.float32))
+
+    def test_rejects_wrong_dtype(self):
+        x = ht.ones((3,), dtype=ht.int32, split=0)
+        with pytest.raises(AssertionError, match="dtype mismatch"):
+            httest.assert_array_equal(x, np.ones(3, dtype=np.float64))
+        # opt-out for quantized ground-truth comparisons
+        httest.assert_array_equal(ht.ones((3,), dtype=ht.float32),
+                                  np.ones(3), check_dtype=False)
+
+    def test_bfloat16_supported(self):
+        import jax.numpy as jnp
+        a = np.arange(8, dtype=np.float32)
+        x = ht.array(a, dtype=ht.bfloat16, split=0)
+        # bf16 vs bf16 must not crash, and bf16 vs float64 ground truth must
+        # use bf16's ulp (~7.8e-3), not float64's
+        httest.assert_array_equal(x, np.asarray(x.larray))
+        httest._compare(np.asarray(jnp.asarray(a * (1 + 3e-3), jnp.bfloat16)),
+                        a.astype(np.float64), "within one bf16 ulp")
+        with pytest.raises(AssertionError):
+            httest._compare(np.asarray(jnp.asarray(a + 1.0, jnp.bfloat16)),
+                            a.astype(np.float64), "off by 1 must fail")
+
+    def test_real_actual_vs_complex_desired_fails(self):
+        with pytest.raises(AssertionError):
+            httest._compare(np.array([0.0, 2.0], np.float32),
+                            np.array([2j, 2.0 + 0j]), "must not drop imag")
+        # matching real parts with ~0 imag still pass
+        httest._compare(np.array([1.0, 2.0], np.float32),
+                        np.array([1.0 + 0j, 2.0 + 0j]), "")
+
+
+class TestAssertFuncEqual:
+    def test_elementwise_passes(self):
+        httest.assert_func_equal((4, 5), ht.exp, np.exp,
+                                 data_types=(np.float32, np.float64), seed=0)
+
+    def test_reduction_replicated_result(self):
+        httest.assert_func_equal(
+            (3, 6), ht.any, np.any, distributed_result=False,
+            data_types=(np.int32,), seed=1)
+
+    def test_args_passthrough(self):
+        httest.assert_func_equal(
+            (5, 4), ht.sum, np.sum,
+            heat_args={"axis": 0}, numpy_args={"axis": 0},
+            data_types=(np.float32, np.int64), seed=2)
+
+    def test_mismatched_functions_fail(self):
+        with pytest.raises(AssertionError):
+            httest.assert_func_equal((4, 4), ht.exp, np.log,
+                                     data_types=(np.float32,), seed=3)
+
+    def test_for_tensor_every_split(self):
+        t = np.random.default_rng(4).standard_normal((6, 7, 2)).astype(
+            np.float32)
+        httest.assert_func_equal_for_tensor(t, ht.floor, np.floor)
+
+    def test_shape_validation(self):
+        with pytest.raises(ValueError, match="shape"):
+            httest.assert_func_equal(5, ht.exp, np.exp)
+
+
+class TestTestCaseBase(httest.TestCase):
+    """The unittest base class itself, run by pytest's unittest collector."""
+
+    def test_comm_and_device(self):
+        assert self.comm.size >= 1
+        assert self.get_size() == self.comm.size
+        assert self.get_rank() == 0
+        assert self.device is not None
+
+    def test_assert_methods_bound(self):
+        a = np.arange(12, dtype=np.int32).reshape(3, 4)
+        self.assert_array_equal(ht.array(a, split=0), a)
+        self.assert_func_equal((3, 3), ht.sqrt, np.sqrt,
+                               data_types=(np.float64,), seed=5)
+
+    def test_memory_layout_assertion(self):
+        x = ht.ones((3, 3))
+        self.assertTrue_memory_layout(x, "C")
