@@ -105,7 +105,10 @@ def average(x: DNDarray, axis=None, weights=None, returned=False):
             n = x.size if axis is None else np.prod([x.shape[a] for a in _axes(x, axis)])
             from . import factories
 
-            return result, factories.full_like(result, float(n))
+            # count inherits the result dtype (reference keeps the element
+            # count in result.dtype, ``statistics.py:261-263``); full_like's
+            # reference-parity float32 default would truncate counts > 2**24
+            return result, factories.full_like(result, float(n), dtype=result.dtype)
         return result
     if not isinstance(weights, DNDarray):
         from . import factories

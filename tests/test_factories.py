@@ -46,6 +46,48 @@ def test_like_factories_inherit_shape_dtype_split():
     assert_array_equal(fl, np.full((6, 3), 9.0), rtol=1e-6)
 
 
+def test_full_complex_fill_forces_complex64():
+    """Reference parity (``factories.py:841-842``): a complex fill upgrades
+    full's float32 dtype default (or an explicit float dtype) to complex64 —
+    regression: the float32 default once silently dropped the imaginary
+    part. An explicitly complex dtype is honored (complex128 stays 128,
+    deliberately better than the reference's blanket override)."""
+    f = ht.full((2,), 1 + 2j)
+    assert f.dtype is ht.complex64
+    np.testing.assert_allclose(f.numpy(), np.full((2,), 1 + 2j, np.complex64))
+    assert ht.full((2,), 1 + 2j, dtype=ht.float64).dtype is ht.complex64
+    fl = ht.full_like(ht.zeros(3), 2 + 0.5j)
+    assert fl.dtype is ht.complex64
+    np.testing.assert_allclose(fl.numpy(), np.full((3,), 2 + 0.5j, np.complex64))
+    assert ht.full((2,), 1 + 2j, dtype=ht.complex128).dtype is ht.complex128
+    # np.complex128 fill on the dtype=None inference path keeps its NumPy
+    # dtype (the float32 *default* still yields complex64, like any other
+    # complex fill — defaults follow the reference)
+    assert ht.full((2,), np.complex128(1 + 2j), dtype=None).dtype is ht.complex128
+    assert ht.full((2,), np.complex128(1 + 2j)).dtype is ht.complex64
+    # np.complex64 does not subclass python complex — still must upgrade
+    f64c = ht.full((2,), np.complex64(1 + 2j))
+    assert f64c.dtype is ht.complex64
+    np.testing.assert_allclose(f64c.numpy(), np.full((2,), 1 + 2j, np.complex64))
+
+
+def test_array_sequences_with_numpy_leaves_keep_dtype():
+    """Sequences holding NumPy-typed data keep NumPy's dtype (the torch
+    ladder infers float64 for ``[np.float64(x)]`` and for lists of f64
+    rows); only pure-python sequences downcast to float32/complex64."""
+    assert ht.array([np.float64(1.5)]).dtype is ht.float64
+    assert ht.array([np.complex128(1 + 2j)]).dtype is ht.complex128
+    assert ht.array([np.ones(2), np.zeros(2)]).dtype is ht.float64
+    assert ht.array([[np.float64(1.0)], [2.0]]).dtype is ht.float64
+    # pure python stays on the reference ladder
+    assert ht.array([1.5, 2.5]).dtype is ht.float32
+    assert ht.array([[1.0], [2.0]]).dtype is ht.float32
+    # 32-bit NumPy leaves mixed with weak python numbers stay float32 too
+    # (torch.tensor([np.float32(1.5), 2.5]) is float32)
+    assert ht.array([np.float32(1.5), 2.5]).dtype is ht.float32
+    assert ht.array([np.ones(2, np.float32), [1.0, 2.0]]).dtype is ht.float32
+
+
 def test_reference_dtype_ladder():
     """Inference parity with the reference's torch ladder for python data
     (``factories.py:318-331``; ``test_full`` pins float32 for int fills)."""

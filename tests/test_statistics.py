@@ -62,6 +62,15 @@ class TestMoments:
         r, s = ht.average(ht.array(data, split=0), returned=True)
         assert float(s.item()) == 6.0
 
+    def test_average_returned_count_dtype(self):
+        """The returned count inherits result.dtype (reference
+        ``statistics.py:261-263``) — regression: full_like's float32 default
+        once downcast float64 pipelines' counts (wrong above 2**24)."""
+        x = ht.array(np.ones(5, np.float64), split=0)
+        r, s = ht.average(x, returned=True)
+        assert s.dtype is ht.float64
+        assert float(s.item()) == 5.0
+
     def test_skew_kurtosis(self):
         rng = np.random.default_rng(2)
         data = rng.normal(size=1000).astype(np.float32)
