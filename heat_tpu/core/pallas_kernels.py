@@ -16,7 +16,10 @@ GB/s-critical tiles the framework runs in its hot loops:
   with the log-sum-exp per query row, which is exactly the merge state ring
   attention needs: per ring step each device runs this kernel on its
   resident K/V block and folds the result with the running ``(out, lse)``
-  pair.
+  pair. The backward is blockwise too (``_flash_bwd_impl``: dK/dV and dQ
+  grid kernels recomputing probabilities from the saved lse) — O(S·D)
+  memory instead of the dense fallback's O(Sq·Sk), so long-context
+  *training* fits in HBM, not just inference.
 
 On non-TPU backends every wrapper falls back to the interpreter
 (``interpret=True``), so the CPU test mesh exercises the same kernel code
@@ -33,6 +36,7 @@ from __future__ import annotations
 import functools
 import math
 import os
+import warnings
 from typing import Optional, Tuple
 
 import jax
@@ -56,12 +60,52 @@ _NEG_BIG = -1e30  # finite stand-in for -inf so exp() of masked rows is safe
 _MM_PRECISION = jax.lax.Precision.DEFAULT
 
 _override: Optional[bool] = None
+_mosaic_ok: Optional[bool] = None
 
 
 def set_pallas(enabled: Optional[bool]) -> None:
     """Force Pallas kernels on/off; ``None`` restores backend autodetection."""
     global _override
     _override = enabled
+
+
+def _mosaic_available() -> bool:
+    """One-time probe: can this TPU runtime actually compile a Mosaic kernel?
+
+    Remote-compile TPU runtimes (tunneled dev chips) can serve plain XLA
+    programs while their Mosaic kernel-compile path is down (observed: every
+    ``pallas_call`` fails with an HTTP 500 from the compile helper while jnp
+    programs run fine). Auto-selecting Pallas there would turn every hot op —
+    and the driver's flagship-model compile check — into a compile error, so
+    backend autodetection compiles one trivial 8x128 kernel first and falls
+    back to the XLA paths (with a warning) if that fails. Explicit opt-in
+    (``set_pallas(True)`` / ``HEAT_TPU_PALLAS=1``) bypasses the probe."""
+    global _mosaic_ok
+    if _mosaic_ok is None:
+        def _probe(x_ref, o_ref):
+            o_ref[...] = x_ref[...] + 1.0
+
+        try:
+            # ensure_compile_time_eval: pallas_enabled() is consulted at
+            # trace time inside jitted wrappers; the probe must execute
+            # eagerly there, not be staged into the caller's trace
+            with jax.ensure_compile_time_eval():
+                out = pl.pallas_call(
+                    _probe,
+                    out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                )(jnp.zeros((8, 128), jnp.float32))
+                jax.block_until_ready(out)
+            _mosaic_ok = True
+        except Exception as e:  # noqa: BLE001 — any compile/runtime failure
+            warnings.warn(
+                "Pallas/Mosaic kernel compilation is unavailable on this TPU "
+                f"runtime ({str(e)[:160]}); falling back to XLA implementations "
+                "of the hot ops. Set HEAT_TPU_PALLAS=1 to force kernels on.",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            _mosaic_ok = False
+    return _mosaic_ok
 
 
 def pallas_enabled() -> bool:
@@ -73,7 +117,7 @@ def pallas_enabled() -> bool:
         return False
     if env in ("1", "true", "True"):
         return True
-    return jax.default_backend() == "tpu"
+    return jax.default_backend() == "tpu" and _mosaic_available()
 
 
 def kmeans_pallas_enabled() -> bool:
@@ -90,6 +134,16 @@ def kmeans_pallas_enabled() -> bool:
 def _interpret() -> bool:
     # off-TPU the Mosaic compiler is unavailable; run the kernels interpreted
     return jax.default_backend() != "tpu"
+
+
+def interpret_vma_hazard(*ts) -> bool:
+    """True when the kernels would run INTERPRETED (off-TPU) on operands
+    carrying a nonempty varying-across-mesh-axes type: the Pallas HLO
+    interpreter's dynamic_slice rejects mixed-vma operands inside a
+    ``check_vma=True`` shard_map (the flagship transformer's train step), so
+    call sites with a jnp fallback should take it. Real Mosaic lowering on
+    TPU is unaffected — this never fires there."""
+    return _interpret() and bool(_vma(*ts))
 
 
 def _round_up(n: int, m: int) -> int:
@@ -337,6 +391,237 @@ def _flash_impl(
     return out, lse[:, :Sq, 0].reshape(B, H, Sq)
 
 
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dmb_ref,
+                          dk_ref, dv_ref, acc_dk, acc_dv, *, scale: float,
+                          block_q: int, block_k: int, kv_valid: int,
+                          causal_offset: Optional[int], acc_dtype):
+    """dK/dV for one K/V block, accumulated over the (innermost) Q-block
+    axis. Everything is computed in the TRANSPOSED (bk, bq) orientation so
+    every GEMM is a dim-1×dim-1 or dim-1×dim-0 contraction — no dim-0
+    contractions for Mosaic to build transpose temporaries for (the KMeans
+    kernel's scoped-VMEM failure mode, NEXT.md #1).
+
+    ``lse_ref``/``dmb_ref`` blocks are (1, 8, bq): the per-row statistics
+    pre-transposed host-side into an 8-sublane layout (lane dim = bq, a
+    128-multiple); the kernel reads sublane 0. ``dmb = dlse - delta`` is the
+    combined additive score-cotangent term (delta = rowsum(dout·out); dlse
+    is the lse cotangent ring attention feeds back)."""
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_qb = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        acc_dk[...] = jnp.zeros_like(acc_dk)
+        acc_dv[...] = jnp.zeros_like(acc_dv)
+
+    def step():
+        q = q_ref[0].astype(acc_dtype)
+        k = k_ref[0].astype(acc_dtype)
+        v = v_ref[0].astype(acc_dtype)
+        do = do_ref[0].astype(acc_dtype)
+        lse_row = lse_ref[0][:1, :]          # (1, bq)
+        dmb_row = dmb_ref[0][:1, :]          # (1, bq)
+        s_t = jax.lax.dot_general(
+            k, q * scale, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=acc_dtype,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                     # (bk, bq)
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 0) + kb * block_k
+        row = jax.lax.broadcasted_iota(jnp.int32, (block_k, block_q), 1) + qi * block_q
+        mask = col < kv_valid
+        if causal_offset is not None:
+            mask = jnp.logical_and(mask, col <= row + causal_offset)
+        # lse = +inf on padded query rows (p -> 0); -inf on fully-masked real
+        # rows would blow exp() up, so gate on finiteness like the dense path
+        p_t = jnp.where(
+            jnp.logical_and(mask, jnp.isfinite(lse_row)),
+            jnp.exp(s_t - lse_row), jnp.zeros((), acc_dtype))
+        dp_t = jax.lax.dot_general(
+            v, do, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=acc_dtype,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                     # (bk, bq)
+        ds_t = p_t * (dp_t + dmb_row)
+        acc_dv[...] += jax.lax.dot_general(
+            p_t, do, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=acc_dtype,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        acc_dk[...] += jax.lax.dot_general(
+            ds_t, q, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=acc_dtype,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    if causal_offset is None:
+        step()
+    else:
+        # skip Q blocks wholly above the diagonal for this K block
+        live = kb * block_k <= (qi + 1) * block_q - 1 + causal_offset
+        pl.when(live)(step)
+
+    @pl.when(qi == num_qb - 1)
+    def _flush():
+        dk_ref[0] = (acc_dk[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = acc_dv[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dmb_ref,
+                         dq_ref, acc_dq, *, scale: float, block_q: int,
+                         block_k: int, kv_valid: int,
+                         causal_offset: Optional[int], acc_dtype):
+    """dQ for one Q block, accumulated over the (innermost) K-block axis.
+    ``lse_ref``/``dmb_ref`` blocks are (1, bq, 8) (the forward's lse output
+    layout); the kernel reads lane 0."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+    num_kb = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_dq[...] = jnp.zeros_like(acc_dq)
+
+    def step():
+        q = q_ref[0].astype(acc_dtype)
+        k = k_ref[0].astype(acc_dtype)
+        v = v_ref[0].astype(acc_dtype)
+        do = do_ref[0].astype(acc_dtype)
+        lse_col = lse_ref[0][:, :1]          # (bq, 1)
+        dmb_col = dmb_ref[0][:, :1]          # (bq, 1)
+        s = jax.lax.dot_general(
+            q * scale, k, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=acc_dtype,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                     # (bq, bk)
+        row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + qi * block_q
+        col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + kb * block_k
+        mask = col < kv_valid
+        if causal_offset is not None:
+            mask = jnp.logical_and(mask, col <= row + causal_offset)
+        p = jnp.where(
+            jnp.logical_and(mask, jnp.isfinite(lse_col)),
+            jnp.exp(s - lse_col), jnp.zeros((), acc_dtype))
+        dp = jax.lax.dot_general(
+            do, v, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=acc_dtype,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                     # (bq, bk)
+        ds = p * (dp + dmb_col)
+        acc_dq[...] += jax.lax.dot_general(
+            ds, k, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=acc_dtype,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    if causal_offset is None:
+        step()
+    else:
+        live = kb * block_k <= (qi + 1) * block_q - 1 + causal_offset
+        pl.when(live)(step)
+
+    @pl.when(kb == num_kb - 1)
+    def _flush():
+        dq_ref[0] = (acc_dq[...] * scale).astype(dq_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "causal", "block_q", "block_k")
+)
+def _flash_bwd_impl(q, k, v, out, lse, dout, dlse, scale: float, causal: bool,
+                    block_q: int, block_k: int):
+    """Blockwise (flash) attention backward: O(S·D) memory per (batch, head)
+    instead of the dense fallback's O(Sq·Sk) probability matrix — the memory
+    profile long-context training needs. Two grid passes: dK/dV (Q-axis
+    innermost) and dQ (K-axis innermost), both recomputing probabilities
+    from the forward's saved lse."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    acc_dtype = jnp.float64 if jnp.promote_types(q.dtype, jnp.float32) == jnp.float64 else jnp.float32
+    bq = min(_round_up(block_q, 128), _round_up(Sq, 128))
+    bk = min(_round_up(block_k, 128), _round_up(Sk, 128))
+    sqp, skp, dp = _round_up(Sq, bq), _round_up(Sk, bk), _round_up(D, 128)
+    BH = B * H
+
+    qf = _pad_axis(_pad_axis(q.reshape(BH, Sq, D), 1, sqp), 2, dp)
+    kf = _pad_axis(_pad_axis(k.reshape(BH, Sk, D), 1, skp), 2, dp)
+    vf = _pad_axis(_pad_axis(v.reshape(BH, Sk, D), 1, skp), 2, dp)
+    dof = _pad_axis(_pad_axis(dout.reshape(BH, Sq, D), 1, sqp), 2, dp)
+
+    # per-row statistics: lse (padded +inf so padded rows give p = 0) and the
+    # combined additive term dmb = dlse - delta, delta = rowsum(dout·out)
+    delta = jnp.sum(dout.astype(acc_dtype) * out.astype(acc_dtype), axis=-1)
+    dmb = (dlse.astype(acc_dtype) - delta).reshape(BH, Sq)
+    lse_f = lse.astype(acc_dtype).reshape(BH, Sq)
+    pad = sqp - Sq
+    lse_f = jnp.pad(lse_f, ((0, 0), (0, pad)), constant_values=jnp.inf)
+    dmb = jnp.pad(dmb, ((0, 0), (0, pad)))
+    # both layouts: (BH, sqp, 8) for the dQ kernel (column reads), and the
+    # transposed (BH, 8, sqp) for the dK/dV kernel (row reads)
+    lse_c = jnp.broadcast_to(lse_f[:, :, None], (BH, sqp, 8))
+    dmb_c = jnp.broadcast_to(dmb[:, :, None], (BH, sqp, 8))
+    lse_r = jnp.broadcast_to(lse_f[:, None, :], (BH, 8, sqp))
+    dmb_r = jnp.broadcast_to(dmb[:, None, :], (BH, 8, sqp))
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    common = dict(
+        scale=float(scale), block_q=bq, block_k=bk, kv_valid=Sk,
+        causal_offset=(Sk - Sq) if causal else None, acc_dtype=acc_dtype,
+    )
+    vma = _vma(q, k, v, dout, dlse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        grid=(BH, skp // bk, sqp // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, dp), lambda b, kb, qi: (_i32(b), _i32(qi), _i32(0))),
+            pl.BlockSpec((1, bk, dp), lambda b, kb, qi: (_i32(b), _i32(kb), _i32(0))),
+            pl.BlockSpec((1, bk, dp), lambda b, kb, qi: (_i32(b), _i32(kb), _i32(0))),
+            pl.BlockSpec((1, bq, dp), lambda b, kb, qi: (_i32(b), _i32(qi), _i32(0))),
+            pl.BlockSpec((1, 8, bq), lambda b, kb, qi: (_i32(b), _i32(0), _i32(qi))),
+            pl.BlockSpec((1, 8, bq), lambda b, kb, qi: (_i32(b), _i32(0), _i32(qi))),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, dp), lambda b, kb, qi: (_i32(b), _i32(kb), _i32(0))),
+            pl.BlockSpec((1, bk, dp), lambda b, kb, qi: (_i32(b), _i32(kb), _i32(0))),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, skp, dp), k.dtype, vma=vma),
+            jax.ShapeDtypeStruct((BH, skp, dp), v.dtype, vma=vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, dp), acc_dtype),
+            pltpu.VMEM((bk, dp), acc_dtype),
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lse_r, dmb_r)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(BH, sqp // bq, skp // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, dp), lambda b, qi, kb: (_i32(b), _i32(qi), _i32(0))),
+            pl.BlockSpec((1, bk, dp), lambda b, qi, kb: (_i32(b), _i32(kb), _i32(0))),
+            pl.BlockSpec((1, bk, dp), lambda b, qi, kb: (_i32(b), _i32(kb), _i32(0))),
+            pl.BlockSpec((1, bq, dp), lambda b, qi, kb: (_i32(b), _i32(qi), _i32(0))),
+            pl.BlockSpec((1, bq, 8), lambda b, qi, kb: (_i32(b), _i32(qi), _i32(0))),
+            pl.BlockSpec((1, bq, 8), lambda b, qi, kb: (_i32(b), _i32(qi), _i32(0))),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, dp), lambda b, qi, kb: (_i32(b), _i32(qi), _i32(0))),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((BH, sqp, dp), q.dtype, vma=vma)],
+        scratch_shapes=[pltpu.VMEM((bq, dp), acc_dtype)],
+        interpret=_interpret(),
+    )(qf, kf, vf, dof, lse_c, dmb_c)[0]
+
+    dq = dq[:, :Sq, :D].reshape(B, H, Sq, D)
+    dk = dk[:, :Sk, :D].reshape(B, H, Sk, D)
+    dv = dv[:, :Sk, :D].reshape(B, H, Sk, D)
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_diff(q, k, v, scale, causal, block_q, block_k):
     return _flash_impl(q, k, v, scale, causal, block_q, block_k)
@@ -348,15 +633,20 @@ def _flash_diff_fwd(q, k, v, scale, causal, block_q, block_k):
 
 
 def _flash_diff_bwd(scale, causal, block_q, block_k, residuals, cotangents):
-    """Flash-attention backward: recompute probabilities from the saved lse
-    and apply the standard softmax-attention gradient (fp32). The lse output
-    is a differentiated product too (ring attention folds with it):
-    ``∂lse/∂S = P`` adds ``dlse·P`` to the score cotangent.
-
-    Memory is O(Sq·Sk) per (batch, head) — a jnp fallback rather than a
-    Pallas backward kernel; correct on every backend."""
+    """Flash-attention backward. Default: the blockwise Pallas kernels
+    (``_flash_bwd_impl``) — O(S·D) memory, recompute-from-lse, including the
+    ``dlse`` cotangent ring attention folds with (``∂lse/∂S = P`` adds
+    ``dlse·P`` to the score cotangent). When Pallas is unavailable, a dense
+    jnp fallback with the same math: O(Sq·Sk) memory per (batch, head),
+    correct on every backend."""
     q, k, v, out, lse = residuals
     dout, dlse = cotangents
+    # hazard-check the cotangents too: replicated q/k/v pass the forward's
+    # guard, but a loss that mixes the output with mesh-varying data hands
+    # this bwd a vma-carrying dout the interpreter would reject
+    if pallas_enabled() and not interpret_vma_hazard(q, k, v, dout, dlse):
+        return _flash_bwd_impl(q, k, v, out, lse, dout, dlse, scale, causal,
+                               block_q, block_k)
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
     doutf, outf = dout.astype(jnp.float32), out.astype(jnp.float32)
 

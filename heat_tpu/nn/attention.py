@@ -30,7 +30,7 @@ from jax import shard_map
 
 from ..core.communication import TPUCommunication, sanitize_comm
 from ..core.dndarray import DNDarray
-from ..core.pallas_kernels import flash_attention, pallas_enabled
+from ..core.pallas_kernels import flash_attention, interpret_vma_hazard, pallas_enabled
 
 __all__ = ["ring_attention", "ulysses_attention", "local_attention"]
 
@@ -41,7 +41,7 @@ def local_attention(q, k, v, scale: Optional[float] = None, causal: bool = False
     """Plain dense attention on local arrays (the single-device tile)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    if pallas_enabled() and q.ndim == 4:
+    if pallas_enabled() and q.ndim == 4 and not interpret_vma_hazard(q, k, v):
         # blockwise online-softmax kernel (Pallas, VMEM tiles)
         return flash_attention(q, k, v, scale=float(scale), causal=causal)
     logits = jnp.einsum("...qd,...kd->...qk", q, k) * scale
@@ -72,7 +72,7 @@ def _ring_body(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float, causal
     B, Sq, H, D = q_blk.shape
     q_heads = jnp.moveaxis(q_blk, 2, 1)  # (B, H, Sq, D)
 
-    if pallas_enabled():
+    if pallas_enabled() and not interpret_vma_hazard(q_blk, k_blk, v_blk):
         # per-step flash kernel on the resident K/V block; fold (out, lse).
         # Causal case: blocks are classified per step — step 0 holds the
         # device's own diagonal block (causal flash); any later block is

@@ -145,6 +145,73 @@ def ab(n=1 << 23, d=64, k=8, iters=50):
             print(tag, "FAILED:", str(e)[:160].replace("\n", " "), flush=True)
 
 
+def _timeit(fn, warmup=2, iters=10):
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def cdist_ab(n=40_000, d=18):
+    """Pallas fused tile (Precision.HIGHEST GEMM) vs the XLA expansion path
+    (package-default HIGH GEMM) at the distance_matrix bench shape
+    (NEXT.md #2)."""
+    from heat_tpu.core import pallas_kernels as pk
+
+    ht.random.seed(0)
+    x = ht.random.rand(n, d, dtype=ht.float32, split=0)
+    for pallas in (False, True, False):
+        pk.set_pallas(pallas)
+        try:
+            dt = _timeit(
+                lambda: ht.spatial.cdist(x, quadratic_expansion=True).larray,
+                warmup=2, iters=5)
+            gbs = n * n * 4 / dt / 1e9
+            print(f"cdist pallas={pallas}: {dt*1e3:.1f} ms  {gbs:.1f} GB/s",
+                  flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"cdist pallas={pallas} FAILED:",
+                  str(e)[:160].replace("\n", " "), flush=True)
+    pk.set_pallas(None)
+
+
+def flash_ab(B=4, H=8, S=2048, D=64):
+    """Pallas flash attention vs the dense jnp softmax path, causal and full,
+    fwd only and fwd+bwd (NEXT.md #2)."""
+    from heat_tpu.core import pallas_kernels as pk
+    from heat_tpu.nn import attention as attn
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, H, S, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, H, S, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, H, S, D), jnp.bfloat16)
+
+    def loss(q_, causal):
+        return attn.local_attention(q_, k, v, causal=causal)\
+            .astype(jnp.float32).sum()
+
+    for causal in (False, True):
+        for pallas in (False, True, False):
+            pk.set_pallas(pallas)
+            tag = f"flash causal={causal} pallas={pallas}"
+            try:
+                fwd = jax.jit(functools.partial(loss, causal=causal))
+                dt_f = _timeit(lambda: fwd(q))
+                grad = jax.jit(jax.grad(functools.partial(loss, causal=causal)))
+                dt_b = _timeit(lambda: grad(q))
+                print(f"{tag}: fwd {dt_f*1e3:.2f} ms  fwd+bwd {dt_b*1e3:.2f} ms",
+                      flush=True)
+            except Exception as e:  # noqa: BLE001
+                print(tag, "FAILED:", str(e)[:160].replace("\n", " "),
+                      flush=True)
+    pk.set_pallas(None)
+
+
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "bisect"
-    (bisect if mode == "bisect" else ab)()
+    {"bisect": bisect, "ab": ab, "cdist_ab": cdist_ab,
+     "flash_ab": flash_ab}[mode]()

@@ -272,3 +272,159 @@ class TestKMeansStepTile:
             rtol=1e-4, atol=1e-4)
         np.testing.assert_array_equal(km_p.labels_.numpy(), km_x.labels_.numpy())
         np.testing.assert_allclose(km_p.inertia_, km_x.inertia_, rtol=1e-4)
+
+
+class TestMosaicAvailabilityProbe:
+    """Backend autodetection must survive a TPU runtime whose Mosaic
+    kernel-compile service is down (remote-compile tunnels: XLA programs run,
+    every pallas_call 500s). The probe downgrades to the XLA paths instead of
+    poisoning every hot op with a compile error."""
+
+    @pytest.fixture(autouse=True)
+    def _reset_probe_state(self):
+        saved = pk._mosaic_ok
+        pk.set_pallas(None)
+        pk._mosaic_ok = None
+        yield
+        pk._mosaic_ok = saved
+        pk.set_pallas(None)
+
+    def test_probe_failure_disables_autoselection(self, monkeypatch):
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+        def boom(*a, **k):
+            raise RuntimeError("HTTP 500: tpu_compile_helper exit code 1")
+
+        monkeypatch.setattr(pk.pl, "pallas_call", boom)
+        with pytest.warns(RuntimeWarning, match="Mosaic"):
+            assert pk.pallas_enabled() is False
+        # cached: a second query neither re-probes nor re-warns
+        monkeypatch.setattr(pk.pl, "pallas_call", lambda *a, **k: 1 / 0)
+        assert pk.pallas_enabled() is False
+
+    def test_probe_success_enables_autoselection(self, monkeypatch):
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        # off-TPU the real probe kernel still runs via the interpreter only
+        # if asked to; patch pallas_call to the identity-ish happy path
+        import functools as ft
+
+        real = pk.pl.pallas_call
+        monkeypatch.setattr(
+            pk.pl, "pallas_call", ft.partial(real, interpret=True))
+        assert pk.pallas_enabled() is True
+
+    def test_explicit_env_optin_bypasses_probe(self, monkeypatch):
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(
+            pk.pl, "pallas_call",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("down")))
+        monkeypatch.setenv("HEAT_TPU_PALLAS", "1")
+        assert pk.pallas_enabled() is True  # user said so; no probe
+        monkeypatch.setenv("HEAT_TPU_PALLAS", "0")
+        assert pk.pallas_enabled() is False
+
+    def test_set_pallas_override_bypasses_probe(self, monkeypatch):
+        monkeypatch.setattr(
+            pk.pl, "pallas_call",
+            lambda *a, **k: (_ for _ in ()).throw(RuntimeError("down")))
+        pk.set_pallas(True)
+        assert pk.pallas_enabled() is True
+
+
+class TestFlashBlockwiseBackward:
+    """The Pallas blockwise backward (``_flash_bwd_impl``) vs the dense jnp
+    backward — same custom_vjp math, O(S·D) vs O(S²) memory."""
+
+    def _grads(self, q, k, v, causal, dlse_seed=None):
+        scale = 1.0 / math.sqrt(q.shape[-1])
+
+        def f(q, k, v):
+            out, lse = pk._flash_diff(q, k, v, scale, causal, 128, 128)
+            if dlse_seed is None:
+                return (out.astype(jnp.float32) ** 2).sum()
+            # fold lse into the loss so the dlse cotangent is nonzero —
+            # exactly what ring attention's merge does
+            w = jax.random.normal(jax.random.PRNGKey(dlse_seed), lse.shape)
+            return (out.astype(jnp.float32) ** 2).sum() + (lse * w).sum()
+
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("sq,sk", [(192, 192), (100, 260)])
+    def test_matches_dense_backward(self, causal, sq, sk, force_pallas):
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(kq, (1, 2, sq, 16), jnp.float32)
+        k = jax.random.normal(kk, (1, 2, sk, 16), jnp.float32)
+        v = jax.random.normal(kv, (1, 2, sk, 16), jnp.float32)
+        got = self._grads(q, k, v, causal, dlse_seed=7)
+        pk.set_pallas(False)  # dense path of the same custom_vjp
+        want = self._grads(q, k, v, causal, dlse_seed=7)
+        for g, w, name in zip(got, want, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=2e-4, atol=2e-4,
+                err_msg=f"d{name} mismatch (causal={causal})")
+
+    def test_bf16_inputs(self, force_pallas):
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = jax.random.normal(kq, (1, 1, 128, 32), jnp.bfloat16)
+        k = jax.random.normal(kk, (1, 1, 128, 32), jnp.bfloat16)
+        v = jax.random.normal(kv, (1, 1, 128, 32), jnp.bfloat16)
+        dq, dk, dv = self._grads(q, k, v, causal=True)
+        assert dq.dtype == jnp.bfloat16 and dk.dtype == jnp.bfloat16
+        assert np.isfinite(np.asarray(dq, np.float32)).all()
+        pk.set_pallas(False)
+        wq, wk, wv = self._grads(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(dq, np.float32), np.asarray(wq, np.float32),
+            rtol=0.1, atol=0.1)
+
+
+class TestInterpretVmaHazard:
+    """force_pallas + the flagship's check_vma=True shard_map must work on
+    the CPU mesh: the interpret-mode Pallas HLO interpreter rejects
+    mixed-vma operands, so attention falls back to the jnp path there
+    (``interpret_vma_hazard``); on real TPU the kernels stay on."""
+
+    def test_transformer_train_step_with_force_pallas(self, force_pallas):
+        import optax
+        from heat_tpu.nn.transformer import TransformerLM, TransformerLMConfig
+
+        grid = ht.MeshGrid((1, 1, 1, 4), ("dp", "pp", "tp", "sp"),
+                           devices=jax.devices()[:4])
+        cfg = TransformerLMConfig(vocab=32, d_model=8, n_heads=2, n_layers=1,
+                                  d_ff=16)
+        model = TransformerLM(grid, cfg)
+        params = model.init(0)
+        tx = optax.sgd(0.05)
+        opt = tx.init(params)
+        step = model.make_train_step(tx)
+        toks = model.shard_batch(
+            np.random.default_rng(0).integers(0, 32, (2, 16)))
+        params, opt, lval = step(params, opt, toks)
+        assert np.isfinite(float(lval))
+
+    def test_hazard_helper(self):
+        x = jnp.zeros((4, 4))
+        assert pk.interpret_vma_hazard(x) is False  # no vma, no hazard
+
+    def test_bwd_with_vma_carrying_cotangent(self, force_pallas):
+        """Replicated q/k/v pass the forward guard, but a loss mixing the
+        output with mesh-varying data hands the bwd a vma-carrying dout —
+        the bwd must fall back to the dense path in interpret mode."""
+        from jax.sharding import PartitionSpec as P
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:4]), ("x",))
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 1, 128, 8))
+        w = jnp.arange(4 * 128, dtype=jnp.float32).reshape(4, 128)
+
+        def body(q_rep, w_shard):
+            def loss(q_):
+                out = pk.flash_attention(q_, q_, q_, causal=True)
+                return (out[0, 0] * w_shard.T).sum()  # vma-carrying cotangent
+
+            return jax.grad(loss)(q_rep)
+
+        g = jax.shard_map(
+            body, mesh=mesh, in_specs=(P(), P("x")), out_specs=P("x"),
+            check_vma=True)(q, w)
+        assert np.isfinite(np.asarray(g)).all()
