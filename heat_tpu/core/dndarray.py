@@ -82,16 +82,23 @@ def _reshard_physical(parray, gshape, from_split, to_split, comm):
 
 class LocalIndex:
     """Parity shim for the reference's ``lloc`` local-indexing helper
-    (``dndarray.py:22-35``): indexes the physical array directly."""
+    (``dndarray.py:22-35``): indexes the physical array directly. Writes go
+    back into the owning array (jax arrays are immutable, so the functional
+    ``.at[].set()`` result must replace the owner's buffer — the reference
+    mutates the local torch tensor in place)."""
 
-    def __init__(self, obj):
-        self.obj = obj
+    def __init__(self, owner: "DNDarray"):
+        self._owner = owner
+
+    @property
+    def obj(self):
+        return self._owner.larray
 
     def __getitem__(self, key):
-        return self.obj[key]
+        return self._owner.larray[key]
 
     def __setitem__(self, key, value):
-        self.obj = self.obj.at[key].set(value)
+        self._owner.larray = self._owner.larray.at[key].set(value)
 
 
 class DNDarray:
@@ -284,7 +291,7 @@ class DNDarray:
 
     @property
     def lloc(self):
-        return LocalIndex(self.__parray)
+        return LocalIndex(self)
 
     @property
     def T(self) -> "DNDarray":
@@ -599,6 +606,35 @@ class DNDarray:
         from . import arithmetics
 
         return arithmetics.right_shift(self, other)
+
+    # reflected bitwise/shift operators: the reference stops at the
+    # arithmetic set (``arithmetics.py:528-635`` has no __rand__/__ror__/
+    # __rxor__/__rlshift__/__rrshift__, so ``6 & x`` raises there) — NumPy
+    # supports them, and the ht.* surface is NumPy's
+    def __rand__(self, other):
+        from . import arithmetics
+
+        return arithmetics.bitwise_and(other, self)
+
+    def __ror__(self, other):
+        from . import arithmetics
+
+        return arithmetics.bitwise_or(other, self)
+
+    def __rxor__(self, other):
+        from . import arithmetics
+
+        return arithmetics.bitwise_xor(other, self)
+
+    def __rlshift__(self, other):
+        from . import arithmetics
+
+        return arithmetics.left_shift(other, self)
+
+    def __rrshift__(self, other):
+        from . import arithmetics
+
+        return arithmetics.right_shift(other, self)
 
     def __eq__(self, other):
         from . import relational

@@ -190,8 +190,8 @@ def flash_ab(B=4, H=8, S=2048, D=64):
     k = jax.random.normal(kk, (B, H, S, D), jnp.bfloat16)
     v = jax.random.normal(kv, (B, H, S, D), jnp.bfloat16)
 
-    def loss(q_, causal):
-        return attn.local_attention(q_, k, v, causal=causal)\
+    def loss(q_, k_, v_, causal):
+        return attn.local_attention(q_, k_, v_, causal=causal)\
             .astype(jnp.float32).sum()
 
     for causal in (False, True):
@@ -200,9 +200,12 @@ def flash_ab(B=4, H=8, S=2048, D=64):
             tag = f"flash causal={causal} pallas={pallas}"
             try:
                 fwd = jax.jit(functools.partial(loss, causal=causal))
-                dt_f = _timeit(lambda: fwd(q))
-                grad = jax.jit(jax.grad(functools.partial(loss, causal=causal)))
-                dt_b = _timeit(lambda: grad(q))
+                dt_f = _timeit(lambda: fwd(q, k, v))
+                # grads wrt ALL of q/k/v: q-only would let XLA prune the
+                # dK/dV backward kernel and under-report the bwd cost
+                grad = jax.jit(jax.grad(
+                    functools.partial(loss, causal=causal), argnums=(0, 1, 2)))
+                dt_b = _timeit(lambda: grad(q, k, v))
                 print(f"{tag}: fwd {dt_f*1e3:.2f} ms  fwd+bwd {dt_b*1e3:.2f} ms",
                       flush=True)
             except Exception as e:  # noqa: BLE001

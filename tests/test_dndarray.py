@@ -268,3 +268,67 @@ class TestDivmod:
             q2, r2 = divmod(7, ht.array(ib, split=split))
             np.testing.assert_array_equal(q2.numpy(), 7 // ib)
             np.testing.assert_array_equal(r2.numpy(), 7 % ib)
+
+
+class TestScalarCastsAndSmallSurfaces:
+    """Reference ``test_dndarray.py`` corners: scalar dunder casts
+    (``test_bool_cast``/``test_int_cast``/``test_float_cast``/
+    ``test_complex_cast``), shifts, ``lloc``, byte/stride introspection,
+    ``fill_diagonal``, ``tolist``."""
+
+    def test_scalar_casts(self):
+        for split in (None, 0):
+            assert bool(ht.array([1], split=split)) is True
+            assert bool(ht.array([0.0], split=split)) is False
+            assert int(ht.array([3.7], split=split)) == 3
+            assert float(ht.array([2.5], split=split)) == 2.5
+            assert complex(ht.array([1 + 2j], split=split)) == 1 + 2j
+        # 0-d works too
+        assert int(ht.array(5)) == 5
+
+    def test_scalar_cast_multielement_raises(self):
+        for cast in (bool, int, float, complex):
+            with pytest.raises((TypeError, ValueError)):
+                cast(ht.arange(4, split=0))
+
+    def test_index_cast(self):
+        x = np.arange(10)
+        assert x[ht.array([3])] == 3  # __index__ path
+
+    def test_shift_operators(self):
+        a = np.array([1, 2, 4, 8], np.int64)
+        for split in (None, 0):
+            x = ht.array(a, split=split)
+            np.testing.assert_array_equal((x << 2).numpy(), a << 2)
+            np.testing.assert_array_equal((x >> 1).numpy(), a >> 1)
+            np.testing.assert_array_equal(
+                (x << ht.array([1, 1, 2, 2], split=split)).numpy(),
+                a << np.array([1, 1, 2, 2]))
+
+    def test_lloc_get_set(self):
+        x = ht.arange(16, split=0)
+        first = x.lloc[0]
+        assert first == x.larray[0]
+        x.lloc[0] = 99
+        assert int(x.larray[0]) == 99
+
+    def test_byte_and_stride_introspection(self):
+        x = ht.zeros((4, 6), dtype=ht.float32, split=0)
+        assert x.itemsize == 4
+        assert x.nbytes == 4 * 6 * 4
+        assert x.lnbytes == int(np.prod(x.lshape)) * 4
+        assert x.stride() == (6, 1)          # element strides, C order
+        assert x.strides == (24, 4)          # byte strides (numpy-style)
+
+    def test_fill_diagonal(self):
+        for split in (None, 0, 1):
+            x = ht.zeros((5, 7), split=split)
+            x.fill_diagonal(3.0)
+            ref = np.zeros((5, 7), np.float32)
+            np.fill_diagonal(ref, 3.0)
+            np.testing.assert_array_equal(x.numpy(), ref)
+
+    def test_tolist_and_len(self):
+        x = ht.arange(6, split=0).reshape((2, 3))
+        assert x.tolist() == [[0, 1, 2], [3, 4, 5]]
+        assert len(x) == 2

@@ -1,0 +1,195 @@
+"""Reference-suite corners not pinned elsewhere: reflected operators
+(``test_arithmetics.test_right_hand_side_operations``), iscomplex/isreal,
+random_sample alias, abstract type instantiation, I/O error paths
+(``test_io.test_load_exception``/``test_save_exception``)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+class TestRightHandSideOperations:
+    """Python scalar OP DNDarray for every arithmetic operator (reference
+    ``test_arithmetics.py::test_right_hand_side_operations``)."""
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_reflected_arithmetic(self, split):
+        a = np.array([1.0, 2.0, 4.0, 8.0], np.float32)
+        x = ht.array(a, split=split)
+        np.testing.assert_allclose((10 - x).numpy(), 10 - a)
+        np.testing.assert_allclose((10 + x).numpy(), 10 + a)
+        np.testing.assert_allclose((12 / x).numpy(), 12 / a, rtol=1e-6)
+        np.testing.assert_allclose((3 * x).numpy(), 3 * a)
+        np.testing.assert_allclose((2 ** x).numpy(), 2 ** a)
+        np.testing.assert_allclose((9 // x).numpy(), 9 // a)
+        np.testing.assert_allclose((7 % x).numpy(), 7 % a)
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_reflected_bitwise_shifts(self, split):
+        """Beyond reference: heat stops at the arithmetic reflected set
+        (``6 & x`` raises there); the ht.* surface is NumPy's, which
+        supports scalar OP array for the bitwise/shift family too."""
+        ia = np.array([1, 2, 3], np.int64)
+        x = ht.array(ia, split=split)
+        np.testing.assert_array_equal((8 >> x).numpy(), 8 >> ia)
+        np.testing.assert_array_equal((1 << x).numpy(), 1 << ia)
+        np.testing.assert_array_equal((6 & x).numpy(), 6 & ia)
+        np.testing.assert_array_equal((6 | x).numpy(), 6 | ia)
+        np.testing.assert_array_equal((6 ^ x).numpy(), 6 ^ ia)
+
+
+class TestComplexPredicates:
+    def test_iscomplex_isreal(self):
+        z = ht.array([1 + 0j, 1 + 2j, 0 + 0j], split=0)
+        np.testing.assert_array_equal(
+            ht.iscomplex(z).numpy(), [False, True, False])
+        np.testing.assert_array_equal(
+            ht.isreal(z).numpy(), [True, False, True])
+        r = ht.array([1.0, 2.0])
+        np.testing.assert_array_equal(ht.iscomplex(r).numpy(), [False, False])
+
+
+class TestRandomSampleAlias:
+    def test_random_sample(self):
+        ht.random.seed(7)
+        s = ht.random.random_sample((3, 2))
+        assert s.shape == (3, 2)
+        arr = s.numpy()
+        assert ((arr >= 0) & (arr < 1)).all()
+
+
+class TestAbstractTypes:
+    def test_abstract_types_not_instantiable(self):
+        for cls in (ht.types.generic, ht.types.flexible, ht.types.number,
+                    ht.types.integer, ht.types.floating):
+            with pytest.raises(TypeError):
+                cls()
+
+
+class TestIOErrorPaths:
+    def test_load_unknown_extension(self, tmp_path):
+        p = tmp_path / "data.xyz"
+        p.write_text("1,2,3")
+        with pytest.raises(ValueError):
+            ht.load(str(p))
+
+    def test_load_missing_file(self, tmp_path):
+        with pytest.raises((FileNotFoundError, OSError, ValueError)):
+            ht.load(str(tmp_path / "nope.h5"), dataset="data")
+
+    def test_save_unknown_extension(self, tmp_path):
+        with pytest.raises(ValueError):
+            ht.save(ht.arange(4), str(tmp_path / "out.xyz"))
+
+    def test_load_hdf5_missing_dataset(self, tmp_path):
+        import h5py
+
+        p = tmp_path / "a.h5"
+        with h5py.File(p, "w") as f:
+            f["data"] = np.arange(4.0)
+        with pytest.raises(KeyError):
+            ht.load_hdf5(str(p), dataset="not_there")
+
+    def test_load_hdf5_requires_dataset_kwarg(self, tmp_path):
+        import h5py
+
+        p = tmp_path / "b.h5"
+        with h5py.File(p, "w") as f:
+            f["data"] = np.arange(4.0)
+        out = ht.load(str(p), dataset="data", split=0)
+        np.testing.assert_allclose(out.numpy(), np.arange(4.0))
+
+
+class TestIrisFits:
+    """Reference estimator tests run on the bundled iris dataset
+    (``cluster/tests/test_kmeans.py::test_fit_iris``,
+    ``naive_bayes/tests``): end-to-end through ht.load + the estimator API
+    on real data."""
+
+    @pytest.fixture(scope="class")
+    def iris(self):
+        from heat_tpu import datasets
+
+        x = ht.load(datasets.path("iris.h5"), dataset="data", split=0)
+        y = np.loadtxt(datasets.path("iris_labels.csv"), delimiter=";",
+                       dtype=np.int64)
+        return x, y
+
+    def test_kmeans_fit_iris(self, iris):
+        x, _ = iris
+        km = ht.cluster.KMeans(n_clusters=3, init="kmeans++",
+                               max_iter=50, random_state=1).fit(x)
+        assert km.cluster_centers_.shape == (3, 4)
+        assert np.isfinite(km.inertia_)
+        labels = km.predict(x).numpy().ravel()
+        # three non-empty clusters on iris
+        assert set(np.unique(labels)) == {0, 1, 2}
+
+    def test_kmeans_fit_iris_unsplit(self, iris):
+        x, _ = iris
+        km0 = ht.cluster.KMeans(n_clusters=3, max_iter=30, random_state=2)
+        km0.fit(x)
+        kmr = ht.cluster.KMeans(n_clusters=3, max_iter=30, random_state=2)
+        kmr.fit(x.resplit(None))
+        # same seed, same data: split must not change the result
+        np.testing.assert_allclose(
+            np.sort(km0.cluster_centers_.numpy(), axis=0),
+            np.sort(kmr.cluster_centers_.numpy(), axis=0), rtol=1e-4)
+
+    def test_gaussian_nb_fit_iris(self, iris):
+        x, y = iris
+        gnb = ht.naive_bayes.GaussianNB()
+        gnb.fit(x, ht.array(y, split=0))
+        pred = gnb.predict(x).numpy().ravel()
+        # reference accuracy on train iris is > 0.9
+        assert (pred == y).mean() > 0.9
+
+    def test_knn_fit_iris(self, iris):
+        x, y = iris
+        knn = ht.classification.KNeighborsClassifier(n_neighbors=5)
+        knn.fit(x, ht.array(y, split=0))
+        pred = knn.predict(x).numpy().ravel()
+        assert (pred == y).mean() > 0.9
+
+    def test_spherical_clusters(self):
+        """Well-separated spherical blobs are exactly recovered (reference
+        ``test_kmeans.py::test_spherical_clusters``)."""
+        rng = np.random.default_rng(0)
+        centers = np.array([[0.0, 0.0], [10.0, 10.0], [-10.0, 10.0]])
+        pts = np.concatenate(
+            [rng.normal(c, 0.5, (50, 2)) for c in centers]).astype(np.float32)
+        km = ht.cluster.KMeans(n_clusters=3, init="kmeans++",
+                               max_iter=50, random_state=0)
+        km.fit(ht.array(pts, split=0))
+        got = np.sort(km.cluster_centers_.numpy(), axis=0)
+        want = np.sort(centers, axis=0)
+        np.testing.assert_allclose(got, want, atol=0.3)
+
+
+class TestLazyPassthroughs:
+    """The reference exposes torch.nn/optim/functional lazily via module
+    ``__getattr__`` (``heat/nn/__init__.py:19-48``); ours does the same over
+    flax/optax (``test_nn_getattr``/``test_optim_getattr``/
+    ``test_functional_getattr``)."""
+
+    def test_nn_getattr(self):
+        assert ht.nn.Dense is not None
+        assert ht.nn.Module is not None
+        with pytest.raises(AttributeError):
+            ht.nn.DoesNotExist_
+
+    def test_functional_getattr(self):
+        import numpy as np
+
+        out = ht.nn.functional.relu(ht.array([-1.0, 2.0]).larray)
+        np.testing.assert_array_equal(np.asarray(out), [0.0, 2.0])
+        with pytest.raises(AttributeError):
+            ht.nn.functional.not_a_function_
+
+    def test_optim_getattr(self):
+        import heat_tpu.optim as optim
+
+        assert optim.SGD is not None and optim.Adam is not None
+        with pytest.raises(AttributeError):
+            optim.NotAnOptimizer_
