@@ -135,6 +135,24 @@ def main() -> None:
     os.environ.setdefault("HEAT_TPU_PALLAS", "0")
     _require_live_backend()
 
+    # whole-run deadline: _require_live_backend only bounds the FIRST backend
+    # touch, but a half-up tunnel can also hang later, inside a compile or an
+    # execute. A daemon timer turns any such hang into a diagnosable exit.
+    import sys
+    import threading
+
+    def _deadline():
+        sys.stderr.write(
+            "bench: measurement exceeded 1800s — the accelerator runtime hung "
+            "after initialization (mid-compile or mid-execute). Aborting "
+            "instead of hanging.\n"
+        )
+        os._exit(5)
+
+    watchdog = threading.Timer(1800.0, _deadline)
+    watchdog.daemon = True
+    watchdog.start()
+
     ips = tpu_kmeans_iter_per_s(n)
     t_torch_small = torch_kmeans_time_per_iter(n_torch)
     t_torch_full_est = t_torch_small * (n / n_torch)

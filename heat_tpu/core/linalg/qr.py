@@ -39,6 +39,17 @@ def qr(a: DNDarray, tiles_per_proc: int = 1, calc_q: bool = True, overwrite_a: b
     """
     if not isinstance(a, DNDarray):
         raise TypeError(f"'a' must be a DNDarray, got {type(a)}")
+    # kwarg type validation matches the reference (``qr.py:100-110``): bool
+    # passes the int check there (int subclass, treated as 1) and no range
+    # check is applied — tiles_per_proc has no effect here anyway (TSQR's
+    # block size is the canonical shard)
+    if not isinstance(tiles_per_proc, int):
+        raise TypeError(
+            f"tiles_per_proc must be an int, got {type(tiles_per_proc)}")
+    if not isinstance(calc_q, bool):
+        raise TypeError(f"calc_q must be a bool, got {type(calc_q)}")
+    if not isinstance(overwrite_a, bool):
+        raise TypeError(f"overwrite_a must be a bool, got {type(overwrite_a)}")
     if a.ndim != 2:
         raise ValueError(f"qr requires a 2-D array, got {a.ndim}-D")
 

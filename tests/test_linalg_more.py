@@ -198,3 +198,47 @@ class TestBatchedMatmulEdge:
         out2 = ht.matmul(ht.array(a), ht.array(b, split=0))
         np.testing.assert_allclose(np.asarray(out2.numpy()), a @ b, rtol=1e-4, atol=1e-4)
         assert out2.split in (None, 0)
+
+
+class TestQRExtendedSweep:
+    """Scaled-down mirror of the reference's extended QR sweeps
+    (``test_qr.py::test_qr_sp0_ext``/``test_qr_sp1_ext``): reconstruction
+    and orthogonality over a grid of shapes — tall, square, wide, and
+    deliberately uneven against the 8-device mesh — for both splits and
+    both float dtypes."""
+
+    @pytest.mark.parametrize("split", [0, 1])
+    @pytest.mark.parametrize("m,n", [(50, 50), (53, 37), (37, 53),
+                                     (64, 17), (17, 64), (51, 8)])
+    def test_qr_shape_sweep(self, split, m, n):
+        rng = np.random.default_rng(m * 100 + n)
+        a_np = rng.standard_normal((m, n)).astype(np.float32)
+        qr = ht.linalg.qr(ht.array(a_np, split=split))
+        recon = (qr.Q @ qr.R).numpy()
+        np.testing.assert_allclose(recon, a_np, rtol=1e-4, atol=1e-4)
+        k = qr.Q.shape[1]
+        qtq = (qr.Q.T @ qr.Q).numpy()
+        np.testing.assert_allclose(qtq, np.eye(k, dtype=np.float32),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_qr_float64(self):
+        rng = np.random.default_rng(3)
+        a_np = rng.standard_normal((40, 20))
+        for split in (0, 1, None):
+            qr = ht.linalg.qr(ht.array(a_np, dtype=ht.float64, split=split))
+            np.testing.assert_allclose((qr.Q @ qr.R).numpy(), a_np,
+                                       rtol=1e-10, atol=1e-10)
+
+    def test_qr_error_paths(self):
+        a = ht.array(np.zeros((8, 4), np.float32))
+        with pytest.raises(TypeError):
+            ht.qr(np.zeros((4, 4)))
+        with pytest.raises(TypeError):
+            ht.qr(a, tiles_per_proc="ls")
+        with pytest.raises(TypeError):
+            ht.qr(a, calc_q=30)
+        with pytest.raises(TypeError):
+            ht.qr(a, overwrite_a=30)
+        # reference parity: bool is an int subclass and passes (treated as 1)
+        qr = ht.qr(a, tiles_per_proc=True)
+        assert qr.Q is not None
