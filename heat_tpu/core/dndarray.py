@@ -368,19 +368,17 @@ class DNDarray:
     # halo exchange (reference ``get_halo``/``array_with_halos``,        #
     # ``dndarray.py:332-445``) — ppermute edge exchange                  #
     # ------------------------------------------------------------------ #
-    def array_with_halos(self, halo_size: int) -> jax.Array:
-        """Physical array where every shard is extended by neighbor edges.
-
-        Returns a ``jax.Array`` of global shape ``(size * (chunk + 2*halo),
-        …)`` sharded along the split axis: each local block is
-        ``[prev_edge; block; next_edge]`` with zeros at the outer boundaries.
-        TPU-native form of the reference's Isend/Irecv halo exchange —
-        one ``ppermute`` shift in each direction.
-        """
+    def _halo_exchange(self, halo_size: int):
+        """One ``ppermute`` shift in each direction: returns the received
+        edges ``(from_prev, from_next)`` as sharded arrays of global shape
+        ``(size * halo_size, …)`` along the split axis, zeros on the outer
+        boundary shards. ``None`` when no exchange is needed (replicated,
+        ``halo_size == 0``, or a single device). The TPU-native form of the
+        reference's Isend/Irecv halo exchange."""
         if not isinstance(halo_size, int) or halo_size < 0:
             raise TypeError("halo_size must be a non-negative integer")
         if self.__split is None or halo_size == 0 or self.__comm.size == 1:
-            return self.__parray
+            return None
         k = self.__split
         comm = self.__comm
         n = comm.size
@@ -398,16 +396,47 @@ class DNDarray:
             prv = [(i + 1, i) for i in range(n - 1)]
             from_prev = jax.lax.ppermute(hi, comm.axis_name, perm=nxt)
             from_next = jax.lax.ppermute(lo, comm.axis_name, perm=prv)
-            return jnp.concatenate([from_prev, x, from_next], axis=k)
+            return from_prev, from_next
 
-        fn = shard_map(body, mesh=comm.mesh, in_specs=spec, out_specs=spec)
+        fn = shard_map(body, mesh=comm.mesh, in_specs=spec,
+                       out_specs=(spec, spec))
         return jax.jit(fn)(self.__parray)
 
+    def array_with_halos(self, halo_size: int) -> jax.Array:
+        """Physical array where every shard is extended by neighbor edges.
+
+        Returns a ``jax.Array`` of global shape ``(size * (chunk + 2*halo),
+        …)`` sharded along the split axis: each local block is
+        ``[prev_edge; block; next_edge]`` with zeros at the outer boundaries.
+        """
+        parts = self._halo_exchange(halo_size)
+        if parts is None:
+            return self.__parray
+        from_prev, from_next = parts
+        k = self.__split
+        comm = self.__comm
+        from jax import shard_map
+
+        spec = comm.spec(self.ndim, k)
+        fn = shard_map(
+            lambda p, x, nx: jnp.concatenate([p, x, nx], axis=k),
+            mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        return jax.jit(fn)(from_prev, self.__parray, from_next)
+
     def get_halo(self, halo_size: int) -> None:
-        """Computes and caches halo arrays (parity with reference ``:360``)."""
-        halos = self.array_with_halos(halo_size)
-        self.halo_prev = halos
-        self.halo_next = halos
+        """Computes and caches the per-direction halo arrays (reference
+        ``get_halo``, ``dndarray.py:360-433``): ``halo_prev`` holds the edge
+        received FROM the previous neighbor (the last ``halo_size`` rows of
+        its shard), ``halo_next`` the edge from the next neighbor — sharded
+        ``jax.Array``s of global shape ``(size * halo_size, …)`` along the
+        split axis, zeros on the outer boundary shards (the reference keeps
+        ``None`` there; static shapes require a uniform representation)."""
+        parts = self._halo_exchange(halo_size)
+        if parts is None:
+            self.halo_prev = None
+            self.halo_next = None
+        else:
+            self.halo_prev, self.halo_next = parts
         return None
 
     def counts_displs(self) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:

@@ -332,3 +332,39 @@ class TestScalarCastsAndSmallSurfaces:
         x = ht.arange(6, split=0).reshape((2, 3))
         assert x.tolist() == [[0, 1, 2], [3, 4, 5]]
         assert len(x) == 2
+
+
+class TestGetHaloDirections:
+    """get_halo caches the DISTINCT received edges (reference
+    ``dndarray.py:360-433``): halo_prev = previous neighbor's trailing rows,
+    halo_next = next neighbor's leading rows — not the combined array."""
+
+    def test_halo_prev_next_values(self):
+        n = ht.get_comm().size
+        if n == 1:
+            x = ht.arange(8, split=0)
+            x.get_halo(1)
+            assert x.halo_prev is None and x.halo_next is None
+            return
+        chunk = 4
+        x = ht.arange(n * chunk, split=0)
+        x.get_halo(1)
+        prev = np.asarray(x.halo_prev)   # (n, ) one received row per shard
+        nxt = np.asarray(x.halo_next)
+        for r in range(n):
+            if r > 0:  # last row of previous shard
+                assert prev[r] == (r - 1) * chunk + (chunk - 1)
+            else:
+                assert prev[0] == 0  # outer boundary: zero-filled
+            if r < n - 1:  # first row of next shard
+                assert nxt[r] == (r + 1) * chunk
+            else:
+                assert nxt[n - 1] == 0
+
+    def test_halo_trivial_cases_cache_none(self):
+        x = ht.arange(8)  # split=None
+        x.get_halo(2)
+        assert x.halo_prev is None and x.halo_next is None
+        y = ht.arange(8, split=0)
+        y.get_halo(0)
+        assert y.halo_prev is None and y.halo_next is None
