@@ -359,8 +359,25 @@ class TPUCommunication(Communication):
     # ------------------------------------------------------------------ #
     # sub-communicators                                                  #
     # ------------------------------------------------------------------ #
-    def Split(self, devices: Sequence[int], axis_name: Optional[str] = None):
-        """New communicator over a subset of devices (reference ``Split``, ``:445``)."""
+    def Split(self, devices: Optional[Sequence[int]] = None,
+              axis_name: Optional[str] = None, *, color=None, key=None):
+        """New communicator over a subset of devices (reference ``Split``,
+        ``:445``). MPI's per-rank ``Split(color, key)`` has no "this rank"
+        under the single-controller SPMD model — pass the subgroup's device
+        indices instead (one call per group)."""
+        if (color is not None or key is not None
+                or isinstance(devices, int)  # positional mpi4py color
+                or not (axis_name is None or isinstance(axis_name, str))):
+            # catches Split(color), Split(color, key) and Split(devs, key):
+            # mpi4py's convention is positional, so an int in either slot is
+            # migrating MPI code, not a device list / axis name
+            raise TypeError(
+                "MPI-style Split(color, key) is per-rank; under the "
+                "single-controller model pass the subgroup's device indices: "
+                "comm.Split(devices=[...]) — one call per group (see "
+                "doc/migrating_from_heat.md)")
+        if devices is None:
+            raise TypeError("Split requires the subgroup's device indices")
         sub = [self._devices[i] for i in devices]
         return TPUCommunication(sub, axis_name or self.axis_name)
 
