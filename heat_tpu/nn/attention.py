@@ -93,12 +93,7 @@ def _ring_body(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float, causal
             if causal and step > 0:
                 visible = ((rank - step) % size) < rank
                 lse_i = jnp.where(visible, lse_i, -jnp.inf)
-            lse_new = jnp.logaddexp(lse, lse_i)
-            # guard the -inf−(-inf) corner (first fold of each row)
-            w_old = jnp.where(jnp.isfinite(lse), jnp.exp(lse - lse_new), 0.0)
-            w_new = jnp.where(jnp.isfinite(lse_i), jnp.exp(lse_i - lse_new), 0.0)
-            acc = acc * w_old[..., None] + out_i.astype(jnp.float32) * w_new[..., None]
-            lse = lse_new
+            acc, lse = _fold(acc, lse, out_i.astype(jnp.float32), lse_i)
             if step != size - 1:
                 k_cur = jax.lax.ppermute(k_cur, axis, perm)
                 v_cur = jax.lax.ppermute(v_cur, axis, perm)
@@ -143,6 +138,185 @@ def _ring_body(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float, causal
     return jnp.moveaxis(out, 1, 2).astype(q_blk.dtype)  # (B, Sq, H, D)
 
 
+def _block_attn(q, k, v, scale: float, causal: bool):
+    """One attention block returning ``(out, lse)`` in f32 — the mergeable
+    form every ring schedule folds. ``q``/``k``/``v``: ``(B, H, s, D)``.
+    Routes through the flash kernel when enabled, else a dense jnp block."""
+    if pallas_enabled() and not interpret_vma_hazard(q, k, v):
+        out, lse = flash_attention(q, k, v, scale=float(scale), causal=causal,
+                                   return_lse=True)
+        return out.astype(jnp.float32), lse
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        row = jnp.arange(sq)[:, None]
+        col = jnp.arange(sk)[None, :]
+        logits = jnp.where(col <= row + (sk - sq), logits, -jnp.inf)
+    m = jnp.max(logits, axis=-1)
+    p = jnp.where(jnp.isfinite(logits), jnp.exp(logits - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    out = out / jnp.maximum(l[..., None], 1e-30)
+    return out, m + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _fold(acc, lse, out_i, lse_i):
+    """Numerically-stable merge of two normalized attention pieces by their
+    log-sum-exp weights; a ``lse_i = -inf`` piece is a no-op."""
+    lse_new = jnp.logaddexp(lse, lse_i)
+    w_old = jnp.where(jnp.isfinite(lse), jnp.exp(lse - lse_new), 0.0)
+    w_new = jnp.where(jnp.isfinite(lse_i), jnp.exp(lse_i - lse_new), 0.0)
+    return acc * w_old[..., None] + out_i * w_new[..., None], lse_new
+
+
+def _ring_body_zigzag(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float):
+    """Load-balanced causal ring attention (zigzag layout).
+
+    The naive causal ring computes every ``(2c × 2c)`` block then masks it:
+    device 0's queries see almost nothing (its steps fold to zero) while the
+    last device needs every step — and since the ring synchronizes at each
+    ``ppermute``, wall-clock is the BUSIEST device: 4c² of block work per
+    step everywhere. Re-laying the sequence so device ``i`` holds chunks
+    ``i`` and ``2n-1-i`` (half from the start, half from the end) makes the
+    live work identical on every device: per step one always-visible
+    half-block (late queries × early keys) plus exactly one of
+    {early × early, late × late} — 2c² per step, half the naive cost, with
+    zero load imbalance. The layout change is two ``ppermute`` streams in,
+    two out; visibility per step is the chunk-order predicate ``j < r``.
+
+    Local inputs ``(B, 2c, H, D)`` in contiguous split order; output in the
+    same order (the zigzag layout is internal).
+    """
+    parts = (zigzag_layout(q_blk, comm), zigzag_layout(k_blk, comm),
+             zigzag_layout(v_blk, comm))
+    if parts[0] is None:  # single device: plain causal attention
+        out = local_attention(jnp.moveaxis(q_blk, 2, 1), jnp.moveaxis(k_blk, 2, 1),
+                              jnp.moveaxis(v_blk, 2, 1), scale, causal=True)
+        return jnp.moveaxis(out, 1, 2)
+    out = _zigzag_core(*parts, comm=comm, scale=scale)
+    return zigzag_unlayout(out, comm)
+
+
+def zigzag_layout(x, comm: TPUCommunication):
+    """Contiguous split layout → zigzag layout along seq axis 1.
+
+    Contiguous device ``s`` holds chunks ``(2s, 2s+1)``; zigzag device ``d``
+    holds ``(d, 2n-1-d)``. Two permutation streams; at an even destination
+    the A-stream carries the early chunk, at an odd one the B-stream does.
+    Positionwise layers are layout-agnostic, so a transformer can relayout
+    ONCE after embedding, run every attention layer in zigzag layout via
+    :func:`_zigzag_core`, and invert once before the loss. Returns ``None``
+    on a single-device comm (no layout needed)."""
+    n = comm.size
+    if n == 1:
+        return None
+    axis = comm.axis_name
+    S2 = x.shape[1]
+    if S2 % 2 != 0:
+        raise ValueError(
+            f"zigzag schedule needs the global sequence divisible by 2*size "
+            f"(local block {S2} is odd)")
+    c = S2 // 2
+    even = (jax.lax.axis_index(axis) % 2) == 0
+    a, b = x[:, :c], x[:, c:]
+    p_a = [(s, 2 * s if 2 * s < n else 2 * n - 1 - 2 * s) for s in range(n)]
+    p_b = [(s, 2 * s + 1 if 2 * s + 1 < n else 2 * n - 2 - 2 * s)
+           for s in range(n)]
+    ra = jax.lax.ppermute(a, axis, p_a)
+    rb = jax.lax.ppermute(b, axis, p_b)
+    early = jnp.where(even, ra, rb)
+    late = jnp.where(even, rb, ra)
+    return jnp.concatenate([early, late], axis=1)
+
+
+def zigzag_unlayout(x, comm: TPUCommunication):
+    """Inverse of :func:`zigzag_layout`: zigzag device ``d`` returns its
+    early chunk ``d`` and late chunk ``2n-1-d`` to their contiguous owners
+    (chunk ``h`` lives on device ``h//2``, slot ``h%2``)."""
+    n = comm.size
+    if n == 1:
+        return x
+    axis = comm.axis_name
+    c = x.shape[1] // 2
+    even = (jax.lax.axis_index(axis) % 2) == 0
+    early, late = x[:, :c], x[:, c:]
+    to0 = jnp.where(even, early, late)   # even-numbered chunks
+    to1 = jnp.where(even, late, early)   # odd-numbered chunks
+    p0 = [(d, d // 2 if d % 2 == 0 else (2 * n - 1 - d) // 2)
+          for d in range(n)]
+    p1 = [(d, (2 * n - 1 - d) // 2 if d % 2 == 0 else d // 2)
+          for d in range(n)]
+    r0 = jax.lax.ppermute(to0, axis, p0)
+    r1 = jax.lax.ppermute(to1, axis, p1)
+    return jnp.concatenate([r0, r1], axis=1)
+
+
+def _zigzag_core(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float):
+    """The balanced causal ring on ALREADY-zigzag-layouted ``(B, 2c, H, D)``
+    blocks; output stays in zigzag layout."""
+    n = comm.size
+    axis = comm.axis_name
+    B, S2, H, D = q_blk.shape
+    c = S2 // 2
+    r = jax.lax.axis_index(axis)
+
+    qz = jnp.moveaxis(q_blk, 2, 1)                   # (B, H, 2c, D)
+    kz, vz = k_blk, v_blk                            # (B, 2c, H, D)
+    q_e, q_l = qz[:, :, :c], qz[:, :, c:]
+
+    acc_e = jnp.zeros((B, H, c, D), jnp.float32)
+    lse_e = jnp.full((B, H, c), -jnp.inf, jnp.float32)
+    acc_l = jnp.zeros((B, H, c, D), jnp.float32)
+    lse_l = jnp.full((B, H, c), -jnp.inf, jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    k_cur, v_cur = kz, vz
+    for t in range(n):
+        kh = jnp.moveaxis(k_cur, 2, 1)
+        vh = jnp.moveaxis(v_cur, 2, 1)
+        k_e, k_l = kh[:, :, :c], kh[:, :, c:]
+        v_e, v_l = vh[:, :, :c], vh[:, :, c:]
+
+        # late queries (chunks >= n) always see early keys (chunks < n)
+        o, l = _block_attn(q_l, k_e, v_e, scale, causal=False)
+        acc_l, lse_l = _fold(acc_l, lse_l, o, l)
+
+        if t == 0:
+            # resident diagonal blocks
+            o, l = _block_attn(q_e, k_e, v_e, scale, causal=True)
+            acc_e, lse_e = _fold(acc_e, lse_e, o, l)
+            o, l = _block_attn(q_l, k_l, v_l, scale, causal=True)
+            acc_l, lse_l = _fold(acc_l, lse_l, o, l)
+        else:
+            j = (r - t) % n  # origin rank of the resident K/V pair
+
+            def early_live(_):
+                o, l = _block_attn(q_e, k_e, v_e, scale, causal=False)
+                dead = (jnp.zeros_like(acc_l),
+                        jnp.full_like(lse_l, -jnp.inf))
+                return (o, l), dead
+
+            def late_live(_):
+                o, l = _block_attn(q_l, k_l, v_l, scale, causal=False)
+                dead = (jnp.zeros_like(acc_e),
+                        jnp.full_like(lse_e, -jnp.inf))
+                return dead, (o, l)
+
+            # exactly ONE of {early x early, late x late} is causally live
+            # per device per step — branch instead of compute-and-mask
+            (oe, le), (ol, ll) = jax.lax.cond(j < r, early_live, late_live, None)
+            acc_e, lse_e = _fold(acc_e, lse_e, oe, le)
+            acc_l, lse_l = _fold(acc_l, lse_l, ol, ll)
+
+        if t != n - 1:
+            k_cur = jax.lax.ppermute(k_cur, axis, perm)
+            v_cur = jax.lax.ppermute(v_cur, axis, perm)
+
+    out = jnp.concatenate([acc_e, acc_l], axis=2)     # (B, H, 2c, D)
+    return jnp.moveaxis(out, 1, 2).astype(q_blk.dtype)
+
+
 def _attn_spec(comm, batch_axis):
     """(batch, seq✂, heads, dim) PartitionSpec; with ``batch_axis`` the
     batch dimension is sharded over that grid axis too."""
@@ -155,7 +329,7 @@ def _attn_spec(comm, batch_axis):
 
 def ring_attention(
     q, k, v, comm=None, scale: Optional[float] = None, causal: bool = False,
-    batch_axis: Optional[str] = None,
+    batch_axis: Optional[str] = None, schedule: str = "ring",
 ):
     """Exact attention over a sequence sharded across the mesh.
 
@@ -166,11 +340,25 @@ def ring_attention(
     ``causal=True`` the global causal mask is applied per ring step (for
     autoregressive/LM training on sequence-sharded inputs).
 
+    ``schedule="zigzag"`` (causal only) uses the load-balanced layout —
+    device ``i`` holds sequence chunks ``i`` and ``2n-1-i`` internally — so
+    every device does identical live work per ring step (2 half-blocks vs
+    the naive schedule's 4, where the masked-out blocks are computed then
+    discarded and the last device gates the ring): ~2x causal wall-clock at
+    scale. Exact same math; requires the global sequence divisible by
+    ``2 * size``.
+
     On a :class:`~heat_tpu.core.communication.MeshGrid` axis view,
     ``batch_axis`` names another grid axis the batch dimension is sharded
     over — combined dp×sp: independent rings run per batch shard
     (``ring_attention(q, k, v, comm=grid.axis("sp"), batch_axis="dp")``).
     """
+    if schedule not in ("ring", "zigzag"):
+        raise ValueError(f"schedule must be 'ring' or 'zigzag', got {schedule!r}")
+    if schedule == "zigzag" and not causal:
+        raise ValueError(
+            "schedule='zigzag' only applies to causal attention — the "
+            "non-causal ring is already load-balanced")
     wrapped = isinstance(q, DNDarray)
     if wrapped:
         comm = q.comm
@@ -185,12 +373,15 @@ def ring_attention(
 
     key = (
         "ring_attn", qa.shape, ka.shape, str(qa.dtype), float(scale), comm.cache_key,
-        pallas_enabled(), causal, batch_axis,
+        pallas_enabled(), causal, batch_axis, schedule,
     )
     fn = _ATTN_CACHE.get(key)
     if fn is None:
         spec = _attn_spec(comm, batch_axis)
-        body = partial(_ring_body, comm=comm, scale=scale, causal=causal)
+        if schedule == "zigzag":
+            body = partial(_ring_body_zigzag, comm=comm, scale=scale)
+        else:
+            body = partial(_ring_body, comm=comm, scale=scale, causal=causal)
         sm = shard_map(
             body, mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
         )

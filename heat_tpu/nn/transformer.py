@@ -45,7 +45,7 @@ from jax import lax, shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.communication import MeshGrid
-from .attention import _ring_body
+from .attention import _ring_body, _zigzag_core, zigzag_layout, zigzag_unlayout
 from .parallel import pipeline_apply, switch_moe
 
 __all__ = ["TransformerLM", "TransformerLMConfig"]
@@ -63,12 +63,17 @@ class TransformerLMConfig:
     n_micro: int = 1                    # microbatches for the pp schedule
     compute_dtype: Any = jnp.float32    # bf16 on real TPUs for MXU rate
     init_scale: float = 0.02
+    attn_schedule: str = "ring"         # "ring" | "zigzag" (load-balanced sp)
 
     def __post_init__(self):
         if self.d_ff is None:
             self.d_ff = 4 * self.d_model
         if self.d_model % self.n_heads:
             raise ValueError("d_model must be divisible by n_heads")
+        if self.attn_schedule not in ("ring", "zigzag"):
+            raise ValueError(
+                f"attn_schedule must be 'ring' or 'zigzag', got "
+                f"{self.attn_schedule!r}")
 
     @property
     def head_dim(self) -> int:
@@ -201,7 +206,15 @@ class TransformerLM:
         qkv = jnp.einsum("bsd,dohk->bsohk", a_in, p["wqkv"])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         scale = 1.0 / math.sqrt(c.head_dim)
-        attn = _ring_body(q, k, v, comm=sp_comm, scale=scale, causal=True)
+        if c.attn_schedule == "zigzag" and sp_comm.size > 1:
+            # load-balanced causal ring: every sp device does identical live
+            # work per step. The token stream is ALREADY in zigzag layout —
+            # _loss_device relayouts once after embedding and inverts once
+            # before the loss, so each layer pays zero layout ppermutes
+            # (every non-attention op in the block is positionwise)
+            attn = _zigzag_core(q, k, v, comm=sp_comm, scale=scale)
+        else:
+            attn = _ring_body(q, k, v, comm=sp_comm, scale=scale, causal=True)
         attn_out = lax.psum(
             jnp.einsum("bshk,hkd->bsd", attn, p["wproj"]), "tp")
         x = x + attn_out
@@ -233,6 +246,12 @@ class TransformerLM:
         mb = B_local // c.n_micro
 
         x = params["embed"][toks].astype(c.compute_dtype)
+        zigzag = c.attn_schedule == "zigzag" and sp_comm.size > 1
+        if zigzag:
+            # one layout round-trip per forward: into zigzag here, back to
+            # contiguous before the loss — the layers in between are either
+            # positionwise (layout-agnostic) or zigzag-aware (_zigzag_core)
+            x = zigzag_layout(x, sp_comm)
         x_micro = x.reshape(c.n_micro, mb, S_local, c.d_model)
 
         stage_params = jax.tree.map(lambda a: a[0], params["stages"])
@@ -245,6 +264,8 @@ class TransformerLM:
 
         out = pipeline_apply(stage_fn, stage_params, x_micro, axis="pp")
         h = out.reshape(B_local, S_local, c.d_model)
+        if zigzag:
+            h = zigzag_unlayout(h, sp_comm)
         h = _rmsnorm(h, params["final_ln"])
         logits = (h @ params["unembed"].astype(c.compute_dtype)).astype(jnp.float32)
 
