@@ -64,6 +64,8 @@ class TransformerLMConfig:
     compute_dtype: Any = jnp.float32    # bf16 on real TPUs for MXU rate
     init_scale: float = 0.02
     attn_schedule: str = "ring"         # "ring" | "zigzag" (load-balanced sp)
+    rope: bool = True                   # rotary position embeddings on q/k
+    rope_theta: float = 10000.0
 
     def __post_init__(self):
         if self.d_ff is None:
@@ -74,6 +76,9 @@ class TransformerLMConfig:
             raise ValueError(
                 f"attn_schedule must be 'ring' or 'zigzag', got "
                 f"{self.attn_schedule!r}")
+        if self.rope and self.head_dim % 2:
+            raise ValueError(
+                f"rope needs an even head_dim, got {self.head_dim}")
 
     @property
     def head_dim(self) -> int:
@@ -83,6 +88,22 @@ class TransformerLMConfig:
 def _rmsnorm(x, scale):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * scale
+
+
+def rope_apply(x, pos, theta: float = 10000.0):
+    """Rotary position embedding (half-split convention) on ``(mb, S, H,
+    Dh)`` with GLOBAL token positions ``pos`` of shape ``(S,)``. Positions
+    are supplied explicitly because under sequence parallelism the local
+    block's positions depend on the layout: contiguous split gives
+    ``r*S_local + arange``, the zigzag layout two chunk-offset ranges."""
+    half = x.shape[-1] // 2
+    freq = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * freq[None, :]       # (S, half)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
 
 
 class TransformerLM:
@@ -195,8 +216,10 @@ class TransformerLM:
     # the per-device program                                        #
     # ------------------------------------------------------------- #
 
-    def _block(self, p, x, sp_comm):
-        """One transformer layer on a local microbatch (mb, S_local, D)."""
+    def _block(self, p, x, sp_comm, pos):
+        """One transformer layer on a local microbatch (mb, S_local, D).
+        ``pos``: global positions of this device's S_local tokens (layout-
+        aware, computed once per forward in ``_loss_device``)."""
         c = self.cfg
         Hs = c.n_heads // self.tp
         mb, S_local, D = x.shape
@@ -205,6 +228,9 @@ class TransformerLM:
         # qkv: (mb, S, D) x (D, 3, Hs, Dh) — local head subset
         qkv = jnp.einsum("bsd,dohk->bsohk", a_in, p["wqkv"])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if c.rope:
+            q = rope_apply(q, pos, c.rope_theta)
+            k = rope_apply(k, pos, c.rope_theta)
         scale = 1.0 / math.sqrt(c.head_dim)
         if c.attn_schedule == "zigzag" and sp_comm.size > 1:
             # load-balanced causal ring: every sp device does identical live
@@ -247,11 +273,22 @@ class TransformerLM:
 
         x = params["embed"][toks].astype(c.compute_dtype)
         zigzag = c.attn_schedule == "zigzag" and sp_comm.size > 1
+        sp_idx = lax.axis_index("sp")
         if zigzag:
             # one layout round-trip per forward: into zigzag here, back to
             # contiguous before the loss — the layers in between are either
             # positionwise (layout-agnostic) or zigzag-aware (_zigzag_core)
             x = zigzag_layout(x, sp_comm)
+            # global positions of the zigzag-resident tokens: chunk sp_idx
+            # and chunk 2n-1-sp_idx
+            half = S_local // 2
+            n_sp = sp_comm.size
+            pos = jnp.concatenate([
+                sp_idx * half + jnp.arange(half),
+                (2 * n_sp - 1 - sp_idx) * half + jnp.arange(half),
+            ])
+        else:
+            pos = sp_idx * S_local + jnp.arange(S_local)
         x_micro = x.reshape(c.n_micro, mb, S_local, c.d_model)
 
         stage_params = jax.tree.map(lambda a: a[0], params["stages"])
@@ -259,7 +296,7 @@ class TransformerLM:
         def stage_fn(sp_params, xm):
             for l in range(self.layers_per_stage):
                 p_l = jax.tree.map(lambda a: a[l], sp_params)
-                xm = self._block(p_l, xm, sp_comm)
+                xm = self._block(p_l, xm, sp_comm, pos)
             return xm
 
         out = pipeline_apply(stage_fn, stage_params, x_micro, axis="pp")

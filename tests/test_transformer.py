@@ -30,8 +30,10 @@ def _rmsnorm(x, scale):
 def dense_loss(host_params, toks, cfg):
     """Single-device reference with the model's exact layer math."""
     from utils import dense_causal_attention_jnp
+    from heat_tpu.nn.transformer import rope_apply
 
     x = host_params["embed"][toks]
+    pos = jnp.arange(toks.shape[1])
     stages = host_params["stages"]
     pp, Ls = stages["wqkv"].shape[:2]
     for s in range(pp):
@@ -40,6 +42,9 @@ def dense_loss(host_params, toks, cfg):
             a_in = _rmsnorm(x, p["ln1"])
             qkv = jnp.einsum("bsd,dohk->bsohk", a_in, p["wqkv"])
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            if cfg.rope:
+                q = rope_apply(q, pos, cfg.rope_theta)
+                k = rope_apply(k, pos, cfg.rope_theta)
             attn = dense_causal_attention_jnp(q, k, v)
             x = x + jnp.einsum("bshk,hkd->bsd", attn, p["wproj"])
             m_in = _rmsnorm(x, p["ln2"])
@@ -227,3 +232,42 @@ class TestZigzagSchedule:
         lz, _ = lg_z(model.init(0), toks)
         lr, _ = lg_r(params_r, toks)
         np.testing.assert_allclose(float(lz), float(lr), rtol=1e-5)
+
+
+class TestRope:
+    def test_rope_known_values(self):
+        """Independent check of the rotation math (the dense parity reference
+        shares rope_apply, so the formula needs its own ground truth):
+        with head_dim 2 there is one frequency (theta^0 = 1) and
+        rope(x, p) = [x0*cos(p) - x1*sin(p), x0*sin(p) + x1*cos(p)]."""
+        from heat_tpu.nn.transformer import rope_apply
+
+        x = jnp.asarray([[[[1.0, 0.0]], [[0.0, 2.0]]]])  # (1, 2, 1, 2)
+        pos = jnp.asarray([0, 3])
+        got = np.asarray(rope_apply(x, pos))
+        np.testing.assert_allclose(got[0, 0, 0], [1.0, 0.0], atol=1e-6)
+        np.testing.assert_allclose(
+            got[0, 1, 0],
+            [-2.0 * math.sin(3.0), 2.0 * math.cos(3.0)], atol=1e-6)
+
+    def test_rope_relative_position_property(self):
+        """The defining RoPE property: q·k after rotation depends only on
+        the position DIFFERENCE — rope(q,p1)·rope(k,p2) == rope(q,p1+s)·rope(k,p2+s)."""
+        from heat_tpu.nn.transformer import rope_apply
+
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((1, 1, 1, 16)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 1, 1, 16)), jnp.float32)
+
+        def score(p1, p2):
+            qr = rope_apply(q, jnp.asarray([p1]))
+            kr = rope_apply(k, jnp.asarray([p2]))
+            return float(jnp.sum(qr * kr))
+
+        np.testing.assert_allclose(score(5, 2), score(105, 102), rtol=1e-4)
+        np.testing.assert_allclose(score(9, 9), score(0, 0), rtol=1e-4)
+        assert abs(score(5, 2) - score(5, 4)) > 1e-6  # and it DOES vary
+
+    def test_odd_head_dim_rejected(self):
+        with pytest.raises(ValueError, match="even head_dim"):
+            TransformerLMConfig(vocab=8, d_model=6, n_heads=2)
