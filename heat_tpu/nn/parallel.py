@@ -220,6 +220,17 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro, *, axis: str):
     T = n_micro + pp - 1
     perm = [(i, i + 1) for i in range(pp - 1)]  # no wraparound
 
+    # the rotating buffers assume the stage preserves dtype (a dtype change
+    # would silently corrupt the masked writes). Checked on EVERY path —
+    # incl. the degenerate pp==1 mesh developers test on — so the contract
+    # fails loud before a real pipeline deployment
+    out_struct = jax.eval_shape(stage_fn, stage_params, x_micro[0])
+    if out_struct.dtype != x_micro.dtype:
+        raise TypeError(
+            f"pipeline stage changed activation dtype {x_micro.dtype} -> "
+            f"{out_struct.dtype}; keep compute dtype uniform across stages "
+            "(cast params inside the stage, not activations between stages)")
+
     if pp == 1:
         # degenerate pipeline: run the stage per microbatch (scan, not vmap —
         # the stage may contain collectives over other axes). The identity

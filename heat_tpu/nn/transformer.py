@@ -66,6 +66,8 @@ class TransformerLMConfig:
     attn_schedule: str = "ring"         # "ring" | "zigzag" (load-balanced sp)
     rope: bool = True                   # rotary position embeddings on q/k
     rope_theta: float = 10000.0
+    remat: bool = False                 # jax.checkpoint each layer: trade
+                                        # recompute FLOPs for activation HBM
 
     def __post_init__(self):
         if self.d_ff is None:
@@ -224,6 +226,15 @@ class TransformerLM:
         Hs = c.n_heads // self.tp
         mb, S_local, D = x.shape
 
+        # mixed precision: master params stay f32 in the optimizer; compute
+        # runs in compute_dtype (bf16 on real TPUs for MXU rate). Without
+        # this cast f32 params silently promote every activation back to f32
+        # and compute_dtype never takes effect.
+        if c.compute_dtype != jnp.float32:
+            p = jax.tree.map(
+                lambda a: a.astype(c.compute_dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+
         a_in = _rmsnorm(x, p["ln1"])
         # qkv: (mb, S, D) x (D, 3, Hs, Dh) — local head subset
         qkv = jnp.einsum("bsd,dohk->bsohk", a_in, p["wqkv"])
@@ -293,17 +304,33 @@ class TransformerLM:
 
         stage_params = jax.tree.map(lambda a: a[0], params["stages"])
 
+        def block(p_l, xm):
+            return self._block(p_l, xm, sp_comm, pos)
+
+        if c.remat:
+            # rematerialise each layer on the backward pass: activation HBM
+            # drops from O(n_layers) to O(1) blocks per stage at the cost of
+            # one extra forward — the standard deep-model memory trade
+            # (jax.checkpoint per the TPU HBM playbook)
+            # prevent_cse=False: every call site is inside a lax.scan (the
+            # pipeline tick / microbatch scan), where the CSE barriers the
+            # default inserts are documented as unnecessary overhead
+            block = jax.checkpoint(block, prevent_cse=False)
+
         def stage_fn(sp_params, xm):
             for l in range(self.layers_per_stage):
                 p_l = jax.tree.map(lambda a: a[l], sp_params)
-                xm = self._block(p_l, xm, sp_comm, pos)
+                xm = block(p_l, xm)
             return xm
 
         out = pipeline_apply(stage_fn, stage_params, x_micro, axis="pp")
         h = out.reshape(B_local, S_local, c.d_model)
         if zigzag:
             h = zigzag_unlayout(h, sp_comm)
-        h = _rmsnorm(h, params["final_ln"])
+        # final_ln must be cast too: an f32 scale would promote h to f32 and
+        # push the (d_model x vocab) head GEMM — the largest single matmul —
+        # off the bf16 MXU path; logits upcast to f32 only after the GEMM
+        h = _rmsnorm(h, params["final_ln"].astype(c.compute_dtype))
         logits = (h @ params["unembed"].astype(c.compute_dtype)).astype(jnp.float32)
 
         # next-token targets across the sharded sequence: local shift plus
