@@ -61,7 +61,10 @@ def main():
           f"{model.layers_per_stage}  heads/shard {cfg.n_heads // model.tp}")
 
     rng = np.random.default_rng(0)
-    base = np.arange(args.batch * args.seq_len).reshape(args.batch, args.seq_len)
+    # round the batch up so it divides dp x n_micro on any grid
+    unit = model.dp * cfg.n_micro
+    batch = -(-args.batch // unit) * unit
+    base = np.arange(batch * args.seq_len).reshape(batch, args.seq_len)
     tokens = ((base + rng.integers(0, 2, base.shape)) % args.vocab)
     toks = model.shard_batch(tokens)
 
@@ -74,6 +77,16 @@ def main():
         params, opt_state, lval = step(params, opt_state, toks)
         if i % 5 == 0 or i == args.steps - 1:
             print(f"step {i:3d}: loss {float(lval):.4f}")
+
+    # KV-cached greedy decode needs a token-recurrent grid (pp=sp=1, dense
+    # MLP); skip the demo on pipelined / sequence-sharded / MoE configs
+    if model.pp == 1 and model.sp == 1 and not cfg.moe_experts:
+        # exactly dp prompt rows (tile if the training batch is smaller)
+        reps = -(-model.dp // tokens.shape[0])
+        prompt = np.tile(tokens, (reps, 1))[:model.dp, :8].astype(np.int32)
+        out = np.asarray(model.generate(params, prompt, max_new_tokens=12))
+        print("prompt:   ", prompt[0].tolist())
+        print("generated:", out[0, 8:].tolist())
 
 
 if __name__ == "__main__":

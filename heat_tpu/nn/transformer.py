@@ -45,7 +45,8 @@ from jax import lax, shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.communication import MeshGrid
-from .attention import _ring_body, _zigzag_core, zigzag_layout, zigzag_unlayout
+from .attention import (_ring_body, _zigzag_core, local_attention,
+                        zigzag_layout, zigzag_unlayout)
 from .parallel import pipeline_apply, switch_moe
 
 __all__ = ["TransformerLM", "TransformerLMConfig"]
@@ -226,22 +227,8 @@ class TransformerLM:
         Hs = c.n_heads // self.tp
         mb, S_local, D = x.shape
 
-        # mixed precision: master params stay f32 in the optimizer; compute
-        # runs in compute_dtype (bf16 on real TPUs for MXU rate). Without
-        # this cast f32 params silently promote every activation back to f32
-        # and compute_dtype never takes effect.
-        if c.compute_dtype != jnp.float32:
-            p = jax.tree.map(
-                lambda a: a.astype(c.compute_dtype)
-                if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
-
-        a_in = _rmsnorm(x, p["ln1"])
-        # qkv: (mb, S, D) x (D, 3, Hs, Dh) — local head subset
-        qkv = jnp.einsum("bsd,dohk->bsohk", a_in, p["wqkv"])
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if c.rope:
-            q = rope_apply(q, pos, c.rope_theta)
-            k = rope_apply(k, pos, c.rope_theta)
+        p = self._cast_params(p)
+        q, k, v = self._qkv(p, x, pos)
         scale = 1.0 / math.sqrt(c.head_dim)
         if c.attn_schedule == "zigzag" and sp_comm.size > 1:
             # load-balanced causal ring: every sp device does identical live
@@ -252,9 +239,7 @@ class TransformerLM:
             attn = _zigzag_core(q, k, v, comm=sp_comm, scale=scale)
         else:
             attn = _ring_body(q, k, v, comm=sp_comm, scale=scale, causal=True)
-        attn_out = lax.psum(
-            jnp.einsum("bshk,hkd->bsd", attn, p["wproj"]), "tp")
-        x = x + attn_out
+        x = self._attn_residual(p, x, attn)
 
         m_in = _rmsnorm(x, p["ln2"])
         if c.moe_experts:
@@ -266,11 +251,52 @@ class TransformerLM:
                     flat, p["router"], p["w_up"], p["w_down"], axis="dp",
                     capacity_factor=c.capacity_factor),
                 "tp")
-            x = x + moe_out.reshape(mb, S_local, D)
-        else:
-            h = jax.nn.gelu(m_in @ p["w_up"])
-            x = x + lax.psum(h @ p["w_down"], "tp")
-        return x
+            return x + moe_out.reshape(mb, S_local, D)
+        return self._dense_mlp_residual(p, x, m_in)
+
+    # shared layer math — _block (training), the prefill pass and the
+    # cached decode step (generate) all call these, so an architecture
+    # change lands everywhere at once
+
+    def _cast_params(self, p):
+        """Mixed precision: master params stay f32 in the optimizer; compute
+        runs in compute_dtype (bf16 on real TPUs for MXU rate). Without this
+        cast f32 params silently promote every activation back to f32 and
+        compute_dtype never takes effect."""
+        c = self.cfg
+        if c.compute_dtype == jnp.float32:
+            return p
+        return jax.tree.map(
+            lambda a: a.astype(c.compute_dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, p)
+
+    def _qkv(self, p, x, pos):
+        """Pre-norm qkv projection for the local head subset, with rotary
+        rotation by the GLOBAL positions ``pos``."""
+        c = self.cfg
+        a_in = _rmsnorm(x, p["ln1"])
+        qkv = jnp.einsum("bsd,dohk->bsohk", a_in, p["wqkv"])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if c.rope:
+            q = rope_apply(q, pos, c.rope_theta)
+            k = rope_apply(k, pos, c.rope_theta)
+        return q, k, v
+
+    def _attn_residual(self, p, x, attn):
+        """Row-parallel output projection (one tp psum) + residual."""
+        return x + lax.psum(
+            jnp.einsum("bshk,hkd->bsd", attn, p["wproj"]), "tp")
+
+    def _dense_mlp_residual(self, p, x, m_in):
+        h = jax.nn.gelu(m_in @ p["w_up"])
+        return x + lax.psum(h @ p["w_down"], "tp")
+
+    def _head(self, params, h):
+        """Final norm + unembed; logits upcast to f32 only after the GEMM —
+        an f32 norm scale would push the largest matmul off the bf16 path."""
+        c = self.cfg
+        h = _rmsnorm(h, params["final_ln"].astype(c.compute_dtype))
+        return (h @ params["unembed"].astype(c.compute_dtype)).astype(jnp.float32)
 
     def _loss_device(self, params, toks):
         """Per-device code: toks (B_local, S_local) -> replicated global loss."""
@@ -327,11 +353,7 @@ class TransformerLM:
         h = out.reshape(B_local, S_local, c.d_model)
         if zigzag:
             h = zigzag_unlayout(h, sp_comm)
-        # final_ln must be cast too: an f32 scale would promote h to f32 and
-        # push the (d_model x vocab) head GEMM — the largest single matmul —
-        # off the bf16 MXU path; logits upcast to f32 only after the GEMM
-        h = _rmsnorm(h, params["final_ln"].astype(c.compute_dtype))
-        logits = (h @ params["unembed"].astype(c.compute_dtype)).astype(jnp.float32)
+        logits = self._head(params, h)
 
         # next-token targets across the sharded sequence: local shift plus
         # the neighbour shard's first token via ppermute (the halo pattern,
@@ -413,3 +435,137 @@ class TransformerLM:
             return optax.apply_updates(params, updates), opt_state, loss
 
         return step
+
+    # ------------------------------------------------------------- #
+    # generation (KV-cached autoregressive decode)                  #
+    # ------------------------------------------------------------- #
+
+    def generate(self, params, prompts, max_new_tokens: int,
+                 temperature: float = 0.0, seed: int = 0):
+        """Autoregressive decode with a per-layer KV cache.
+
+        ``prompts``: ``(B, S0)`` int tokens; returns ``(B, S0 +
+        max_new_tokens)`` (prompt included). ``temperature=0`` is greedy,
+        otherwise softmax sampling at that temperature. Runs on the model's
+        grid with the batch sharded over dp and heads/features over tp;
+        decode is a single compiled program (prefill pass + a
+        ``lax.scan`` over steps). Requires ``pp == sp == 1`` (decode is
+        token-recurrent: a pipelined or sequence-sharded layout would idle
+        on the single live token) and a dense MLP (no MoE routing at S=1).
+
+        K/V are cached post-RoPE, so each cache row is rotated by its own
+        absolute position exactly as in the training forward.
+        """
+        c = self.cfg
+        if self.pp != 1 or self.sp != 1:
+            raise ValueError(
+                "generate requires a pp=1, sp=1 grid (token-recurrent "
+                "decode); use dp x tp for inference")
+        if c.moe_experts:
+            raise NotImplementedError("generate supports the dense MLP only")
+        prompts = jnp.asarray(prompts, jnp.int32)
+        B, S0 = prompts.shape
+        if B % self.dp:
+            raise ValueError(
+                f"prompt batch ({B}) must divide over dp ({self.dp})")
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        S_max = S0 + max_new_tokens
+        Hs = c.n_heads // self.tp
+
+        def attn_from_cache(q, ck, cv, upto):
+            """q (Bl, 1, Hs, Dh) against cached rows < ``upto``."""
+            s = jnp.einsum("bqhd,bshd->bhqs", q.astype(jnp.float32),
+                           ck.astype(jnp.float32)) / math.sqrt(c.head_dim)
+            col = jnp.arange(ck.shape[1])[None, None, None, :]
+            s = jnp.where(col < upto, s, -jnp.inf)
+            w = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("bhqs,bshd->bqhd", w, cv.astype(jnp.float32))
+            return out.astype(q.dtype)
+
+        def layer_step(p_l, x, ck, cv, pos, upto):
+            """One block on (Bl, 1, D) with cache write at ``pos``."""
+            q, k, v = self._qkv(p_l, x, pos)
+            ck = lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), upto - 1, axis=1)
+            cv = lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), upto - 1, axis=1)
+            x = self._attn_residual(p_l, x, attn_from_cache(q, ck, cv, upto))
+            x = self._dense_mlp_residual(p_l, x, _rmsnorm(x, p_l["ln2"]))
+            return x, ck, cv
+
+        def body(params, toks, key):
+            Bl = toks.shape[0]
+            # independent sampling noise per dp shard — a replicated key
+            # would draw IDENTICAL continuations for equal logits across
+            # the dp batch shards
+            key = jax.random.fold_in(key, lax.axis_index("dp"))
+            stage_params = jax.tree.map(lambda a: a[0], params["stages"])
+            dtype = c.compute_dtype
+            caches_k = jnp.zeros((c.n_layers, Bl, S_max, Hs, c.head_dim), dtype)
+            caches_v = jnp.zeros_like(caches_k)
+
+            # ---- prefill: full causal pass over the prompt, cache K/V ---- #
+            x = params["embed"][toks].astype(dtype)
+            pos0 = jnp.arange(S0)
+            for l in range(c.n_layers):
+                p_l = self._cast_params(jax.tree.map(lambda a: a[l], stage_params))
+                q, k, v = self._qkv(p_l, x, pos0)
+                caches_k = caches_k.at[l, :, :S0].set(k.astype(dtype))
+                caches_v = caches_v.at[l, :, :S0].set(v.astype(dtype))
+                attn = jnp.moveaxis(local_attention(
+                    jnp.moveaxis(q, 2, 1), jnp.moveaxis(k, 2, 1),
+                    jnp.moveaxis(v, 2, 1), causal=True), 1, 2)
+                x = self._attn_residual(p_l, x, attn)
+                x = self._dense_mlp_residual(p_l, x, _rmsnorm(x, p_l["ln2"]))
+            logits0 = self._head(params, x[:, -1:, :])[:, 0]  # (Bl, V)
+
+            def sample(logits, key):
+                if temperature == 0.0:
+                    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return jax.random.categorical(
+                    key, logits / temperature, axis=-1).astype(jnp.int32)
+
+            key0, key = jax.random.split(key)
+            first = sample(logits0, key0)
+
+            # ---- decode scan ---- #
+            def step(carry, key_t):
+                caches_k, caches_v, tok, t = carry
+                x = params["embed"][tok].astype(dtype)[:, None, :]
+                pos = t[None]
+                new_k, new_v = caches_k, caches_v
+                for l in range(c.n_layers):
+                    p_l = self._cast_params(
+                        jax.tree.map(lambda a: a[l], stage_params))
+                    xl, ckl, cvl = layer_step(
+                        p_l, x, new_k[l], new_v[l], pos, t + 1)
+                    x = xl
+                    new_k = new_k.at[l].set(ckl)
+                    new_v = new_v.at[l].set(cvl)
+                logits = self._head(params, x)[:, 0]
+                nxt = sample(logits, key_t)
+                return (new_k, new_v, nxt, t + 1), tok
+
+            # first came from the prefill; N-1 scan steps yield the rest
+            # (each step consumes the previous token and emits the next)
+            keys = jax.random.split(key, max_new_tokens)[1:]
+            (_, _, last, _), toks_out = lax.scan(
+                step, (caches_k, caches_v, first, jnp.int32(S0)), keys)
+            # toks_out: (N-1, Bl) tokens FED at each step; append the final
+            gen = jnp.concatenate(
+                [jnp.swapaxes(toks_out, 0, 1), last[:, None]], axis=1)
+            return jnp.concatenate([toks, gen], axis=1)
+
+        data_spec = P("dp", None)
+        cache_key = ("generate", B, S0, max_new_tokens, float(temperature))
+        fn = self._step_cache.get(cache_key)
+        if fn is None:
+            fn = jax.jit(shard_map(
+                body, mesh=self.grid.mesh,
+                in_specs=(self.param_specs(), data_spec, P()),
+                out_specs=data_spec, check_vma=False))
+            self._step_cache[cache_key] = fn
+        toks_sharded = jax.device_put(
+            prompts, NamedSharding(self.grid.mesh, data_spec))
+        key = jax.random.key(seed)
+        return fn(params, toks_sharded, key)
