@@ -179,6 +179,17 @@ class TransformerLM:
             "stages": stages,
         }
 
+    def shard_params(self, params) -> Dict[str, Any]:
+        """Place a (host or differently-placed) parameter tree onto this
+        grid's shardings — e.g. after ``load_checkpoint``, whose restored
+        leaves are host arrays."""
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.grid.mesh, s), self.param_specs(),
+            is_leaf=lambda s: isinstance(s, P))
+        # device_put handles the pytree-of-shardings form natively and
+        # batches the transfers (one placement, not one per leaf)
+        return jax.device_put(params, shardings)
+
     def init(self, seed: int = 0) -> Dict[str, Any]:
         c, Ls, pp = self.cfg, self.layers_per_stage, self.pp
         H, Dh, D, F, V = c.n_heads, c.head_dim, c.d_model, c.d_ff, c.vocab
@@ -208,12 +219,7 @@ class TransformerLM:
             "unembed": norm(D, V),
             "stages": stages,
         }
-        mesh = self.grid.mesh
-        return jax.tree.map(
-            lambda a, spec: jax.device_put(a, NamedSharding(mesh, spec)),
-            host, self.param_specs(),
-            is_leaf=lambda x: isinstance(x, np.ndarray),
-        )
+        return self.shard_params(host)
 
     # ------------------------------------------------------------- #
     # the per-device program                                        #
