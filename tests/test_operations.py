@@ -355,3 +355,45 @@ class TestWhereKeyword:
         a = ht.ones((2, 2))
         with pytest.raises(ValueError):
             ht.add(a, 1, where=a > 0)
+
+
+class TestBroadcastSplitMatrix:
+    """Every broadcast shape pair x every (split_a, split_b) combination vs
+    NumPy — the op engine's distribution-alignment hard path (the
+    reference's sanitize_distribution machinery, `sanitation.py:31-157`)."""
+
+    SHAPES = [((6, 5), (6, 5)), ((6, 5), (1, 5)), ((6, 5), (5,)),
+              ((6, 1), (1, 5)), ((4, 1, 3), (2, 3)), ((7,), (6, 7)),
+              ((3, 4, 5), (4, 5)), ((1,), (6, 5))]
+
+    @pytest.mark.parametrize("sa,sb", SHAPES)
+    def test_add_broadcast_all_splits(self, sa, sb):
+        rng = np.random.default_rng(hash((sa, sb)) % 2**31)
+        a = rng.standard_normal(sa).astype(np.float32)
+        b = rng.standard_normal(sb).astype(np.float32)
+        want = a + b
+        for split_a in all_splits(len(sa)):
+            for split_b in all_splits(len(sb)):
+                got = (ht.array(a, split=split_a)
+                       + ht.array(b, split=split_b)).numpy()
+                np.testing.assert_allclose(
+                    got, want, atol=1e-6,
+                    err_msg=f"splits ({split_a}, {split_b})")
+
+    def test_mixed_split_ternary_and_inplace(self):
+        rng = np.random.default_rng(1)
+        a = rng.standard_normal((6, 5)).astype(np.float32)
+        b = rng.standard_normal((6, 5)).astype(np.float32)
+        for s1 in all_splits(2):
+            for s2 in all_splits(2):
+                x, y = ht.array(a, split=s1), ht.array(b, split=s2)
+                np.testing.assert_allclose(
+                    ht.where(x > 0, x, y).numpy(), np.where(a > 0, a, b))
+                np.testing.assert_allclose(
+                    ht.logaddexp(x, y).numpy(), np.logaddexp(a, b), atol=1e-6)
+            x = ht.array(a.copy(), split=s1)
+            x += 2.0
+            x *= 0.5
+            x -= 1.0
+            np.testing.assert_allclose(x.numpy(), (a + 2) * 0.5 - 1, atol=1e-6)
+            assert x.split == s1  # augmented ops preserve the distribution
