@@ -317,6 +317,30 @@ def _zigzag_core(q_blk, k_blk, v_blk, comm: TPUCommunication, scale: float):
     return jnp.moveaxis(out, 1, 2).astype(q_blk.dtype)
 
 
+def _ulysses_core(qb, kb, vb, comm: TPUCommunication, scale: float,
+                  causal: bool):
+    """DeepSpeed-Ulysses attention on local ``(B, s, H, D)`` blocks inside
+    an enclosing shard_map: seq-sharded → all_to_all → head-sharded full
+    sequence → dense local attention → all_to_all back. The comm size must
+    divide the local head count (each device takes heads/size heads)."""
+    axis = comm.axis_name
+
+    def seq2head(x):
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    def head2seq(x):
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq2head(qb), seq2head(kb), seq2head(vb)
+    # after the swap every device holds the FULL sequence for its head
+    # subset, so the ordinary causal mask applies locally
+    out = local_attention(
+        jnp.moveaxis(qh, 2, 1), jnp.moveaxis(kh, 2, 1), jnp.moveaxis(vh, 2, 1),
+        scale, causal=causal,
+    )
+    return head2seq(jnp.moveaxis(out, 1, 2))  # back to (B, s, H, D)
+
+
 def _attn_spec(comm, batch_axis):
     """(batch, seq✂, heads, dim) PartitionSpec; with ``batch_axis`` the
     batch dimension is sharded over that grid axis too."""
@@ -428,26 +452,7 @@ def ulysses_attention(
     fn = _ATTN_CACHE.get(key)
     if fn is None:
         spec = _attn_spec(comm, batch_axis)
-        axis = comm.axis_name
-
-        def body(qb, kb, vb):
-            # (B, s, H, D) local → heads sharded: (B, S, H/size, D)
-            def seq2head(x):
-                return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
-
-            def head2seq(x):
-                return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
-
-            qh, kh, vh = seq2head(qb), seq2head(kb), seq2head(vb)
-            # after the swap every device holds the FULL sequence for its
-            # head subset, so the ordinary causal mask applies locally
-            out = local_attention(
-                jnp.moveaxis(qh, 2, 1), jnp.moveaxis(kh, 2, 1), jnp.moveaxis(vh, 2, 1),
-                scale, causal=causal,
-            )
-            out = jnp.moveaxis(out, 1, 2)  # back to (B, S, h, D)
-            return head2seq(out)
-
+        body = partial(_ulysses_core, comm=comm, scale=scale, causal=causal)
         sm = shard_map(
             body, mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
         )

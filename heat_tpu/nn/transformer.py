@@ -45,8 +45,8 @@ from jax import lax, shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.communication import MeshGrid
-from .attention import (_ring_body, _zigzag_core, local_attention,
-                        zigzag_layout, zigzag_unlayout)
+from .attention import (_ring_body, _ulysses_core, _zigzag_core,
+                        local_attention, zigzag_layout, zigzag_unlayout)
 from .parallel import pipeline_apply, switch_moe
 
 __all__ = ["TransformerLM", "TransformerLMConfig"]
@@ -64,7 +64,9 @@ class TransformerLMConfig:
     n_micro: int = 1                    # microbatches for the pp schedule
     compute_dtype: Any = jnp.float32    # bf16 on real TPUs for MXU rate
     init_scale: float = 0.02
-    attn_schedule: str = "ring"         # "ring" | "zigzag" (load-balanced sp)
+    attn_schedule: str = "ring"         # "ring" | "zigzag" (load-balanced
+                                        # causal ring) | "ulysses" (all_to_all
+                                        # head-parallel; local heads % sp == 0)
     rope: bool = True                   # rotary position embeddings on q/k
     rope_theta: float = 10000.0
     remat: bool = False                 # jax.checkpoint each layer: trade
@@ -75,9 +77,9 @@ class TransformerLMConfig:
             self.d_ff = 4 * self.d_model
         if self.d_model % self.n_heads:
             raise ValueError("d_model must be divisible by n_heads")
-        if self.attn_schedule not in ("ring", "zigzag"):
+        if self.attn_schedule not in ("ring", "zigzag", "ulysses"):
             raise ValueError(
-                f"attn_schedule must be 'ring' or 'zigzag', got "
+                f"attn_schedule must be 'ring', 'zigzag' or 'ulysses', got "
                 f"{self.attn_schedule!r}")
         if self.rope and self.head_dim % 2:
             raise ValueError(
@@ -140,6 +142,11 @@ class TransformerLM:
             raise ValueError(
                 f"moe_experts ({c.moe_experts}) must divide over dp ({self.dp}) "
                 "(experts are sharded over the dp axis)")
+        if (c.attn_schedule == "ulysses" and self.sp > 1
+                and (c.n_heads // self.tp) % self.sp):
+            raise ValueError(
+                f"ulysses schedule needs local heads ({c.n_heads}//{self.tp}"
+                f"={c.n_heads // self.tp}) divisible by sp ({self.sp})")
         self.layers_per_stage = c.n_layers // self.pp
         self.mesh_size = self.dp * self.pp * self.tp * self.sp
         self._step_cache: Dict = {}
@@ -243,6 +250,11 @@ class TransformerLM:
             # before the loss, so each layer pays zero layout ppermutes
             # (every non-attention op in the block is positionwise)
             attn = _zigzag_core(q, k, v, comm=sp_comm, scale=scale)
+        elif c.attn_schedule == "ulysses" and sp_comm.size > 1:
+            # all_to_all head-parallel: two collectives per layer instead of
+            # sp-1 ppermute steps — often wins at moderate S on fast ICI
+            attn = _ulysses_core(q, k, v, comm=sp_comm, scale=scale,
+                                 causal=True)
         else:
             attn = _ring_body(q, k, v, comm=sp_comm, scale=scale, causal=True)
         x = self._attn_residual(p, x, attn)

@@ -447,3 +447,34 @@ class TestShardedCheckpointRoundtrip:
         l0, _ = model.loss_and_grad_fn()(params, toks)
         l1, _ = model.loss_and_grad_fn()(restored, toks)
         np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+
+
+class TestUlyssesSchedule:
+    def test_ulysses_matches_ring_loss_and_grads(self):
+        grid = ht.MeshGrid((1, 1, 1, 4), ("dp", "pp", "tp", "sp"),
+                           devices=jax.devices()[:4])
+        toks_np = np.random.default_rng(0).integers(0, 32, (2, 16))
+        results = {}
+        for sched in ("ring", "ulysses"):
+            cfg = TransformerLMConfig(vocab=32, d_model=16, n_heads=4,
+                                      n_layers=1, d_ff=16,
+                                      attn_schedule=sched)
+            model = TransformerLM(grid, cfg)
+            loss, grads = model.loss_and_grad_fn()(
+                model.init(0), model.shard_batch(toks_np))
+            results[sched] = (float(loss), grads)
+        np.testing.assert_allclose(results["ring"][0],
+                                   results["ulysses"][0], rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(results["ring"][1]),
+                        jax.tree_util.tree_leaves(results["ulysses"][1])):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_head_divisibility_validated(self):
+        grid = ht.MeshGrid((1, 1, 1, 4), ("dp", "pp", "tp", "sp"),
+                           devices=jax.devices()[:4])
+        cfg = TransformerLMConfig(vocab=32, d_model=12, n_heads=3,
+                                  n_layers=1, attn_schedule="ulysses",
+                                  rope=True)
+        with pytest.raises(ValueError, match="ulysses"):
+            TransformerLM(grid, cfg)
