@@ -1,0 +1,263 @@
+"""Distributed sort over the device mesh: a block merge-split network.
+
+TPU-native counterpart of the reference's parallel sample-sort
+(``heat/core/manipulations.py:2263``: local sort → pivot exchange →
+Alltoallv rebucket → local merge). A literal sample-sort cannot compile
+under XLA: the Alltoallv bucket sizes are data-dependent, and XLA requires
+static shapes. The static-shape equivalent is a **block merge-split
+network**: every device keeps exactly ``c`` elements at every step, and a
+comparator ``(i, j)`` of a sorting network becomes "merge the two sorted
+blocks; ``i`` keeps the lower half, ``j`` the upper". By the 0-1 principle
+this turns ANY sorting network on ``p`` inputs into a sorter of ``p``
+pre-sorted blocks (Knuth TAOCP 5.3.4). We use Batcher's odd-even mergesort
+network: ``O(log^2 p)`` rounds, each a disjoint set of pairwise
+``ppermute`` exchanges riding ICI — **no all-gather of the sort axis
+anywhere**, O(c) memory per device.
+
+Arbitrary (non-power-of-two) ``p``: the network is built for the next power
+of two and comparators touching indices ``>= p`` are dropped. Every Batcher
+odd-even comparator is ascending (min to the lower index), so virtual
+blocks — conceptually filled with the ascending sentinel — never leave the
+top positions and every dropped comparator is a no-op on real data (the
+mirror argument covers descending).
+
+Sentinel discipline: ascending sorts fill the canonical layout's padding
+with the dtype's maximum, so after the global sort all padding lands in the
+trailing physical positions — exactly the canonical padded layout again.
+Descending mirrors with the minimum.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+
+__all__ = ["batcher_rounds", "distributed_sort_fn", "distributed_flat_sort_fn"]
+
+# jitted sort programs keyed by (shape, dtype, axis, n, descending, mesh)
+_SORT_CACHE: dict = {}
+
+
+def batcher_rounds(p: int) -> List[List[Tuple[int, int]]]:
+    """Rounds of disjoint ascending comparator pairs ``(low, high)`` of
+    Batcher's odd-even mergesort on ``p`` inputs.
+
+    Built for the next power of two ``P >= p``; comparators touching an
+    index ``>= p`` are dropped (no-ops on virtual sentinel blocks, see
+    module docstring). Pairs within one round are disjoint, so each round
+    is a single ``ppermute``.
+    """
+    P = 1
+    while P < p:
+        P *= 2
+    rounds: List[List[Tuple[int, int]]] = []
+    ph = 1
+    while ph < P:
+        k = ph
+        while k >= 1:
+            pairs = []
+            j = k % ph
+            while j + k < P:
+                for i in range(k):
+                    a, b = i + j, i + j + k
+                    if b < P and (a // (ph * 2)) == (b // (ph * 2)) and b < p:
+                        pairs.append((a, b))
+                j += 2 * k
+            if pairs:
+                rounds.append(pairs)
+            k //= 2
+        ph *= 2
+    return rounds
+
+
+def _sentinel(jdt, descending: bool):
+    """Value that sorts to the END of the requested order for dtype ``jdt``."""
+    if jnp.issubdtype(jdt, jnp.floating):
+        return jnp.asarray(-jnp.inf if descending else jnp.inf, jdt)
+    if jdt == jnp.bool_:
+        return jnp.asarray(not descending, jdt)
+    info = jnp.iinfo(jdt)
+    return jnp.asarray(info.min if descending else info.max, jdt)
+
+
+def _float_key_dtype(jdt):
+    return jnp.int64 if jnp.dtype(jdt).itemsize == 8 else jnp.int32
+
+
+def _float_sort_key(x):
+    """Monotone integer encoding of a float array's total order.
+
+    Needed because value sentinels cannot bound NaN: under jax's sort NaNs
+    order after +inf, so an inf-filled padding would slip *inside* the valid
+    region whenever the data contains NaNs (round-2 review finding —
+    fabricated infs, dropped NaNs, out-of-range indices). The IEEE bit trick
+    gives a total order ``-inf < … < +inf < NaN`` (NaNs canonicalized to the
+    positive quiet pattern first), all strictly below the integer maximum —
+    which is therefore a safe padding key."""
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        x = x.astype(jnp.float32)  # exact, monotone; bitcast needs 32 bits
+    idt = _float_key_dtype(x.dtype)
+    x = jnp.where(jnp.isnan(x), jnp.asarray(jnp.nan, x.dtype), x)
+    b = jax.lax.bitcast_convert_type(x, idt)
+    imax = jnp.asarray(jnp.iinfo(idt).max, idt)
+    # b >= 0 (positive floats incl. canonical NaN): key = b, ascending.
+    # b < 0 (negative floats): imax - b wraps to a strictly increasing map
+    # onto [imin, -1], so every negative float keys below every positive one.
+    return jnp.where(b >= 0, b, imax - b)
+
+
+def _index_dtype():
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
+def _network_sort(key_block, payload_blocks, rounds, role_tables, c, descending,
+                  axis_name):
+    """Run the merge-split network on per-device blocks, inside shard_map.
+
+    ``key_block``: (..., c) sort keys, last axis is the (local chunk of the)
+    sort axis. ``payload_blocks``: tuple of same-shaped arrays co-sorted with
+    the keys. Returns (sorted key block, tuple of sorted payload blocks).
+    """
+
+    def _merge(vals, payloads):
+        order = jnp.argsort(vals, axis=-1, descending=descending, stable=True)
+        return (
+            jnp.take_along_axis(vals, order, axis=-1),
+            tuple(jnp.take_along_axis(pl, order, axis=-1) for pl in payloads),
+        )
+
+    xl, pls = _merge(key_block, tuple(payload_blocks))
+    me = jax.lax.axis_index(axis_name)
+    for pairs, role in zip(rounds, role_tables):
+        perm = [(a, b) for a, b in pairs] + [(b, a) for a, b in pairs]
+        rx = jax.lax.ppermute(xl, axis_name, perm=perm)
+        rpls = tuple(jax.lax.ppermute(pl, axis_name, perm=perm) for pl in pls)
+        myrole = jnp.asarray(role)[me]
+
+        # Both sides of a pair MUST merge the identical sequence (low-index
+        # block first): under tied keys a stable argsort of [own, recv] and
+        # [recv, own] disagree, and the kept halves would no longer be
+        # complementary — tied payloads would be duplicated/dropped.
+        def ordered_concat(own, recv):
+            first = jnp.where(myrole == 2, recv, own)
+            second = jnp.where(myrole == 2, own, recv)
+            return jnp.concatenate([first, second], axis=-1)
+
+        both_v, both_p = _merge(
+            ordered_concat(xl, rx),
+            tuple(ordered_concat(pl, rpl) for pl, rpl in zip(pls, rpls)),
+        )
+
+        def pick(low, high, keep):
+            return jnp.where(myrole == 1, low,
+                             jnp.where(myrole == 2, high, keep))
+
+        xl = pick(both_v[..., :c], both_v[..., c:], xl)
+        pls = tuple(pick(bp[..., :c], bp[..., c:], pl)
+                    for bp, pl in zip(both_p, pls))
+    return xl, pls
+
+
+def _role_tables(rounds, p):
+    """Per-round device roles: 0 = bystander, 1 = keeps low, 2 = keeps high."""
+    tables = []
+    for pairs in rounds:
+        role = np.zeros(p, np.int32)
+        for a, b in pairs:
+            role[a], role[b] = 1, 2
+        tables.append(role)
+    return tables
+
+
+def distributed_sort_fn(phys_shape, jdt, axis: int, n: int, descending: bool, comm):
+    """Jitted ``physical -> (sorted_physical, global_indices_physical)``.
+
+    ``physical`` is the canonical padded global array split at ``axis``
+    (padding content is ignored: sentinels are applied inside). The returned
+    values land back in canonical layout (valid data first, padding last);
+    indices are global positions along ``axis`` into the original array.
+    """
+    key = ("dsort", tuple(phys_shape), str(jdt), axis, n, descending, comm.cache_key)
+    fn = _SORT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    p = comm.size
+    c = phys_shape[axis] // p
+    rounds = batcher_rounds(p)
+    roles = _role_tables(rounds, p)
+    spec = comm.spec(len(phys_shape), axis)
+    idt = _index_dtype()
+    floating = jnp.issubdtype(jdt, jnp.floating)
+
+    def body(x):
+        me = jax.lax.axis_index(comm.axis_name)
+        xl = jnp.moveaxis(x, axis, -1)
+        gpos = me * c + jnp.arange(c, dtype=idt)  # global positions, this block
+        if floating:
+            # NaN-safe total order: sort integer keys carrying the values as
+            # payload; the padding key strictly bounds every data key
+            kdt = _float_key_dtype(jnp.float32 if jnp.dtype(jdt).itemsize < 4
+                                   else jdt)
+            info = jnp.iinfo(kdt)
+            pad_key = jnp.asarray(info.min if descending else info.max, kdt)
+            keys = jnp.where(gpos < n, _float_sort_key(xl), pad_key)
+            _, (xl, gi) = _network_sort(
+                keys, (xl, jnp.broadcast_to(gpos, xl.shape)), rounds, roles,
+                c, descending, comm.axis_name)
+        else:
+            xl = jnp.where(gpos < n, xl, _sentinel(jdt, descending))
+            xl, (gi,) = _network_sort(
+                xl, (jnp.broadcast_to(gpos, xl.shape),), rounds, roles, c,
+                descending, comm.axis_name)
+        return jnp.moveaxis(xl, -1, axis), jnp.moveaxis(gi, -1, axis)
+
+    fn = jax.jit(
+        shard_map(body, mesh=comm.mesh, in_specs=spec, out_specs=(spec, spec),
+                  check_vma=False)
+    )
+    _SORT_CACHE[key] = fn
+    return fn
+
+
+def distributed_flat_sort_fn(phys_shape, jdt, split: int, comm):
+    """Jitted flatten-and-sort of a sharded N-D array as a 1-D bag.
+
+    Each device flattens its own shard locally (row-major shard order, NOT
+    the global logical order — callers must only rely on the sorted
+    multiset, i.e. order statistics) and the network sorts the resulting
+    ``p * prod(shard_shape)`` 1-D array. Validity is the caller's job:
+    pre-fill padding with a sentinel (``DNDarray.filled``), after which the
+    valid elements occupy the first ``n`` global positions of the result.
+    """
+    key = ("dflat", tuple(phys_shape), str(jdt), split, comm.cache_key)
+    fn = _SORT_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    p = comm.size
+    rounds = batcher_rounds(p)
+    roles = _role_tables(rounds, p)
+    local = int(np.prod([s // p if i == split else s
+                         for i, s in enumerate(phys_shape)], dtype=np.int64))
+
+    def body(xs):
+        flat = xs.reshape(-1)
+        out, _ = _network_sort(flat, (), rounds, roles, local, False,
+                               comm.axis_name)
+        return out
+
+    fn = jax.jit(
+        shard_map(body, mesh=comm.mesh,
+                  in_specs=comm.spec(len(phys_shape), split),
+                  out_specs=comm.spec(1, 0), check_vma=False)
+    )
+    _SORT_CACHE[key] = fn
+    return fn
+
+

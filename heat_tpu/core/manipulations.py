@@ -358,20 +358,31 @@ def shape(a: DNDarray) -> Tuple[int, ...]:
 def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     """Sort along an axis (reference ``:2263``).
 
-    The reference runs a parallel sample-sort (local sort → pivot exchange →
-    Alltoallv). Here the padded physical array is filled with ±inf sentinels
-    and sorted by XLA's partitioned sort; sentinels land in the trailing
-    padding positions, preserving the canonical layout. Returns
-    ``(values, indices)`` like the reference.
+    Along a split axis this runs the distributed block merge-split network
+    (:mod:`heat_tpu.core._sort`) — the static-shape XLA equivalent of the
+    reference's parallel sample-sort: local sort, then ``O(log^2 p)``
+    pairwise ``ppermute`` merge-split rounds; no all-gather of the sort
+    axis, O(chunk) memory per device. Sentinels in the padding sort to the
+    trailing global positions, so the result lands back in canonical
+    layout. Returns ``(values, indices)`` like the reference; ``indices``
+    are global positions along ``axis`` into the original array.
     """
     axis = sanitize_axis(a.shape, axis)
-    if a.split == axis and a.pad:
-        sentinel = _sort_sentinel(a, descending)
-        physical = a.filled(sentinel)
+    if a.split == axis and a.comm.size > 1 and a.shape[axis] > 0:
+        from ._sort import distributed_sort_fn
+
+        fn = distributed_sort_fn(
+            a.larray.shape, jnp.dtype(a.larray.dtype), axis, a.shape[axis],
+            descending, a.comm)
+        values, idx = fn(a.larray)
     else:
-        physical = a.larray
-    idx = jnp.argsort(physical, axis=axis, descending=descending)
-    values = jnp.take_along_axis(physical, idx, axis=axis)
+        if a.split == axis and a.pad:
+            sentinel = _sort_sentinel(a, descending)
+            physical = a.filled(sentinel)
+        else:
+            physical = a.larray
+        idx = jnp.argsort(physical, axis=axis, descending=descending)
+        values = jnp.take_along_axis(physical, idx, axis=axis)
     vals = DNDarray(values, a.gshape, a.dtype, a.split, a.device, a.comm)
     indices = DNDarray(idx, a.gshape, types.canonical_heat_type(idx.dtype), a.split, a.device, a.comm)
     if out is not None:
@@ -518,9 +529,18 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False,
     """Unique elements (reference ``:3051``; ``return_counts`` exceeds the
     reference's signature, matching numpy's).
 
-    Dynamic-shape op: computed on the gathered logical array (documented XLA
-    semantic, SURVEY.md §7 hard part 4); result is replicated.
+    1-D split arrays run the fully distributed pipeline
+    (:mod:`heat_tpu.core._setops`: network sort → ppermute halo compare →
+    psum'd unique count → network compaction), never gathering the array;
+    the result is split and always sorted. Other cases (``axis=`` uniques,
+    multi-dim flatten) fall back to the gathered logical array — the
+    dynamic-shape semantic of SURVEY.md §7 hard part 4.
     """
+    if (axis is None and a.split is not None and a.comm.size > 1
+            and a.ndim == 1 and a.shape[0] > 0):
+        from ._setops import distributed_unique
+
+        return distributed_unique(a, return_inverse, return_counts)
     logical = a._logical()
     if return_inverse or return_counts:
         res, *rest = jnp.unique(

@@ -1,0 +1,132 @@
+"""Distributed block merge-split sort (``heat_tpu/core/_sort.py``).
+
+Mirrors the reference's sample-sort coverage (``heat/core/tests/
+test_manipulations.py`` sort cases): prime global sizes (maximally uneven
+chunks), both directions, multi-dim batch axes, integer dtypes, and the
+VERDICT round-1 done-criterion — the compiled program must contain no
+all-gather of the sort axis, only pairwise collective-permutes.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core._sort import batcher_rounds, distributed_sort_fn
+from heat_tpu.testing import assert_array_equal
+
+
+rng = np.random.default_rng(7)
+
+
+def _check_sorted(data, axis, descending, split):
+    x = ht.array(data, split=split)
+    v, idx = ht.sort(x, axis=axis, descending=descending)
+    expected = np.sort(data, axis=axis)
+    if descending:
+        expected = np.flip(expected, axis=axis)
+    assert_array_equal(v, expected)
+    # indices must be a valid argsort: gathering the original by them
+    # reproduces the values (exact argsort parity is not required under ties)
+    idx_np = np.asarray(idx.numpy())
+    taken = np.take_along_axis(data, idx_np, axis=axis)
+    np.testing.assert_array_equal(taken, expected)
+    # ...and a PERMUTATION along the axis — under ties a take-along check
+    # alone cannot see duplicated/dropped indices (the round-1 payload bug)
+    np.testing.assert_array_equal(
+        np.sort(idx_np, axis=axis),
+        np.broadcast_to(
+            np.arange(data.shape[axis]).reshape(
+                [-1 if i == axis else 1 for i in range(data.ndim)]),
+            data.shape))
+    assert v.split == x.split
+
+
+@pytest.mark.parametrize("n", [3, 7, 13, 29, 64, 101])
+@pytest.mark.parametrize("descending", [False, True])
+def test_sort_1d_prime_sizes(n, descending):
+    data = rng.normal(size=n).astype(np.float32)
+    _check_sorted(data, 0, descending, split=0)
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.float32, np.float64])
+def test_sort_1d_dtypes_with_ties(dtype):
+    data = rng.integers(0, 5, 37).astype(dtype)
+    _check_sorted(data, 0, False, split=0)
+    _check_sorted(data, 0, True, split=0)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+@pytest.mark.parametrize("descending", [False, True])
+def test_sort_2d_split_axis(axis, descending):
+    data = rng.normal(size=(17, 11)).astype(np.float32)
+    _check_sorted(data, axis, descending, split=axis)
+
+
+def test_sort_3d_batch_axes():
+    data = rng.normal(size=(3, 19, 4)).astype(np.float32)
+    _check_sorted(data, 1, False, split=1)
+
+
+def test_sort_smaller_than_mesh():
+    # n < device count: some devices hold pure padding blocks
+    for n in (1, 2, 5):
+        data = rng.normal(size=n).astype(np.float32)
+        _check_sorted(data, 0, False, split=0)
+
+
+def test_sort_nan_and_inf():
+    """Round-2 review regression: +inf padding sentinels sorted BEFORE data
+    NaNs, leaking padding into the valid region (fabricated infs, indices
+    out of range). The float path now sorts NaN-safe integer keys."""
+    data = np.array([1.0, np.nan, 2.0, 5.0, np.nan, -np.inf, np.inf, 3.0],
+                    np.float32)
+    x = ht.array(data, split=0)
+    v, i = ht.sort(x, axis=0)
+    got = np.asarray(v.numpy())
+    want = np.sort(data)  # numpy: NaNs last
+    np.testing.assert_array_equal(got, want)
+    idx = np.asarray(i.numpy())
+    np.testing.assert_array_equal(np.sort(idx), np.arange(len(data)))
+    np.testing.assert_array_equal(data[idx], want)
+    # descending: NaNs first (total order, mirrored)
+    vd, idxd = ht.sort(x, axis=0, descending=True)
+    gd = np.asarray(vd.numpy())
+    assert np.isnan(gd[:2]).all()
+    np.testing.assert_array_equal(gd[2:], np.sort(data)[:-2][::-1])
+    np.testing.assert_array_equal(np.sort(np.asarray(idxd.numpy())),
+                                  np.arange(len(data)))
+
+
+def test_sort_bool():
+    data = rng.integers(0, 2, 21).astype(bool)
+    x = ht.array(data, split=0)
+    v, _ = ht.sort(x, axis=0)
+    np.testing.assert_array_equal(np.asarray(v.numpy()), np.sort(data))
+
+
+def test_batcher_rounds_depth():
+    # O(log^2 p) rounds, disjoint pairs per round
+    for p in range(1, 33):
+        rounds = batcher_rounds(p)
+        for pairs in rounds:
+            flat = [i for pr in pairs for i in pr]
+            assert len(flat) == len(set(flat))
+            assert all(0 <= a < b < p for a, b in pairs)
+        k = max(1, (p - 1).bit_length())
+        assert len(rounds) <= k * (k + 1) // 2
+
+
+def test_sort_compiles_without_allgather():
+    """VERDICT round-1 done-criterion: sorting a split axis must never
+    gather it — the HLO may use pairwise collective-permute only."""
+    comm = ht.get_comm()
+    if comm.size == 1:
+        pytest.skip("needs a multi-device mesh")
+    x = ht.array(rng.normal(size=41).astype(np.float32), split=0)
+    fn = distributed_sort_fn(x.larray.shape, jnp.dtype(jnp.float32), 0,
+                             41, False, comm)
+    hlo = fn.lower(x.larray).compile().as_text()
+    assert "all-gather" not in hlo
+    assert "collective-permute" in hlo
