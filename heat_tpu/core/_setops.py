@@ -1,0 +1,207 @@
+"""Distributed unique over the device mesh (reference
+``heat/core/manipulations.py:3051``).
+
+The reference Allgatherv-merges per-rank local uniques. That shape is
+dynamic twice over (local unique counts, global unique count), which XLA
+cannot compile, so the TPU-native pipeline is built from the static-shape
+block merge-split network (:mod:`heat_tpu.core._sort`) in three jitted
+phases — none of which ever gathers the full array:
+
+A. distributed sort of the values (carrying original positions), then a
+   one-element ``ppermute`` halo compare marks each first occurrence, and a
+   ``psum`` counts the global number of uniques ``U`` (the ONE scalar that
+   must be concretized on the host, exactly like the reference's dynamic
+   result size).
+B. compaction, compiled per ``U``: marked elements get their output rank as
+   a sort key (everything else MAX), one more network pass moves the ``U``
+   uniques to the front of the global layout in order; counts come from
+   differencing neighbouring first-occurrence positions (one more
+   single-element ``ppermute``).
+C. inverse, on demand: each sorted element's unique rank is a prefix count
+   of the marks; network-sorting ranks keyed by the original positions is a
+   distributed scatter back to the input layout.
+
+NaN semantics follow elementwise ``!=`` (each NaN is its own unique), like
+torch's ``unique`` that the reference wraps.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+
+from ._sort import (
+    _float_key_dtype,
+    _float_sort_key,
+    _index_dtype,
+    _network_sort,
+    _role_tables,
+    _sentinel,
+    batcher_rounds,
+)
+
+__all__ = ["distributed_unique"]
+
+_UNIQUE_CACHE: dict = {}
+
+
+def _phase_a_fn(c, jdt, n, comm):
+    """values -> (sorted values, original positions, first-occurrence mask,
+    global unique count)."""
+    key = ("uniqA", c, str(jdt), n, comm.cache_key)
+    fn = _UNIQUE_CACHE.get(key)
+    if fn is not None:
+        return fn
+    p = comm.size
+    rounds = batcher_rounds(p)
+    roles = _role_tables(rounds, p)
+    idt = _index_dtype()
+    floating = jnp.issubdtype(jdt, jnp.floating)
+    spec = comm.spec(1, 0)
+
+    def body(x):
+        me = jax.lax.axis_index(comm.axis_name)
+        gpos = me * c + jnp.arange(c, dtype=idt)
+        if floating:
+            # NaN-safe key order (see _sort._float_sort_key); the value
+            # payload keeps the raw floats for the != neighbour compare, so
+            # every NaN still counts as its own unique
+            kdt = _float_key_dtype(jnp.float32 if jnp.dtype(jdt).itemsize < 4
+                                   else jdt)
+            pad_key = jnp.asarray(jnp.iinfo(kdt).max, kdt)
+            keys = jnp.where(gpos < n, _float_sort_key(x), pad_key)
+            _, (xl, gi) = _network_sort(keys, (x, gpos), rounds, roles, c,
+                                        False, comm.axis_name)
+        else:
+            xl = jnp.where(gpos < n, x, _sentinel(jdt, False))
+            xl, (gi,) = _network_sort(xl, (gpos,), rounds, roles, c, False,
+                                      comm.axis_name)
+        spos = me * c + jnp.arange(c, dtype=idt)  # sorted coordinates
+        # left halo: previous device's last element (device 0 receives zeros,
+        # but its position 0 is forced to "first" below)
+        prev_last = jax.lax.ppermute(
+            xl[-1:], comm.axis_name, perm=[(i, i + 1) for i in range(p - 1)])
+        prev = jnp.concatenate([prev_last, xl[:-1]])
+        mask = (spos < n) & ((spos == 0) | (xl != prev))
+        total = jax.lax.psum(jnp.sum(mask.astype(idt)), comm.axis_name)
+        return xl, gi, mask, total
+
+    fn = jax.jit(
+        shard_map(body, mesh=comm.mesh, in_specs=spec,
+                  out_specs=(spec, spec, spec, comm.spec(0, None)),
+                  check_vma=False)
+    )
+    _UNIQUE_CACHE[key] = fn
+    return fn
+
+
+def _phase_b_fn(c, jdt, n, n_unique, comm, with_counts):
+    """(sorted values, mask) -> compacted uniques (+counts), front-aligned in
+    the c-chunk layout; positions beyond ``n_unique`` are garbage."""
+    key = ("uniqB", c, str(jdt), n, n_unique, with_counts, comm.cache_key)
+    fn = _UNIQUE_CACHE.get(key)
+    if fn is not None:
+        return fn
+    p = comm.size
+    rounds = batcher_rounds(p)
+    roles = _role_tables(rounds, p)
+    idt = _index_dtype()
+    kmax = jnp.iinfo(idt).max
+    spec = comm.spec(1, 0)
+
+    def body(xl, mask):
+        me = jax.lax.axis_index(comm.axis_name)
+        cnt = jnp.sum(mask.astype(idt))
+        offs = comm.exscan(cnt)
+        out_pos = jnp.where(mask, offs + jnp.cumsum(mask.astype(idt)) - 1,
+                            kmax)
+        spos = me * c + jnp.arange(c, dtype=idt)
+        _, (vals_s, spos_s) = _network_sort(
+            out_pos, (xl, spos), rounds, roles, c, False, comm.axis_name)
+        if not with_counts:
+            return (vals_s,)
+        # counts[r] = first_pos[r+1] - first_pos[r]; last closes at n
+        nxt_first = jax.lax.ppermute(
+            spos_s[:1], comm.axis_name,
+            perm=[(i + 1, i) for i in range(p - 1)])
+        nxt = jnp.concatenate([spos_s[1:], nxt_first])
+        gout = me * c + jnp.arange(c, dtype=idt)
+        counts = jnp.where(
+            gout < n_unique - 1, nxt - spos_s,
+            jnp.where(gout == n_unique - 1, n - spos_s, 0))
+        return vals_s, counts
+
+    n_out = 2 if with_counts else 1
+    fn = jax.jit(
+        shard_map(body, mesh=comm.mesh, in_specs=(spec, spec),
+                  out_specs=(spec,) * n_out, check_vma=False)
+    )
+    _UNIQUE_CACHE[key] = fn
+    return fn
+
+
+def _phase_c_fn(c, comm):
+    """(original positions, mask) -> inverse indices in the input layout."""
+    key = ("uniqC", c, comm.cache_key)
+    fn = _UNIQUE_CACHE.get(key)
+    if fn is not None:
+        return fn
+    p = comm.size
+    rounds = batcher_rounds(p)
+    roles = _role_tables(rounds, p)
+    idt = _index_dtype()
+    spec = comm.spec(1, 0)
+
+    def body(gi, mask):
+        cnt = jnp.sum(mask.astype(idt))
+        offs = comm.exscan(cnt)
+        # rank of the unique each sorted element belongs to (duplicates
+        # inherit the rank of their first occurrence via the prefix count)
+        rank = offs + jnp.cumsum(mask.astype(idt)) - 1
+        # distributed scatter back to input order: gi is a permutation of the
+        # physical positions (padding entries carry gi >= n and sink to the
+        # trailing padding again)
+        _, (rank_s,) = _network_sort(gi, (rank,), rounds, roles, c, False,
+                                     comm.axis_name)
+        return rank_s
+
+    fn = jax.jit(
+        shard_map(body, mesh=comm.mesh, in_specs=(spec, spec),
+                  out_specs=spec, check_vma=False)
+    )
+    _UNIQUE_CACHE[key] = fn
+    return fn
+
+
+def distributed_unique(a, return_inverse: bool, return_counts: bool):
+    """Distributed unique of a 1-D split DNDarray. Returns DNDarrays
+    ``(uniques[, inverse][, counts])``; uniques/counts are split at 0 in the
+    canonical layout for their length ``U``, inverse is split like ``a``."""
+    from .dndarray import DNDarray
+    from . import types
+
+    comm = a.comm
+    n = a.shape[0]
+    c = comm.chunk_size(n)
+    jdt = jnp.dtype(a.larray.dtype)
+
+    sorted_phys, gi, mask, total = _phase_a_fn(c, jdt, n, comm)(a.larray)
+    n_unique = int(total)  # the one host sync — the result size is dynamic
+
+    fb = _phase_b_fn(c, jdt, n, n_unique, comm, return_counts)
+    compacted = fb(sorted_phys, mask)
+    uniques = DNDarray.from_logical(
+        compacted[0][:n_unique], 0, a.device, comm, dtype=a.dtype)
+    out = [uniques]
+    if return_inverse:
+        rank_s = _phase_c_fn(c, comm)(gi, mask)
+        out.append(DNDarray(
+            rank_s, (n,), types.canonical_heat_type(rank_s.dtype), 0,
+            a.device, comm))
+    if return_counts:
+        out.append(DNDarray.from_logical(
+            compacted[1][:n_unique], 0, a.device, comm))
+    return tuple(out) if len(out) > 1 else out[0]

@@ -319,16 +319,114 @@ def minimum(x1, x2, out=None) -> DNDarray:
 def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False, keepdim=None) -> DNDarray:
     """q-th percentile (reference ``statistics.py:1256``).
 
-    Gather-based: percentiles are order statistics with data-dependent
-    communication; the logical array is materialized and reduced by XLA.
+    Order statistics by sort-then-select: when the reduction crosses the
+    split axis, the distributed block merge-split sort
+    (:mod:`heat_tpu.core._sort`) orders the data over the mesh — no
+    full-array gather, matching the reference's distributed percentile —
+    and the (static) order-statistic positions are then sliced out and
+    interpolated. A reduction along a non-split axis stays local on the
+    physical shards. NaN lanes propagate to NaN results (numpy parity).
     """
     if keepdim is not None:  # reference/torch keyword name
         keepdims = keepdim
-    logical = x._logical()
-    qa = jnp.asarray(q, dtype=jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    q_np = np.asarray(q, dtype=np.float64)
+    if q_np.size and (q_np.min() < 0 or q_np.max() > 100):
+        raise ValueError("Percentiles must be in the range [0, 100]")
+    if interpolation not in ("linear", "lower", "higher", "nearest", "midpoint"):
+        raise ValueError(f"unknown interpolation method {interpolation!r}")
     axis_s = sanitize_axis(x.shape, axis)
-    res = jnp.percentile(logical.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32),
+    ftype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    distributed = x.split is not None and x.comm.size > 1
+    if distributed and (axis_s is None or axis_s == x.split):
+        return _percentile_distributed(x, q, axis_s, out, interpolation,
+                                       keepdims, ftype)
+    if distributed:
+        # reduction axis is not the split axis: purely local per shard;
+        # padding rows produce garbage that stays in the invalid region
+        qa = jnp.asarray(q, dtype=ftype)
+        res = jnp.percentile(x.larray.astype(ftype), qa, axis=axis_s,
+                             method=interpolation, keepdims=keepdims)
+        q_ndim = np.ndim(q)
+        split = x.split + q_ndim if keepdims else (
+            x.split - (1 if axis_s < x.split else 0) + q_ndim)
+        gshape = list(x.shape)
+        if keepdims:
+            gshape[axis_s] = 1
+        else:
+            del gshape[axis_s]
+        gshape = tuple(np.shape(q)) + tuple(gshape)
+        result = DNDarray(res, gshape, types.canonical_heat_type(res.dtype),
+                          split, x.device, x.comm)
+        return _operations._finalize(result, out)
+    logical = x._logical()
+    qa = jnp.asarray(q, dtype=ftype)
+    res = jnp.percentile(logical.astype(ftype),
                          qa, axis=axis_s, method=interpolation, keepdims=keepdims)
+    result = DNDarray.from_logical(res, None, x.device, x.comm)
+    return _operations._finalize(result, out)
+
+
+def _percentile_distributed(x: DNDarray, q, axis_s, out, interpolation,
+                            keepdims, ftype) -> DNDarray:
+    """Sort-then-select percentile crossing the split axis."""
+    from ._sort import distributed_flat_sort_fn, distributed_sort_fn
+
+    comm = x.comm
+    jdt = jnp.dtype(x.larray.dtype)
+    floating = jnp.issubdtype(jdt, jnp.floating)
+    if axis_s is None:
+        n = int(np.prod(x.shape, dtype=np.int64))
+        # floats: NaN-fill the padding — NaNs (data and padding alike) sort
+        # last, so the first n sorted positions are exactly the data
+        # multiset even when it contains NaN or +inf
+        sent = jnp.asarray(jnp.nan, jdt) if floating else _min_neutral(x)
+        fn = distributed_flat_sort_fn(
+            x.larray.shape, jdt, x.split, comm)
+        sorted_phys = fn(x.filled(sent))
+
+        def take(i):
+            return sorted_phys[i]
+    else:
+        n = x.shape[axis_s]
+        fn = distributed_sort_fn(
+            x.larray.shape, jdt, axis_s, n, False, comm)
+        sorted_phys, _ = fn(x.larray)
+
+        def take(i):
+            return jnp.take(sorted_phys, i, axis=axis_s)
+
+    q_arr = np.asarray(q, dtype=np.float64).reshape(-1)
+    picks = []
+    for qv in q_arr:
+        f = (n - 1) * float(qv) / 100.0
+        lo, hi = int(np.floor(f)), int(np.ceil(f))
+        w = f - lo
+        if interpolation == "lower":
+            r = take(lo).astype(ftype)
+        elif interpolation == "higher":
+            r = take(hi).astype(ftype)
+        elif interpolation == "nearest":
+            r = take(int(np.round(f))).astype(ftype)
+        elif interpolation == "midpoint":
+            r = (take(lo).astype(ftype) + take(hi).astype(ftype)) / 2
+        else:  # linear
+            a = take(lo).astype(ftype)
+            r = a if hi == lo else a + (take(hi).astype(ftype) - a) * ftype(w)
+        picks.append(r)
+    if floating:
+        # numpy parity: any NaN in a lane poisons that lane's percentile.
+        # NaNs sort to the end of the valid region, so the last valid
+        # element is NaN iff the lane contains one.
+        last = take(n - 1)
+        picks = [jnp.where(jnp.isnan(last), jnp.asarray(jnp.nan, ftype), r)
+                 for r in picks]
+    res = picks[0] if np.ndim(q) == 0 else jnp.stack(picks)
+    if np.ndim(q) > 1:
+        res = res.reshape(tuple(np.shape(q)) + res.shape[1:])
+    if keepdims and axis_s is not None:
+        res = jnp.expand_dims(res, axis_s + np.ndim(q))
+    elif keepdims:
+        res = res.reshape(tuple(np.shape(q)) + (1,) * x.ndim)
     result = DNDarray.from_logical(res, None, x.device, x.comm)
     return _operations._finalize(result, out)
 

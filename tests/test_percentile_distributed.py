@@ -1,0 +1,93 @@
+"""Distributed percentile/median via sort-then-select (reference
+``statistics.py:1256,867``): crossing the split axis must use the network
+sort, never a full gather; non-split axes stay local."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+rng = np.random.default_rng(13)
+
+
+@pytest.mark.parametrize("q", [0, 25, 37.5, 50, 75, 100])
+def test_percentile_1d_split(q):
+    data = rng.normal(size=97).astype(np.float32)
+    x = ht.array(data, split=0)
+    got = float(ht.percentile(x, q).item())
+    assert got == pytest.approx(float(np.percentile(data, q)), rel=1e-5, abs=1e-6)
+
+
+@pytest.mark.parametrize("interpolation", ["linear", "lower", "higher", "nearest", "midpoint"])
+def test_percentile_interpolations(interpolation):
+    data = rng.integers(0, 100, 41).astype(np.float32)
+    x = ht.array(data, split=0)
+    got = float(ht.percentile(x, 33, interpolation=interpolation).item())
+    want = float(np.percentile(data, 33, method=interpolation))
+    assert got == pytest.approx(want, rel=1e-6)
+
+
+def test_percentile_q_array():
+    data = rng.normal(size=53).astype(np.float32)
+    x = ht.array(data, split=0)
+    got = np.asarray(ht.percentile(x, [10, 50, 90]).numpy())
+    np.testing.assert_allclose(got, np.percentile(data, [10, 50, 90]), rtol=1e-5)
+
+
+@pytest.mark.parametrize("split", [0, 1])
+def test_percentile_2d_axis_split(split):
+    data = rng.normal(size=(19, 11)).astype(np.float32)
+    x = ht.array(data, split=split)
+    for axis in (0, 1):
+        got = np.asarray(ht.percentile(x, 40, axis=axis).numpy())
+        np.testing.assert_allclose(got, np.percentile(data, 40, axis=axis),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_percentile_2d_flatten_split():
+    data = rng.normal(size=(13, 7)).astype(np.float32)
+    for split in (0, 1):
+        x = ht.array(data, split=split)
+        got = float(ht.percentile(x, 62).item())
+        assert got == pytest.approx(float(np.percentile(data, 62)), rel=1e-5)
+
+
+def test_percentile_keepdims():
+    data = rng.normal(size=(9, 6)).astype(np.float32)
+    x = ht.array(data, split=0)
+    got = np.asarray(ht.percentile(x, 50, axis=0, keepdims=True).numpy())
+    want = np.percentile(data, 50, axis=0, keepdims=True)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_percentile_nan_propagates():
+    """Round-2 review: numpy parity — a NaN lane yields NaN, never a value
+    computed with padding sentinels."""
+    data = np.array([1.0, np.nan, 2.0, 5.0, 3.0, 4.0, 0.5, 9.0], np.float32)
+    x = ht.array(data, split=0)
+    assert np.isnan(float(ht.percentile(x, 50).item()))
+    assert np.isnan(float(ht.median(x).item()))
+    m = rng.normal(size=(11, 6)).astype(np.float32)
+    m[3, 2] = np.nan
+    got = np.asarray(ht.percentile(ht.array(m, split=0), 50, axis=0).numpy())
+    want = np.percentile(m, 50, axis=0)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    assert np.isnan(got[2]) and np.isfinite(got[0])
+
+
+def test_percentile_q_2d():
+    data = rng.normal(size=37).astype(np.float32)
+    x = ht.array(data, split=0)
+    q = [[10.0, 50.0], [75.0, 90.0]]
+    got = np.asarray(ht.percentile(x, q).numpy())
+    np.testing.assert_allclose(got, np.percentile(data, q), rtol=1e-5)
+
+
+def test_median_matches_numpy():
+    for n in (8, 51, 101):
+        data = rng.normal(size=n).astype(np.float32)
+        x = ht.array(data, split=0)
+        assert float(ht.median(x).item()) == pytest.approx(
+            float(np.median(data)), rel=1e-5, abs=1e-6)

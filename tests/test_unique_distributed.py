@@ -1,0 +1,100 @@
+"""Distributed unique (``heat_tpu/core/_setops.py``).
+
+Coverage modeled on the reference's ``test_manipulations.py`` unique cases:
+random duplicate-heavy data at prime sizes, inverse/counts round trips, and
+the VERDICT round-1 done-criterion — no full-array gather in the compiled
+pipeline (pairwise collective-permutes and scalar-sized gathers only).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core import _setops
+
+
+rng = np.random.default_rng(11)
+
+
+@pytest.mark.parametrize("n", [1, 7, 29, 101, 256])
+@pytest.mark.parametrize("dtype", [np.int32, np.float32])
+def test_unique_random(n, dtype):
+    data = rng.integers(0, max(2, n // 3), n).astype(dtype)
+    x = ht.array(data, split=0)
+    u = ht.unique(x)
+    np.testing.assert_array_equal(np.asarray(u.numpy()), np.unique(data))
+    assert u.split == 0  # distributed path returns a split result
+
+
+def test_unique_inverse_counts_random():
+    data = rng.integers(0, 17, 83).astype(np.int64)
+    nu, ninv, ncnt = np.unique(data, return_inverse=True, return_counts=True)
+    x = ht.array(data, split=0)
+    u, inv, cnt = ht.unique(x, return_inverse=True, return_counts=True)
+    np.testing.assert_array_equal(np.asarray(u.numpy()), nu)
+    np.testing.assert_array_equal(np.asarray(inv.numpy()), ninv)
+    np.testing.assert_array_equal(np.asarray(cnt.numpy()), ncnt)
+    # inverse reconstructs the input
+    np.testing.assert_array_equal(nu[np.asarray(inv.numpy())], data)
+
+
+def test_unique_all_same_and_all_distinct():
+    same = np.full(31, 5, dtype=np.int32)
+    x = ht.array(same, split=0)
+    u, cnt = ht.unique(x, return_counts=True)
+    np.testing.assert_array_equal(np.asarray(u.numpy()), [5])
+    np.testing.assert_array_equal(np.asarray(cnt.numpy()), [31])
+
+    distinct = rng.permutation(41).astype(np.float32)
+    u2, inv2 = ht.unique(ht.array(distinct, split=0), return_inverse=True)
+    np.testing.assert_array_equal(np.asarray(u2.numpy()), np.sort(distinct))
+    np.testing.assert_array_equal(
+        np.sort(distinct)[np.asarray(inv2.numpy())], distinct)
+
+
+def test_unique_floats_with_negatives():
+    data = np.repeat(np.array([-2.5, 0.0, 3.25, -2.5, 7.5], np.float32), 5)
+    rng.shuffle(data)
+    u = ht.unique(ht.array(data, split=0))
+    np.testing.assert_array_equal(np.asarray(u.numpy()), np.unique(data))
+
+
+def test_unique_nan_and_inf():
+    """Round-2 review regression: NaNs must survive (each as its own
+    unique, numpy/torch semantics) and no fabricated infs may appear."""
+    data = np.array([1.0, np.nan, 2.0, 5.0, 3.0], np.float32)
+    u = ht.unique(ht.array(data, split=0))
+    got = np.asarray(u.numpy())
+    assert got.shape == (5,)
+    np.testing.assert_array_equal(got[:4], [1.0, 2.0, 3.0, 5.0])
+    assert np.isnan(got[4])
+
+    data2 = np.array([np.inf, 1.0, -np.inf, np.inf, np.nan, np.nan],
+                     np.float32)
+    u2, cnt2 = ht.unique(ht.array(data2, split=0), return_counts=True)
+    g2 = np.asarray(u2.numpy())
+    np.testing.assert_array_equal(g2[:3], [-np.inf, 1.0, np.inf])
+    assert np.isnan(g2[3:]).all() and g2.shape == (5,)
+    np.testing.assert_array_equal(np.asarray(cnt2.numpy()), [1, 1, 2, 1, 1])
+
+
+def test_unique_compiles_without_allgather():
+    """Phases A and B must not gather the data axis: pairwise
+    collective-permute plus scalar-sized collectives only."""
+    comm = ht.get_comm()
+    if comm.size == 1:
+        pytest.skip("needs a multi-device mesh")
+    n = 53
+    c = comm.chunk_size(n)
+    jdt = jnp.dtype(jnp.float32)
+    x = ht.array(rng.integers(0, 9, n).astype(np.float32), split=0)
+    fa = _setops._phase_a_fn(c, jdt, n, comm)
+    hlo_a = fa.lower(x.larray).compile().as_text()
+    assert "collective-permute" in hlo_a
+    # scalar psum/exscan all-gathers are fine; data-sized ones are not:
+    # no all-gather operand may be the (c,)-chunked data array
+    for line in hlo_a.splitlines():
+        if "all-gather" in line and f"[{n}]" in line.replace(" ", ""):
+            raise AssertionError(f"full-axis all-gather found: {line}")
