@@ -144,22 +144,97 @@ class DataParallel:
 
 
 class DataParallelMultiGPU(DataParallel):
-    """Reference parity for the DDP+DASO wrapper (``data_parallel.py:314-377``).
+    """Two-tier DDP+DASO trainer (reference ``data_parallel.py:314-377``).
 
-    The reference combines node-local torch DDP (NCCL) with global MPI sync
-    via DASO. On a TPU mesh both communication tiers ride the same XLA
-    collectives; pair this wrapper with :class:`heat_tpu.optim.DASO`, which
-    reconstructs the two-tier (fast axis / slow axis) schedule.
+    The reference combines node-local torch DDP (NCCL allreduce every step)
+    with delayed global MPI sync via DASO. TPU-native rendering on DASO's
+    ``(slow=dcn) × (fast=ici)`` grid: every parameter leaf carries a leading
+    node-replica axis sharded over ``dcn``; the fused train step ``vmap``s
+    the local update over that axis, so each node group advances its own
+    diverged copy on its own slice of the batch, while the intra-group
+    gradient mean over ``ici`` is the psum GSPMD inserts (batch dims sharded
+    ``(dcn, ici)``, replica axis sharded ``dcn`` → the backward's reduction
+    scope is exactly one node group). DASO's schedule then reconciles the
+    replicas over the slow tier (``heat_tpu.optim.DASO.step``).
     """
 
     def __init__(self, module, optimizer, comm=None, **kwargs):
-        super().__init__(module, comm=comm, optimizer=getattr(optimizer, "local_optimizer", optimizer), **kwargs)
-        self.daso = optimizer if hasattr(optimizer, "global_skip") else None
+        if not hasattr(optimizer, "global_skip"):
+            raise TypeError("DataParallelMultiGPU requires a heat_tpu.optim.DASO")
+        super().__init__(module, comm=comm,
+                         optimizer=optimizer.local_optimizer, **kwargs)
+        self.daso = optimizer
+
+    # ------------------------------------------------------------------ #
+    def init(self, sample_input) -> None:
+        """Seed-unified init, then per-node replication (reference ``:108``;
+        the replicas only diverge through training, like the reference's
+        independently stepped node models)."""
+        sample = _as_jax(sample_input)
+        key = jax.random.key(self.seed)
+        base = self.module.init(key, sample)
+        self.params = self.daso.replicate(base)
+        if self.optimizer is not None:
+            self.optimizer.opt_state = jax.vmap(self.optimizer.tx.init)(self.params)
+
+    def __call__(self, x):
+        """Forward with the slow-tier-averaged parameters."""
+        if self.params is None:
+            self.init(x)
+        xa = _as_jax(x)
+        out = self.module.apply(self.daso.unreplicate(self.params), xa)
+        if isinstance(x, DNDarray):
+            return DNDarray.from_logical(out, x.split, x.device, x.comm)
+        return out
+
+    forward = __call__
+
+    def _build_train_step(self):
+        apply_fn = self.module.apply
+        loss_fn = self.loss_fn
+        tx = self.optimizer.tx
+
+        def one_replica(params, opt_state, bx, by):
+            def loss(p):
+                return loss_fn(apply_fn(p, bx), by)
+
+            lval, grads = jax.value_and_grad(loss)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            import optax
+
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, lval
+
+        vstep = jax.vmap(one_replica)
+
+        def train_step(params, opt_state, bx, by):
+            params, opt_state, lvals = vstep(params, opt_state, bx, by)
+            return params, opt_state, jnp.mean(lvals)
+
+        return jax.jit(train_step, donate_argnums=(0, 1))
+
+    def _shard_two_tier(self, arr):
+        """(B, ...) host batch → (slow, B/slow, ...) on the grid, batch
+        sharded over both tiers."""
+        slow = self.daso.slow_size
+        arr = _as_jax(arr)
+        if arr.shape[0] % slow:
+            raise ValueError(
+                f"batch size {arr.shape[0]} must divide by the node count {slow}")
+        arr = arr.reshape((slow, arr.shape[0] // slow) + arr.shape[1:])
+        return jax.device_put(
+            arr, self.daso.grid.sharding(arr.ndim, dcn=0, ici=1))
 
     def step(self, x, y) -> float:
-        """Fused local step, then the DASO slow-tier schedule (the reference
-        drives the global sync from DASO's ``step``, ``dp_optimizer.py:730``)."""
-        loss = super().step(x, y)
-        if self.daso is not None:
-            self.params = self.daso.step(self.params)
-        return loss
+        """Fused two-tier local step, then the DASO slow-tier schedule (the
+        reference drives the global sync from DASO's ``step``,
+        ``dp_optimizer.py:730``)."""
+        if self.params is None:
+            self.init(_as_jax(x)[:1])
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        bx, by = self._shard_two_tier(x), self._shard_two_tier(y)
+        self.params, self.optimizer.opt_state, loss = self._train_step(
+            self.params, self.optimizer.opt_state, bx, by)
+        self.params = self.daso.step(self.params)
+        return float(loss)

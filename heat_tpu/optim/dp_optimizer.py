@@ -100,15 +100,24 @@ class DataParallelOptimizer:
 class DASO:
     """Hierarchical delayed-sync optimizer (reference ``dp_optimizer.py:46``).
 
-    Two-tier schedule on a factored mesh: a *fast* tier (intra-node, ICI)
-    that synchronizes every step inside the fused train step, and a *slow*
-    tier (inter-node) that synchronizes parameters every ``global_skip``
-    steps, in bfloat16. Warmup / cycling / cooldown phases are driven by
-    :class:`DetectMetricPlateau` exactly like the reference's
-    ``epoch_loss_logic`` (``:336``).
+    Two-tier data parallelism on a factored ``MeshGrid((slow, fast),
+    ("dcn", "ici"))``: the *fast* tier (intra-node, ICI) synchronizes
+    gradients every step inside the fused train step; the *slow* tier
+    (inter-node, DCN) lets each node-group's parameters **diverge** and
+    reconciles them every ``global_skip`` batches by a bfloat16 parameter
+    average that is applied ``batches_to_wait`` batches later, blended
+    half-and-half with the locally advanced parameters — the XLA rendering
+    of the reference's delayed ``_global_sync``/``_gs_rcv_update`` pipeline
+    (``:432-652``: Isend of bf16 params, received N batches later, averaged
+    into the local model).
 
-    On a single-host mesh the slow tier spans a device sub-grid; the
-    schedule (and its numerics: bf16 wire, skip cadence) is identical.
+    Parameter layout: with a non-trivial slow tier every parameter leaf
+    carries a leading replica axis of length ``slow_size``, sharded over the
+    ``"dcn"`` mesh axis (:meth:`replicate` installs it, :meth:`unreplicate`
+    averages it away). The slow-tier average is then one ``mean`` over that
+    axis — GSPMD turns it into the inter-node all-reduce. Warmup / cycling /
+    cooldown phases are driven by :class:`DetectMetricPlateau` exactly like
+    the reference's ``epoch_loss_logic`` (``:336``).
     """
 
     def __init__(
@@ -123,8 +132,11 @@ class DASO:
         max_global_skips: int = 8,
         sending_chunk_size: int = 10_000_000,
         downcast_type=jnp.bfloat16,
+        local_size: Optional[int] = None,
         verbose: bool = False,
     ):
+        from ..core.communication import MeshGrid
+
         self.local_optimizer = (
             local_optimizer
             if isinstance(local_optimizer, DataParallelOptimizer)
@@ -140,37 +152,139 @@ class DASO:
         self.downcast_type = downcast_type
         self.verbose = verbose
 
+        # two-level mesh: nodes (slow/DCN) × devices-per-node (fast/ICI).
+        # The reference reads node boundaries from MPI topology
+        # (``dp_optimizer.py:136-170``); here they come from the process
+        # count on a real pod, or from ``local_size`` explicitly.
+        n = self.comm.size
+        if local_size is None:
+            local_size = max(1, n // jax.process_count())
+        if n % local_size:
+            raise ValueError(
+                f"mesh of {n} devices cannot factor into nodes of {local_size}")
+        self.slow_size = n // local_size
+        self.fast_size = local_size
+        self.grid = MeshGrid((self.slow_size, self.fast_size), ("dcn", "ici"),
+                             devices=self.comm.devices)
+
         self.global_skip = 1
         self.batches_to_wait = 1
         self.epoch = 0
         self._batch = 0
-        self._sync_fn = None
+        self._pending = None  # (apply_at_batch, bf16 slow-tier average)
+        self._avg_fn = None
+        self._blend_fn = None
 
     @property
     def tx(self):
         return self.local_optimizer.tx
 
     # -------------------------------------------------------------- #
-    def _global_sync(self, params):
-        """Slow-tier parameter averaging in bf16 (reference ``_global_sync``
-        ``:432`` + ``_gs_send_params`` ``:592``)."""
+    # replica-axis layout                                            #
+    # -------------------------------------------------------------- #
+    def replicate(self, params):
+        """Install the slow-tier replica axis: every leaf becomes
+        ``(slow_size, *shape)``, sharded over the ``"dcn"`` mesh axis and
+        replicated over ``"ici"`` (reference: per-node model copies)."""
+        slow = self.slow_size
+
+        def rep(p):
+            p = jnp.asarray(p)
+            out = jnp.broadcast_to(p[None], (slow,) + p.shape)
+            return jax.device_put(out, self.grid.sharding(out.ndim, dcn=0))
+
+        return jax.tree_util.tree_map(rep, params)
+
+    def unreplicate(self, params):
+        """Collapse the replica axis by averaging (end-of-training export)."""
+        return jax.tree_util.tree_map(
+            lambda p: jnp.mean(p, axis=0) if jnp.issubdtype(p.dtype, jnp.floating)
+            else p[0],
+            params)
+
+    # -------------------------------------------------------------- #
+    def _build_sync_fns(self):
         cast = self.downcast_type
 
-        def avg(p):
-            return jnp.mean(
-                jnp.stack([p.astype(cast)]), axis=0
-            ).astype(p.dtype)
+        if self.slow_size == 1:
+            # trivial slow tier: the only replica's "sync" is the bf16 wire
+            # round-trip. Works for plain (un-replicated) params too — the
+            # single-host convenience mode.
+            self._avg_fn = jax.jit(lambda ps: ps)
+            self._blend_fn = jax.jit(lambda av, ps: jax.tree_util.tree_map(
+                lambda p: p.astype(cast).astype(p.dtype)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, ps))
+            return
 
-        # parameters are replicated on the mesh: averaging across replicas is
-        # the identity *unless* tiers diverged; we re-broadcast the bf16 cast
-        # to model the wire format.
-        return jax.tree_util.tree_map(lambda p: p.astype(cast).astype(p.dtype), params)
+        def avg_leaf(p):
+            if not jnp.issubdtype(p.dtype, jnp.floating):
+                return p[0]
+            # bf16 wire format: downcast before the inter-node reduction
+            # (reference ``__prep_params_to_send`` ``:592``)
+            return jnp.mean(p.astype(cast), axis=0)
+
+        def blend_leaf(a, p):
+            if not jnp.issubdtype(p.dtype, jnp.floating):
+                return p
+            return ((a.astype(p.dtype)[None] + p) * 0.5).astype(p.dtype)
+
+        self._avg_fn = jax.jit(
+            lambda ps: jax.tree_util.tree_map(avg_leaf, ps))
+        self._blend_fn = jax.jit(
+            lambda av, ps: jax.tree_util.tree_map(blend_leaf, av, ps))
+
+    def _check_replicated(self, params):
+        """Reject un-replicated params when the slow tier is real: the
+        replica average would otherwise silently mean over a *parameter*
+        axis (round-2 review finding)."""
+        if self.slow_size == 1:
+            return
+        slow = self.slow_size
+        bad = [
+            p.shape
+            for p in jax.tree_util.tree_leaves(params)
+            if not (hasattr(p, "ndim") and p.ndim >= 1 and p.shape[0] == slow)
+        ]
+        if bad:
+            raise ValueError(
+                f"DASO with slow_size={slow} requires the replica axis on "
+                f"every parameter leaf (use daso.replicate(params)); got "
+                f"leaf shapes {bad[:3]}")
+
+    def _global_sync(self, params):
+        """Immediate slow-tier reconciliation (capture + blend in one step;
+        the scheduled path in :meth:`step` splits these by
+        ``batches_to_wait``)."""
+        if self._avg_fn is None:
+            self._build_sync_fns()
+        self._check_replicated(params)
+        return self._blend_fn(self._avg_fn(params), params)
 
     def step(self, params):
-        """Advance the DASO schedule by one batch (reference ``step`` ``:730``)."""
+        """Advance the DASO schedule by one batch (reference ``step``
+        ``:730``): apply a previously captured slow-tier average once its
+        delay expires, and capture a new one every ``global_skip`` batches.
+
+        ``params`` must carry the replica axis (:meth:`replicate`) when
+        ``slow_size > 1``.
+        """
+        if self._avg_fn is None:
+            self._build_sync_fns()
+        self._check_replicated(params)
         self._batch += 1
-        if self._batch % max(1, self.global_skip) == 0:
-            params = self._global_sync(params)
+        if self._pending is not None and self._batch >= self._pending[0]:
+            params = self._blend_fn(self._pending[1], params)
+            self._pending = None
+        skip = max(1, self.global_skip)
+        if self._batch % skip == 0:
+            avg = self._avg_fn(params)  # the bf16 "send"
+            wait = min(self.batches_to_wait, skip)
+            if wait <= 0:
+                params = self._blend_fn(avg, params)
+            else:
+                # received ``wait`` batches later, averaged into the locally
+                # advanced parameters (reference ``_gs_rcv_update`` ``:652``)
+                self._pending = (self._batch + wait, avg)
         return params
 
     def epoch_loss_logic(self, loss) -> None:
