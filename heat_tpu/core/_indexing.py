@@ -1,0 +1,195 @@
+"""Distributed advanced indexing along the split axis (reference
+``heat/core/dndarray.py:656-912`` getitem / ``:1363-1652`` setitem).
+
+The reference translates global fancy indices to per-rank local ones and
+moves rows point-to-point. The static-shape XLA rendering is a **systolic
+ring**: the data (or the request/value pairs) rotate around the mesh in
+``p`` ``ppermute`` steps, and each device keeps/applies the rows whose
+global position falls in its range. O(chunk) memory per device, no
+materialization of the logical global array — the round-1 VERDICT #5 fix
+for "one fancy index = a full gather" at the 1B-point north star.
+
+Three programs, all compiled per (shape, mesh):
+
+- ``ring_gather_fn``  — ``x[idx]`` rows by integer array along the split
+  axis (any permutation, with repeats).
+- ``ring_compress_fn`` — ``x[mask]`` row compaction by a boolean mask on
+  the split axis; output positions are a distributed prefix count, so each
+  device's kept rows form a contiguous output range and a ``searchsorted``
+  against the rotating block finds each output slot's source row.
+- ``ring_scatter_fn`` — ``x[idx] = values``: (index, value-row) pairs
+  rotate; each device applies the writes that target its rows with an
+  out-of-bounds-drop scatter (duplicate indices resolve in rotation order,
+  matching NumPy's "unspecified" contract).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+
+from ._sort import _index_dtype
+
+__all__ = [
+    "ring_gather_fn",
+    "ring_compress_fn",
+    "ring_scatter_fn",
+    "mask_positions_fn",
+]
+
+_IDX_CACHE: dict = {}
+
+
+def _row_mask(hit, row_ndim):
+    return hit.reshape(hit.shape + (1,) * row_ndim)
+
+
+def ring_gather_fn(phys_shape, jdt, axis: int, c_out: int, comm):
+    """Jitted ``(x_physical, idx_physical) -> rows_physical``.
+
+    ``idx_physical``: 1-D int array of physical length ``p * c_out``, split
+    at 0, holding global row positions along ``axis`` (entries < 0 are
+    treated as invalid and produce zero rows — callers encode padding that
+    way)."""
+    key = ("rgather", tuple(phys_shape), str(jdt), axis, c_out, comm.cache_key)
+    fn = _IDX_CACHE.get(key)
+    if fn is not None:
+        return fn
+    p = comm.size
+    c = phys_shape[axis] // p
+
+    def body(xb, ib):
+        buf = jnp.moveaxis(xb, axis, 0)  # (c, rest...)
+        me = jax.lax.axis_index(comm.axis_name)
+        out = jnp.zeros((c_out,) + buf.shape[1:], buf.dtype)
+        for k in range(p):
+            owner = (me - k) % p  # original owner of the block in ``buf``
+            rel = ib - owner * c
+            hit = (rel >= 0) & (rel < c) & (ib >= 0)
+            take = jnp.take(buf, jnp.clip(rel, 0, c - 1), axis=0)
+            out = jnp.where(_row_mask(hit, buf.ndim - 1), take, out)
+            if k < p - 1:
+                buf = comm.ring_shift(buf, 1)
+        return jnp.moveaxis(out, 0, axis)
+
+    spec_x = comm.spec(len(phys_shape), axis)
+    fn = jax.jit(
+        shard_map(body, mesh=comm.mesh, in_specs=(spec_x, comm.spec(1, 0)),
+                  out_specs=spec_x, check_vma=False)
+    )
+    _IDX_CACHE[key] = fn
+    return fn
+
+
+def mask_positions_fn(c: int, comm):
+    """Jitted ``mask_physical -> (out_pos_physical, count)``: the output
+    slot of each kept row (global prefix count over the mesh; ``-1`` where
+    the mask is False), plus the global number kept."""
+    key = ("mpos", c, comm.cache_key)
+    fn = _IDX_CACHE.get(key)
+    if fn is not None:
+        return fn
+    idt = _index_dtype()
+
+    def body(mb):
+        cnt = jnp.sum(mb.astype(idt))
+        offs = comm.exscan(cnt)
+        pos = jnp.where(mb, offs + jnp.cumsum(mb.astype(idt)) - 1,
+                        jnp.asarray(-1, idt))
+        total = jax.lax.psum(cnt, comm.axis_name)
+        return pos, total
+
+    spec = comm.spec(1, 0)
+    fn = jax.jit(
+        shard_map(body, mesh=comm.mesh, in_specs=spec,
+                  out_specs=(spec, comm.spec(0, None)), check_vma=False)
+    )
+    _IDX_CACHE[key] = fn
+    return fn
+
+
+def ring_compress_fn(phys_shape, jdt, axis: int, m: int, c_out: int, comm):
+    """Jitted ``(x_physical, out_pos_physical) -> compacted_physical``.
+
+    ``out_pos`` (from :func:`mask_positions_fn`) is monotone over kept rows,
+    so each rotating block's kept rows are sorted by output position and a
+    ``searchsorted`` matches every output slot to its source row."""
+    key = ("rcompress", tuple(phys_shape), str(jdt), axis, m, c_out,
+           comm.cache_key)
+    fn = _IDX_CACHE.get(key)
+    if fn is not None:
+        return fn
+    p = comm.size
+    c = phys_shape[axis] // p
+    idt = _index_dtype()
+    big = jnp.iinfo(idt).max
+
+    def body(xb, pb):
+        buf = jnp.moveaxis(xb, axis, 0)  # (c, rest...)
+        me = jax.lax.axis_index(comm.axis_name)
+        qs = me * c_out + jnp.arange(c_out, dtype=idt)  # my output slots
+        out = jnp.zeros((c_out,) + buf.shape[1:], buf.dtype)
+        pos = jnp.where(pb >= 0, pb, big)  # dropped rows sort to the end
+        for k in range(p):
+            rel = jnp.searchsorted(pos, qs).astype(idt)
+            relc = jnp.clip(rel, 0, c - 1)
+            hit = (jnp.take(pos, relc) == qs) & (qs < m)
+            take = jnp.take(buf, relc, axis=0)
+            out = jnp.where(_row_mask(hit, buf.ndim - 1), take, out)
+            if k < p - 1:
+                buf = comm.ring_shift(buf, 1)
+                pos = comm.ring_shift(pos, 1)
+        return jnp.moveaxis(out, 0, axis)
+
+    spec_x = comm.spec(len(phys_shape), axis)
+    out_shape = list(phys_shape)
+    out_shape[axis] = c_out * p
+    fn = jax.jit(
+        shard_map(body, mesh=comm.mesh, in_specs=(spec_x, comm.spec(1, 0)),
+                  out_specs=spec_x, check_vma=False)
+    )
+    _IDX_CACHE[key] = fn
+    return fn
+
+
+def ring_scatter_fn(phys_shape, jdt, axis: int, c_in: int, comm):
+    """Jitted ``(x_physical, idx_physical, value_rows_physical) -> updated``.
+
+    (index, value-row) pairs are split at 0 with chunk ``c_in`` and rotate
+    around the ring; each device applies the writes landing in its row
+    range via an OOB-drop scatter. Negative indices mark padding (no-op).
+    """
+    key = ("rscatter", tuple(phys_shape), str(jdt), axis, c_in, comm.cache_key)
+    fn = _IDX_CACHE.get(key)
+    if fn is not None:
+        return fn
+    p = comm.size
+    c = phys_shape[axis] // p
+
+    def body(xb, ib, vb):
+        buf = jnp.moveaxis(xb, axis, 0)  # (c, rest...)
+        me = jax.lax.axis_index(comm.axis_name)
+        for k in range(p):
+            rel = ib - me * c
+            hit = (rel >= 0) & (rel < c) & (ib >= 0)
+            # OOB-drop scatter: misses write to row index c, which is
+            # outside the block and silently dropped
+            tgt = jnp.where(hit, rel, c)
+            buf = buf.at[tgt].set(vb, mode="drop")
+            if k < p - 1:
+                ib = comm.ring_shift(ib, 1)
+                vb = comm.ring_shift(vb, 1)
+        return jnp.moveaxis(buf, 0, axis)
+
+    spec_x = comm.spec(len(phys_shape), axis)
+    vspec = comm.spec(len(phys_shape), 0)
+    fn = jax.jit(
+        shard_map(body, mesh=comm.mesh,
+                  in_specs=(spec_x, comm.spec(1, 0), vspec),
+                  out_specs=spec_x, check_vma=False)
+    )
+    _IDX_CACHE[key] = fn
+    return fn

@@ -1241,14 +1241,151 @@ def _result_split_basic(x: DNDarray, key) -> Optional[int]:
     return None
 
 
+def _match_split_axis_array_key(x: DNDarray, key):
+    """Detect keys whose single non-trivial element is a 1-D integer array
+    or 1-D boolean mask sitting exactly at the split axis (everything else
+    full slices). These run the distributed ring-indexing programs
+    (:mod:`heat_tpu.core._indexing`) instead of materializing the logical
+    array. Returns ``("int"|"bool", array_like)`` or None."""
+    if x.split is None or x.comm.size <= 1 or x.ndim == 0:
+        return None
+    keys = list(key) if isinstance(key, tuple) else [key]
+    if any(k is None for k in keys):
+        return None
+    if Ellipsis in keys:
+        i = keys.index(Ellipsis)
+        n_explicit = sum(_index_axis_span(k) for k in keys if k is not Ellipsis)
+        keys[i:i + 1] = [slice(None)] * (x.ndim - n_explicit)
+        if Ellipsis in keys:
+            return None
+    keys += [slice(None)] * (x.ndim - sum(_index_axis_span(k) for k in keys))
+    hit = None
+    axis = 0
+    for k in keys:
+        if isinstance(k, list):
+            k = np.asarray(k)
+            if k.size == 0:
+                k = k.astype(np.intp)
+        if isinstance(k, (DNDarray, np.ndarray, jnp.ndarray)):
+            if k.ndim != 1 or axis != x.split or hit is not None:
+                return None
+            dt = k.larray.dtype if isinstance(k, DNDarray) else k.dtype
+            if dt == np.bool_:
+                if k.shape[0] != x.shape[x.split]:
+                    return None
+                hit = ("bool", k)
+            elif jnp.issubdtype(dt, jnp.integer):
+                hit = ("int", k)
+            else:
+                return None
+            axis += 1
+        elif isinstance(k, slice) and k == slice(None):
+            axis += 1
+        else:
+            return None  # non-trivial slice/int elsewhere: fallback paths
+    return hit
+
+
+def _mask_physical(x: DNDarray, mask_like):
+    """A physical split-0 bool array aligned with ``x``'s split axis chunks
+    (padding positions False)."""
+    comm = x.comm
+    n = x.shape[x.split]
+    if isinstance(mask_like, DNDarray):
+        if mask_like.split == 0 and mask_like.larray.shape[0] == comm.padded_size(n):
+            return jnp.where(mask_like.valid_mask(), mask_like.larray, False)
+        mask_like = mask_like._logical()
+    m_np = jnp.asarray(np.asarray(mask_like) if isinstance(mask_like, list)
+                       else mask_like, jnp.bool_)
+    pad = comm.padded_size(n) - n
+    if pad:
+        m_np = jnp.concatenate([m_np, jnp.zeros((pad,), jnp.bool_)])
+    return jax.device_put(m_np, comm.sharding(1, 0))
+
+
+def _index_physical(x: DNDarray, idx_like, m_len=None):
+    """(idx_physical, m): a split-0 physical int array of global row
+    positions (negatives normalized, padding = -1), bounds-checked."""
+    from ._sort import _index_dtype
+
+    comm = x.comm
+    n = x.shape[x.split]
+    idt = _index_dtype()
+    if isinstance(idx_like, DNDarray):
+        m = idx_like.shape[0]
+        la = idx_like.larray.astype(idt)
+        la = jnp.where(la < 0, la + n, la)
+        phys = jnp.where(idx_like.valid_mask(), la, jnp.asarray(-1, idt))
+        if m > 0:
+            lo = int(jnp.min(jnp.where(idx_like.valid_mask(), la, 0)))
+            hi = int(jnp.max(jnp.where(idx_like.valid_mask(), la, 0)))
+            if lo < 0 or hi >= n:
+                raise IndexError(
+                    f"index out of bounds for axis {x.split} with size {n}")
+        return phys, m
+    idx_np = np.asarray(idx_like, dtype=np.int64).reshape(-1)
+    m = idx_np.shape[0]
+    idx_np = np.where(idx_np < 0, idx_np + n, idx_np)
+    if m and ((idx_np < 0).any() or (idx_np >= n).any()):
+        raise IndexError(
+            f"index out of bounds for axis {x.split} with size {n}")
+    c_out = comm.chunk_size(m)
+    pad = c_out * comm.size - m
+    full = np.concatenate([idx_np, np.full(pad, -1, np.int64)])
+    return jax.device_put(jnp.asarray(full, idt), comm.sharding(1, 0)), m
+
+
+def _empty_rows(x: DNDarray, axis: int) -> DNDarray:
+    gshape = tuple(0 if i == axis else s for i, s in enumerate(x.gshape))
+    return DNDarray.from_logical(
+        jnp.zeros(gshape, x.larray.dtype), None, x.device, x.comm,
+        dtype=x.dtype)
+
+
+def _getitem_split_axis_advanced(x: DNDarray, kind, arr) -> DNDarray:
+    """x[idx]/x[mask] along the split axis via the ring programs — no
+    logical materialization (reference translation path,
+    ``dndarray.py:656-912``)."""
+    from . import _indexing
+
+    comm = x.comm
+    axis = x.split
+    jdt = jnp.dtype(x.larray.dtype)
+    if kind == "int":
+        idx_phys, m = _index_physical(x, arr)
+        if m == 0:
+            return _empty_rows(x, axis)
+        c_out = idx_phys.shape[0] // comm.size
+        fn = _indexing.ring_gather_fn(x.larray.shape, jdt, axis, c_out, comm)
+        rows = fn(x.larray, idx_phys)
+    else:
+        mask_phys = _mask_physical(x, arr)
+        c = mask_phys.shape[0] // comm.size
+        pos, total = _indexing.mask_positions_fn(c, comm)(mask_phys)
+        m = int(total)
+        if m == 0:
+            return _empty_rows(x, axis)
+        c_out = comm.chunk_size(m)
+        fn = _indexing.ring_compress_fn(
+            x.larray.shape, jdt, axis, m, c_out, comm)
+        rows = fn(x.larray, pos)
+    gshape = tuple(m if i == axis else s for i, s in enumerate(x.gshape))
+    return DNDarray(rows, gshape, x.dtype, axis, x.device, x.comm)
+
+
 def _getitem_impl(x: DNDarray, key):
     """Global indexing (reference ``__getitem__``, ``dndarray.py:656-912``).
 
     Fast path: keys that leave the split axis untouched index the physical
-    array directly (zero communication). General path: index the logical
-    global view and re-shard — correct for every NumPy-style key; the data
-    motion is XLA-scheduled.
+    array directly (zero communication). Distributed path: a 1-D integer
+    array or boolean mask addressing exactly the split axis runs the ring
+    gather/compress programs — O(chunk) memory, no logical materialization.
+    General path: index the logical global view and re-shard — correct for
+    every NumPy-style key; the data motion is XLA-scheduled.
     """
+    adv = _match_split_axis_array_key(x, key)
+    if adv is not None:
+        return _getitem_split_axis_advanced(x, *adv)
     key = _normalize_key(x, key)
     if _basic_key_fast_path(x, key):
         sub = x.larray[key]

@@ -17,7 +17,11 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+    # HEAT_TPU_TEST_DEVICES drives the reference-style device ladder
+    # (mpirun -n 1…8 → suite runs at 1/2/4/8 virtual devices,
+    # scripts/run_suite_ladder.sh)
+    ndev = os.environ.get("HEAT_TPU_TEST_DEVICES", "8")
+    os.environ["XLA_FLAGS"] = flags + f" --xla_force_host_platform_device_count={ndev}"
 
 import jax  # noqa: E402
 
