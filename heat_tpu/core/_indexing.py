@@ -114,9 +114,15 @@ def mask_positions_fn(c: int, comm):
 def ring_compress_fn(phys_shape, jdt, axis: int, m: int, c_out: int, comm):
     """Jitted ``(x_physical, out_pos_physical) -> compacted_physical``.
 
-    ``out_pos`` (from :func:`mask_positions_fn`) is monotone over kept rows,
-    so each rotating block's kept rows are sorted by output position and a
-    ``searchsorted`` matches every output slot to its source row."""
+    ``out_pos`` (from :func:`mask_positions_fn`) holds each kept row's output
+    slot and ``-1`` for dropped rows — it is NOT monotone (dropped rows are
+    interleaved), so it cannot be binary-searched directly. Instead each
+    step rebuilds the block's monotone inclusive prefix count
+    ``s[i] = offs + #kept rows <= i`` (``offs`` = the block's first output
+    slot): row ``i`` serves output slot ``q`` iff ``kept[i]`` and
+    ``s[i] == q + 1``, and ``searchsorted(s, q + 1, side='left')`` lands on
+    exactly that row because ``s`` first reaches ``q + 1`` where the count
+    increments."""
     key = ("rcompress", tuple(phys_shape), str(jdt), axis, m, c_out,
            comm.cache_key)
     fn = _IDX_CACHE.get(key)
@@ -125,23 +131,28 @@ def ring_compress_fn(phys_shape, jdt, axis: int, m: int, c_out: int, comm):
     p = comm.size
     c = phys_shape[axis] // p
     idt = _index_dtype()
-    big = jnp.iinfo(idt).max
 
     def body(xb, pb):
         buf = jnp.moveaxis(xb, axis, 0)  # (c, rest...)
         me = jax.lax.axis_index(comm.axis_name)
         qs = me * c_out + jnp.arange(c_out, dtype=idt)  # my output slots
         out = jnp.zeros((c_out,) + buf.shape[1:], buf.dtype)
-        pos = jnp.where(pb >= 0, pb, big)  # dropped rows sort to the end
         for k in range(p):
-            rel = jnp.searchsorted(pos, qs).astype(idt)
+            kept = pb >= 0
+            csum = jnp.cumsum(kept.astype(idt))
+            # every kept row agrees on the block offset pb - (csum - 1);
+            # a block with no kept rows never hits, so 0 is a safe fill
+            offs = jnp.max(jnp.where(kept, pb - csum + 1, 0))
+            s = offs + csum  # non-decreasing
+            rel = jnp.searchsorted(s, qs + 1, side="left").astype(idt)
             relc = jnp.clip(rel, 0, c - 1)
-            hit = (jnp.take(pos, relc) == qs) & (qs < m)
+            hit = ((rel < c) & jnp.take(kept, relc)
+                   & (jnp.take(s, relc) == qs + 1) & (qs < m))
             take = jnp.take(buf, relc, axis=0)
             out = jnp.where(_row_mask(hit, buf.ndim - 1), take, out)
             if k < p - 1:
                 buf = comm.ring_shift(buf, 1)
-                pos = comm.ring_shift(pos, 1)
+                pb = comm.ring_shift(pb, 1)
         return jnp.moveaxis(out, 0, axis)
 
     spec_x = comm.spec(len(phys_shape), axis)

@@ -85,7 +85,12 @@ class LocalIndex:
     (``dndarray.py:22-35``): indexes the physical array directly. Writes go
     back into the owning array (jax arrays are immutable, so the functional
     ``.at[].set()`` result must replace the owner's buffer — the reference
-    mutates the local torch tensor in place)."""
+    mutates the local torch tensor in place).
+
+    Semantic note: under MPI, ``lloc`` addresses the calling rank's shard;
+    under the single controller it addresses the whole *physical* (padded,
+    global) array — i.e. all shards at once, in canonical layout. Per-device
+    blocks are ``larray.addressable_shards``."""
 
     def __init__(self, owner: "DNDarray"):
         self._owner = owner
@@ -272,7 +277,14 @@ class DNDarray:
 
     @property
     def lshape(self) -> Tuple[int, ...]:
-        """Logical shard shape on mesh device 0 (parity with reference rank-0)."""
+        """Logical shard shape on mesh device 0.
+
+        Semantic note (vs reference ``dndarray.py:186``): under MPI every
+        rank sees *its own* local shape here; under the single-controller
+        runtime there is one process, so this property reports device 0 —
+        the canonical layout makes all shards the same size anyway (the last
+        may be padding-short). Use :attr:`lshape_map` for the per-device
+        table, or ``larray.addressable_shards`` for the raw blocks."""
         _, lshape, _ = self.__comm.chunk(self.__gshape, self.__split, rank=0)
         return lshape
 
@@ -665,34 +677,59 @@ class DNDarray:
 
         return arithmetics.right_shift(other, self)
 
+    @staticmethod
+    def _is_operand(other) -> builtins.bool:
+        """True for types the binary-op engine can promote (DNDarray, python
+        scalars, numpy/jax arrays, nested sequences). Non-operands (Ellipsis,
+        None, slices, arbitrary objects) make the rich comparisons return
+        ``NotImplemented`` so Python falls back to identity semantics instead
+        of raising through ``_binary_op`` — e.g. ``Ellipsis in (x, ...)``."""
+        return isinstance(
+            other,
+            (DNDarray, builtins.int, builtins.float, builtins.bool, complex,
+             np.generic, np.ndarray, jnp.ndarray, list, tuple),
+        )
+
     def __eq__(self, other):
         from . import relational
 
+        if not self._is_operand(other):
+            return NotImplemented
         return relational.eq(self, other)
 
     def __ne__(self, other):
         from . import relational
 
+        if not self._is_operand(other):
+            return NotImplemented
         return relational.ne(self, other)
 
     def __lt__(self, other):
         from . import relational
 
+        if not self._is_operand(other):
+            return NotImplemented
         return relational.lt(self, other)
 
     def __le__(self, other):
         from . import relational
 
+        if not self._is_operand(other):
+            return NotImplemented
         return relational.le(self, other)
 
     def __gt__(self, other):
         from . import relational
 
+        if not self._is_operand(other):
+            return NotImplemented
         return relational.gt(self, other)
 
     def __ge__(self, other):
         from . import relational
 
+        if not self._is_operand(other):
+            return NotImplemented
         return relational.ge(self, other)
 
     __hash__ = None
@@ -1220,9 +1257,9 @@ def _result_split_basic(x: DNDarray, key) -> Optional[int]:
     if not isinstance(key, tuple):
         key = (key,)
     key = list(key)
-    # expand ellipsis
-    if Ellipsis in key:
-        i = key.index(Ellipsis)
+    # expand ellipsis (identity tests — see _match_split_axis_array_key)
+    if any(k is Ellipsis for k in key):
+        i = next(j for j, k in enumerate(key) if k is Ellipsis)
         n_explicit = sum(1 for k in key if k is not Ellipsis and k is not None)
         key[i : i + 1] = [slice(None)] * (x.ndim - n_explicit)
     out_pos = 0
@@ -1252,11 +1289,13 @@ def _match_split_axis_array_key(x: DNDarray, key):
     keys = list(key) if isinstance(key, tuple) else [key]
     if any(k is None for k in keys):
         return None
-    if Ellipsis in keys:
-        i = keys.index(Ellipsis)
+    # identity tests only: ``in``/``index`` run ``==`` per element, which is
+    # ambiguous for array-valued keys and dispatches DNDarray.__eq__
+    if any(k is Ellipsis for k in keys):
+        i = next(j for j, k in enumerate(keys) if k is Ellipsis)
         n_explicit = sum(_index_axis_span(k) for k in keys if k is not Ellipsis)
         keys[i:i + 1] = [slice(None)] * (x.ndim - n_explicit)
-        if Ellipsis in keys:
+        if any(k is Ellipsis for k in keys):
             return None
     keys += [slice(None)] * (x.ndim - sum(_index_axis_span(k) for k in keys))
     hit = None
@@ -1286,6 +1325,137 @@ def _match_split_axis_array_key(x: DNDarray, key):
     return hit
 
 
+def _match_mixed_key(x: DNDarray, key):
+    """Detect mixed advanced keys: EXACTLY ONE 1-D integer array or 1-D
+    boolean mask combined with basic ints/slices (reference
+    ``dndarray.py:656-912`` bread-and-butter ``x[idx, 2:5]``). Returns
+    ``(keys, arr_pos, kind, arr)`` with Ellipsis expanded and the key padded
+    to ``x.ndim``, or None for keys the general path must handle.
+
+    Non-slice keys (ints + the array) must sit at consecutive axes: NumPy
+    moves broadcast dims to the front when advanced indices are *separated*
+    by a slice, and the per-axis layout used here would be wrong there.
+    """
+    if x.split is None or x.comm.size <= 1 or x.ndim == 0:
+        return None
+    keys = list(key) if isinstance(key, tuple) else [key]
+    if any(k is None or isinstance(k, builtins.bool) for k in keys):
+        return None
+    if any(k is Ellipsis for k in keys):
+        i = next(j for j, k in enumerate(keys) if k is Ellipsis)
+        n_explicit = sum(_index_axis_span(k) for k in keys if k is not Ellipsis)
+        keys[i:i + 1] = [slice(None)] * (x.ndim - n_explicit)
+        if any(k is Ellipsis for k in keys):
+            return None
+    keys += [slice(None)] * (x.ndim - sum(_index_axis_span(k) for k in keys))
+    if len(keys) != x.ndim:
+        return None
+    arr_pos = kind = arr = None
+    for axis, k in enumerate(keys):
+        if isinstance(k, list):
+            k = np.asarray(k)
+            if k.size == 0:
+                k = k.astype(np.intp)
+            keys[axis] = k
+        if isinstance(k, (DNDarray, np.ndarray, jnp.ndarray)):
+            if k.ndim != 1 or arr_pos is not None:
+                return None
+            dt = k.larray.dtype if isinstance(k, DNDarray) else k.dtype
+            if dt == np.bool_:
+                if k.shape[0] != x.gshape[axis]:
+                    return None
+                kind = "bool"
+            elif jnp.issubdtype(dt, jnp.integer):
+                kind = "int"
+            else:
+                return None
+            arr_pos, arr = axis, k
+        elif isinstance(k, slice):
+            continue
+        elif isinstance(k, builtins.int):
+            n = x.gshape[axis]
+            kk = k + n if k < 0 else k
+            if not 0 <= kk < n:
+                raise IndexError(
+                    f"index {k} is out of bounds for axis {axis} with size {n}")
+            keys[axis] = kk
+        else:
+            return None
+    if arr_pos is None:
+        return None
+    adv = [i for i, k in enumerate(keys) if not isinstance(k, slice)]
+    if any(b - a != 1 for a, b in zip(adv, adv[1:])):
+        return None  # separated advanced indices: broadcast dims move front
+    return keys, arr_pos, kind, arr
+
+
+def _slice_len(sl: slice, n: int) -> builtins.int:
+    return len(range(*sl.indices(n)))
+
+
+def _getitem_mixed(x: DNDarray, keys, arr_pos, kind, arr) -> Optional[DNDarray]:
+    """Execute a mixed key from :func:`_match_mixed_key` without logical
+    materialization. Array at the split axis: apply the basic keys
+    shard-locally (they never touch the split axis), then run the ring
+    programs. Array elsewhere with the split axis untouched: the whole key
+    applies shard-locally."""
+    split = x.split
+    if arr_pos == split:
+        pre = tuple(slice(None) if i == split else k
+                    for i, k in enumerate(keys))
+        if all(isinstance(k, slice) and k == slice(None) for k in pre):
+            sub = x
+        else:
+            sub_phys = x.larray[pre]
+            gshape, new_split, dim = [], None, 0
+            for i, k in enumerate(keys):
+                if i == split:
+                    new_split = dim
+                    gshape.append(x.gshape[i])
+                    dim += 1
+                elif isinstance(k, slice):
+                    gshape.append(_slice_len(k, x.gshape[i]))
+                    dim += 1
+                # ints drop the dim
+            sub = DNDarray(sub_phys, tuple(gshape), x.dtype, new_split,
+                           x.device, x.comm)
+        return _getitem_split_axis_advanced(sub, kind, arr)
+    # array on a non-split axis: only valid gather-free when the split axis
+    # keeps its full extent
+    if not (isinstance(keys[split], slice) and keys[split] == slice(None)):
+        return None
+    n_axis = x.gshape[arr_pos]
+    if kind == "bool":
+        mask = arr.numpy() if isinstance(arr, DNDarray) else np.asarray(arr)
+        idx_np = np.nonzero(np.asarray(mask, bool))[0]
+    else:
+        if isinstance(arr, DNDarray):
+            arr = np.asarray(arr.numpy())
+        idx_np = np.asarray(arr, dtype=np.int64).reshape(-1)
+        idx_np = np.where(idx_np < 0, idx_np + n_axis, idx_np)
+        if idx_np.size and ((idx_np < 0).any() or (idx_np >= n_axis).any()):
+            raise IndexError(
+                f"index out of bounds for axis {arr_pos} with size {n_axis}")
+    m = idx_np.shape[0]
+    key2 = tuple(jnp.asarray(idx_np) if i == arr_pos else k
+                 for i, k in enumerate(keys))
+    sub_phys = x.larray[key2]
+    gshape, new_split, dim = [], None, 0
+    for i, k in enumerate(keys):
+        if i == arr_pos:
+            gshape.append(m)
+            dim += 1
+        elif isinstance(k, slice):
+            if i == split:
+                new_split = dim
+                gshape.append(x.gshape[i])
+            else:
+                gshape.append(_slice_len(k, x.gshape[i]))
+            dim += 1
+    return DNDarray(sub_phys, tuple(gshape), x.dtype, new_split, x.device,
+                    x.comm)
+
+
 def _mask_physical(x: DNDarray, mask_like):
     """A physical split-0 bool array aligned with ``x``'s split axis chunks
     (padding positions False)."""
@@ -1311,6 +1481,11 @@ def _index_physical(x: DNDarray, idx_like, m_len=None):
     comm = x.comm
     n = x.shape[x.split]
     idt = _index_dtype()
+    if isinstance(idx_like, DNDarray) and idx_like.split != 0:
+        # replicated (or oddly-split) index: its physical array is not in
+        # the canonical padded split-0 layout the ring expects
+        idx_like = np.asarray(idx_like.larray if idx_like.split is None
+                              else idx_like.numpy())
     if isinstance(idx_like, DNDarray):
         m = idx_like.shape[0]
         la = idx_like.larray.astype(idt)
@@ -1386,6 +1561,11 @@ def _getitem_impl(x: DNDarray, key):
     adv = _match_split_axis_array_key(x, key)
     if adv is not None:
         return _getitem_split_axis_advanced(x, *adv)
+    mixed = _match_mixed_key(x, key)
+    if mixed is not None:
+        res = _getitem_mixed(x, *mixed)
+        if res is not None:
+            return res
     key = _normalize_key(x, key)
     if _basic_key_fast_path(x, key):
         sub = x.larray[key]
@@ -1418,8 +1598,87 @@ def _getitem_impl(x: DNDarray, key):
     return DNDarray.from_logical(sub, new_split, x.device, x.comm, dtype=x.dtype)
 
 
+def _setitem_split_axis_advanced(x: DNDarray, kind, arr, value) -> builtins.bool:
+    """``x[idx] = v`` / ``x[mask] = v`` along the split axis without
+    materializing the logical array (reference ``dndarray.py:1363-1652``):
+    boolean masks with row-broadcastable values apply locally via ``where``;
+    integer-array keys rotate (index, value-row) pairs around the ring
+    (:func:`heat_tpu.core._indexing.ring_scatter_fn`). Returns False when the
+    value shape needs the general fallback."""
+    from . import _indexing
+
+    comm = x.comm
+    axis = x.split
+    jdt = jnp.dtype(x.larray.dtype)
+    row_shape = tuple(s for i, s in enumerate(x.gshape) if i != axis)
+
+    val_dn = value if isinstance(value, DNDarray) else None
+    if val_dn is not None and not (kind == "int" and val_dn.split == 0):
+        value = val_dn._logical()
+        val_dn = None
+
+    if kind == "bool":
+        val = jnp.asarray(value, jdt)
+        # a masked where along the split axis is exact NumPy semantics (and
+        # fully local, no ring) iff the value does not vary along that axis:
+        # right-aligned against the target shape, its axis dim is 1 or absent
+        j = axis - (x.ndim - val.ndim)
+        if val.ndim <= x.ndim and (j < 0 or val.shape[j] == 1):
+            target_one = tuple(1 if i == axis else s
+                               for i, s in enumerate(x.gshape))
+            try:
+                np.broadcast_shapes(tuple(val.shape), target_one)
+            except ValueError:
+                return False
+            mask_phys = _mask_physical(x, arr)
+            sel = mask_phys.reshape(
+                tuple(-1 if i == axis else 1 for i in range(x.ndim)))
+            x.larray = jnp.where(sel, val, x.larray)
+            return True
+        # value varies per selected position: reduce to the integer-scatter
+        # path over the kept positions
+        if isinstance(arr, DNDarray):
+            arr = np.asarray(arr.numpy())
+        idx = np.nonzero(np.asarray(arr, bool))[0]
+        return _setitem_split_axis_advanced(x, "int", idx, value)
+
+    idx_phys, m = _index_physical(x, arr)
+    if m == 0:
+        return True
+    c_in = idx_phys.shape[0] // comm.size
+    if val_dn is not None and axis == 0 and val_dn.split == 0 and \
+            val_dn.larray.shape == (c_in * comm.size,) + row_shape:
+        # split-0 value whose chunks already align with the index chunks:
+        # feed the physical shards straight into the ring (padding rows pair
+        # with idx -1 and drop)
+        val_phys = val_dn.larray.astype(jdt)
+    else:
+        if val_dn is not None:
+            value = val_dn._logical()
+        val = jnp.asarray(value, jdt)
+        # NumPy target shape keeps the index dim at the axis position
+        # (``x[:, idx] = v`` broadcasts v against (rows, m)); the ring wants
+        # the index dim leading
+        target = tuple(m if i == axis else s for i, s in enumerate(x.gshape))
+        try:
+            rows = jnp.moveaxis(jnp.broadcast_to(val, target), axis, 0)
+        except (ValueError, TypeError):
+            return False
+        pad = c_in * comm.size - m
+        if pad:
+            rows = jnp.concatenate(
+                [rows, jnp.zeros((pad,) + row_shape, jdt)])
+        val_phys = jax.device_put(rows, comm.sharding(x.ndim, 0))
+    fn = _indexing.ring_scatter_fn(x.larray.shape, jdt, axis, c_in, comm)
+    x.larray = fn(x.larray, idx_phys, val_phys)
+    return True
+
+
 def _setitem_impl(x: DNDarray, key, value):
     """Global assignment (reference ``__setitem__``, ``dndarray.py:1363-1652``)."""
+    adv = _match_split_axis_array_key(x, key)
+    if adv is not None and _setitem_split_axis_advanced(x, *adv, value):
+        return
     key = _normalize_key(x, key)
     if isinstance(value, DNDarray):
         value = value._logical()

@@ -2,6 +2,9 @@
 
 from __future__ import annotations
 
+import numpy as np
+
+import jax
 import jax.numpy as jnp
 
 from . import _operations, types
@@ -10,16 +13,71 @@ from .dndarray import DNDarray
 __all__ = ["nonzero", "where"]
 
 
+def _nonzero_distributed(x: DNDarray) -> DNDarray:
+    """Distributed nonzero (reference keeps the result split,
+    ``indexing.py:16``): a prefix-count compress over the global *flat*
+    index space — the same three-piece machinery as ``x[mask]``
+    (:mod:`heat_tpu.core._indexing`). The only host sync is the nonzero
+    count ``m`` (dynamic output shape — unavoidable under XLA, SURVEY.md §7
+    hard part 4); the coordinates themselves never leave the devices.
+    """
+    from . import _indexing
+    from ._sort import _index_dtype
+
+    comm = x.comm
+    src = x if x.split == 0 else x.resplit(0)
+    phys = src.larray
+    total_flat = int(np.prod(phys.shape))
+    local = total_flat // comm.size
+    idt = _index_dtype()
+    n_valid = x.size  # logical flat extent: rows beyond are padding
+
+    sharding1 = comm.sharding(1, 0)
+    flat_iota = jax.jit(
+        lambda: jnp.arange(total_flat, dtype=idt), out_shardings=sharding1)()
+    flat_vals = jax.jit(
+        lambda a: a.reshape(-1), out_shardings=sharding1)(phys)
+    mask = jax.jit(
+        lambda v, f: (v != 0) & (f < n_valid), out_shardings=sharding1
+    )(flat_vals, flat_iota)
+    pos, total = _indexing.mask_positions_fn(local, comm)(mask)
+    m = int(total)
+    if m == 0:
+        return DNDarray.from_logical(
+            jnp.zeros((0, x.ndim), idt), None, x.device, comm)
+    c_out = comm.chunk_size(m)
+    fn = _indexing.ring_compress_fn(
+        (total_flat,), jnp.dtype(idt), 0, m, c_out, comm)
+    flat_kept = fn(flat_iota, pos)
+    strides = []
+    s = 1
+    for dim in reversed(x.gshape):
+        strides.append(s)
+        s *= dim
+    strides = strides[::-1]
+
+    def unravel(fk):
+        return jnp.stack(
+            [(fk // int(strides[j])) % int(x.gshape[j])
+             for j in range(x.ndim)], axis=1)
+
+    coords = jax.jit(unravel, out_shardings=comm.sharding(2, 0))(flat_kept)
+    return DNDarray(
+        coords, (m, x.ndim), types.canonical_heat_type(idt), 0, x.device, comm)
+
+
 def nonzero(x: DNDarray) -> DNDarray:
     """Indices of nonzero elements as an (nnz, ndim) array (reference
     ``indexing.py:16``).
 
-    Dynamic-shape op: the result is materialized replicated (host-synced
-    count), the documented semantic for shape-data-dependent ops on the XLA
-    backend (SURVEY.md §7, hard part 4).
+    Split arrays run the distributed prefix-count compress (result stays
+    split along axis 0, matching the reference); only the nonzero *count*
+    syncs to host — a dynamic output shape needs a concrete size under XLA.
     """
     if not isinstance(x, DNDarray):
         raise TypeError(f"expected x to be a DNDarray, but was {type(x)}")
+    if x.split is not None and x.comm.size > 1 and x.size > 0 and x.ndim > 0:
+        return _nonzero_distributed(x)
     logical = x._logical()
     idx = jnp.nonzero(logical)
     stacked = jnp.stack(idx, axis=1) if x.ndim > 0 else jnp.zeros((0, 0), jnp.int64)

@@ -1,0 +1,380 @@
+"""Regression tests for the split-axis ring-indexing programs
+(``heat_tpu/core/_indexing.py``; reference ``heat/core/dndarray.py:656-912``).
+
+Round-2 advisor findings covered here:
+
+- ``Ellipsis in keys`` identity bug: array-valued keys must not be
+  element-compared while detecting the ring path (``x[x > 5]`` crash).
+- ``ring_compress_fn`` searched a non-monotone position sequence, so
+  ``x[mask]`` silently returned wrong rows for interleaved masks — the
+  advisor's 4-device repro is test_advisor_repro_interleaved_mask.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import dndarray as dnd_mod
+
+from utils import assert_array_equal
+
+
+def _ring_detects(x, key):
+    """The dispatcher recognizes ``key`` as a ring-program case."""
+    return dnd_mod._match_split_axis_array_key(x, key) is not None
+
+
+class TestRingCompress:
+    def test_advisor_repro_interleaved_mask(self):
+        # advisor round-2 repro: expected [0, 2, 3, 7, 11, 19], observed
+        # [0, 0, 3, 0, 0, 0] before the monotone-searchsorted fix
+        a = np.array([0, 2, 3, 5, 7, 9, 11, 13, 19, 21, 23, 29], np.float32)
+        mask = np.array([1, 1, 1, 0, 1, 0, 1, 0, 1, 0, 0, 0], bool)
+        x = ht.array(a, split=0)
+        assert _ring_detects(x, mask)
+        assert_array_equal(x[mask], a[mask], rtol=0)
+
+    @pytest.mark.parametrize("pattern", ["alternating", "sparse", "dense",
+                                         "block_heavy", "tail_only"])
+    def test_mask_patterns_1d(self, pattern):
+        rng = np.random.default_rng(7)
+        n = 41  # uneven over 8 devices → padded shards
+        a = rng.standard_normal(n).astype(np.float32)
+        if pattern == "alternating":
+            mask = (np.arange(n) % 2).astype(bool)
+        elif pattern == "sparse":
+            mask = np.zeros(n, bool)
+            mask[[3, 17, 40]] = True
+        elif pattern == "dense":
+            mask = np.ones(n, bool)
+            mask[[5, 25]] = False
+        elif pattern == "block_heavy":
+            # all kept rows on the first devices, none later
+            mask = np.arange(n) < 13
+        else:  # tail_only
+            mask = np.arange(n) >= n - 4
+        x = ht.array(a, split=0)
+        assert _ring_detects(x, mask)
+        assert_array_equal(x[mask], a[mask], rtol=0)
+
+    def test_mask_2d_rows_split0(self):
+        rng = np.random.default_rng(11)
+        a = rng.standard_normal((19, 6)).astype(np.float32)
+        mask = rng.random(19) > 0.5
+        x = ht.array(a, split=0)
+        assert _ring_detects(x, (mask, slice(None)))
+        assert_array_equal(x[mask], a[mask], rtol=0)
+
+    def test_mask_on_axis1_split1(self):
+        rng = np.random.default_rng(12)
+        a = rng.standard_normal((5, 23)).astype(np.float32)
+        mask = rng.random(23) > 0.4
+        x = ht.array(a, split=1)
+        assert _ring_detects(x, (slice(None), mask))
+        assert_array_equal(x[:, mask], a[:, mask], rtol=0)
+
+    def test_dndarray_comparison_mask(self):
+        # x[x > 5] — the most ordinary mask expression (round-2 verdict #1)
+        a = np.arange(20, dtype=np.float32)
+        x = ht.array(a, split=0)
+        out = x[x > 5]
+        assert_array_equal(out, a[a > 5], rtol=0)
+
+    def test_split_dndarray_mask_key(self):
+        a = np.arange(30, dtype=np.float32)
+        mask = a % 3 == 0
+        x = ht.array(a, split=0)
+        m = ht.array(mask, split=0)
+        assert_array_equal(x[m], a[mask], rtol=0)
+
+    def test_all_false_mask(self):
+        a = np.arange(16, dtype=np.float32)
+        x = ht.array(a, split=0)
+        out = x[np.zeros(16, bool)]
+        assert out.shape == (0,)
+
+
+class TestRingGather:
+    def test_permutation_with_repeats(self):
+        rng = np.random.default_rng(5)
+        a = rng.standard_normal((26, 3)).astype(np.float32)
+        idx = np.array([25, 0, 13, 13, 7, 1, 24, 5, 13])
+        x = ht.array(a, split=0)
+        assert _ring_detects(x, idx)
+        assert_array_equal(x[idx], a[idx], rtol=0)
+
+    def test_negative_indices(self):
+        a = np.arange(18, dtype=np.float32)
+        idx = np.array([-1, -18, 4, -3])
+        x = ht.array(a, split=0)
+        assert_array_equal(x[idx], a[idx], rtol=0)
+
+    def test_split1_gather(self):
+        rng = np.random.default_rng(6)
+        a = rng.standard_normal((4, 21)).astype(np.float32)
+        idx = np.array([20, 3, 3, 0, 11])
+        x = ht.array(a, split=1)
+        assert _ring_detects(x, (slice(None), idx))
+        assert_array_equal(x[:, idx], a[:, idx], rtol=0)
+
+
+class TestRingScatter:
+    """``x[idx] = v`` / ``x[mask] = v`` along the split axis (wires
+    ``ring_scatter_fn`` — round-2 advisor: implemented but never called)."""
+
+    def test_int_scatter_scalar(self):
+        a = np.arange(23, dtype=np.float32)
+        idx = np.array([0, 7, 22, 11])
+        x = ht.array(a, split=0)
+        x[idx] = -5.0
+        b = a.copy()
+        b[idx] = -5.0
+        assert_array_equal(x, b, rtol=0)
+
+    def test_int_scatter_rows_2d(self):
+        rng = np.random.default_rng(3)
+        a = rng.standard_normal((17, 4)).astype(np.float32)
+        idx = np.array([16, 2, 9])
+        rows = rng.standard_normal((3, 4)).astype(np.float32)
+        x = ht.array(a, split=0)
+        x[idx] = rows
+        b = a.copy()
+        b[idx] = rows
+        assert_array_equal(x, b, rtol=0)
+
+    def test_int_scatter_negative_indices(self):
+        a = np.arange(15, dtype=np.float32)
+        x = ht.array(a, split=0)
+        x[np.array([-1, -15])] = 0.0
+        b = a.copy()
+        b[np.array([-1, -15])] = 0.0
+        assert_array_equal(x, b, rtol=0)
+
+    def test_int_scatter_split_value(self):
+        # split-0 value whose chunks align with the index chunks: shards feed
+        # the ring directly
+        rng = np.random.default_rng(4)
+        a = rng.standard_normal((29, 3)).astype(np.float32)
+        idx = np.arange(29)[::-1].copy()
+        vals = rng.standard_normal((29, 3)).astype(np.float32)
+        x = ht.array(a, split=0)
+        x[idx] = ht.array(vals, split=0)
+        b = a.copy()
+        b[idx] = vals
+        assert_array_equal(x, b, rtol=0)
+
+    def test_mask_scalar_where_path(self):
+        a = np.arange(31, dtype=np.float32)
+        mask = a % 3 == 1
+        x = ht.array(a, split=0)
+        x[mask] = -1.0
+        b = a.copy()
+        b[mask] = -1.0
+        assert_array_equal(x, b, rtol=0)
+
+    def test_mask_row_value_2d(self):
+        rng = np.random.default_rng(8)
+        a = rng.standard_normal((13, 5)).astype(np.float32)
+        mask = rng.random(13) > 0.5
+        row = np.arange(5, dtype=np.float32)
+        x = ht.array(a, split=0)
+        x[mask] = row
+        b = a.copy()
+        b[mask] = row
+        assert_array_equal(x, b, rtol=0)
+
+    def test_mask_per_row_values(self):
+        rng = np.random.default_rng(9)
+        a = rng.standard_normal((21, 2)).astype(np.float32)
+        mask = rng.random(21) > 0.4
+        vals = rng.standard_normal((int(mask.sum()), 2)).astype(np.float32)
+        x = ht.array(a, split=0)
+        x[mask] = vals
+        b = a.copy()
+        b[mask] = vals
+        assert_array_equal(x, b, rtol=0)
+
+    def test_mask_dndarray_split_mask_scalar(self):
+        a = np.arange(26, dtype=np.float32)
+        x = ht.array(a, split=0)
+        x[x > 12] = 12.0
+        b = np.minimum(a, 12.0)
+        assert_array_equal(x, b, rtol=0)
+
+    def test_scatter_axis1(self):
+        rng = np.random.default_rng(10)
+        a = rng.standard_normal((3, 19)).astype(np.float32)
+        idx = np.array([18, 0, 5])
+        x = ht.array(a, split=1)
+        x[:, idx] = 9.0
+        b = a.copy()
+        b[:, idx] = 9.0
+        assert_array_equal(x, b, rtol=0)
+
+
+class TestMixedKeys:
+    """Mixed advanced keys stay O(chunk): basic ints/slices combined with one
+    split-axis array run basic-local + ring; an array on a non-split axis
+    with the split axis intact applies shard-locally (round-2 VERDICT #8,
+    reference ``dndarray.py:656-912``)."""
+
+    a = np.arange(3 * 23 * 4, dtype=np.float32).reshape(23, 3, 4).transpose(1, 0, 2).copy()
+    # shape (3, 23, 4); tests split axis 1 (length 23: uneven over 8 devices)
+
+    def _no_logical(self, monkeypatch):
+        def boom(self):  # pragma: no cover
+            raise AssertionError("mixed key materialized the logical array")
+
+        monkeypatch.setattr(ht.DNDarray, "_logical", boom)
+
+    def test_idx_then_slice(self, monkeypatch):
+        b = np.arange(60, dtype=np.float32).reshape(12, 5)
+        x = ht.array(b, split=0)
+        idx = np.array([0, 7, 11, 3])
+        self._no_logical(monkeypatch)
+        out = x[idx, 1:4]
+        monkeypatch.undo()
+        assert_array_equal(out, b[idx, 1:4], rtol=0)
+        assert out.split == 0
+
+    def test_slice_then_split_idx(self, monkeypatch):
+        x = ht.array(self.a, split=1)
+        idx = np.array([22, 0, 13])
+        self._no_logical(monkeypatch)
+        out = x[0:2, idx]
+        monkeypatch.undo()
+        assert_array_equal(out, self.a[0:2, idx], rtol=0)
+        assert out.split == 1
+
+    def test_int_then_split_idx(self, monkeypatch):
+        x = ht.array(self.a, split=1)
+        idx = np.array([4, 4, 19])
+        self._no_logical(monkeypatch)
+        out = x[1, idx]
+        monkeypatch.undo()
+        assert_array_equal(out, self.a[1, idx], rtol=0)
+        assert out.split == 0
+
+    def test_split_idx_then_int(self, monkeypatch):
+        x = ht.array(self.a, split=1)
+        idx = np.array([2, 9])
+        self._no_logical(monkeypatch)
+        out = x[:, idx, 3]
+        monkeypatch.undo()
+        # advanced (idx at 1, int at 2) separated from nothing — contiguous
+        assert_array_equal(out, self.a[:, idx, 3], rtol=0)
+
+    def test_mask_with_slices(self, monkeypatch):
+        x = ht.array(self.a, split=1)
+        mask = np.arange(23) % 3 == 1
+        self._no_logical(monkeypatch)
+        out = x[0:2, mask, 1:3]
+        monkeypatch.undo()
+        assert_array_equal(out, self.a[0:2, mask, 1:3], rtol=0)
+
+    def test_nonsplit_idx_local(self, monkeypatch):
+        x = ht.array(self.a, split=1)
+        idx = np.array([2, 0, 1, 2])
+        self._no_logical(monkeypatch)
+        out = x[idx]
+        monkeypatch.undo()
+        assert_array_equal(out, self.a[idx], rtol=0)
+        assert out.split == 1
+
+    def test_nonsplit_mask_local(self, monkeypatch):
+        b = np.arange(48, dtype=np.float32).reshape(6, 8)
+        x = ht.array(b, split=0)
+        mask = np.array([True, False, True, False, True, False, True, False])
+        self._no_logical(monkeypatch)
+        out = x[:, mask]
+        monkeypatch.undo()
+        assert_array_equal(out, b[:, mask], rtol=0)
+        assert out.split == 0
+
+    def test_separated_advanced_falls_back(self):
+        # int and array separated by a slice: NumPy moves broadcast dims to
+        # the front — the general path must handle it (and must match)
+        x = ht.array(self.a, split=1)
+        idx = np.array([1, 3])
+        out = x[0, :, idx]
+        np.testing.assert_allclose(np.asarray(out.numpy() if isinstance(
+            out, ht.DNDarray) else out), self.a[0, :, idx], rtol=0)
+
+    def test_negative_step_slice_with_split_idx(self):
+        x = ht.array(self.a, split=1)
+        idx = np.array([5, 5, 0])
+        out = x[::-1, idx]
+        assert_array_equal(out, self.a[::-1, idx], rtol=0)
+
+
+class TestDistributedNonzero:
+    """nonzero keeps the result split and never materializes the logical
+    array (reference ``heat/core/indexing.py:16``; round-2 VERDICT #10)."""
+
+    def test_1d(self):
+        a = np.array([0, 3, 0, 0, 7, 1, 0, 2, 0, 0, 5], np.float32)
+        x = ht.array(a, split=0)
+        nz = ht.nonzero(x)
+        assert nz.split == 0
+        np.testing.assert_array_equal(
+            np.asarray(nz.numpy()), np.stack(np.nonzero(a), 1))
+
+    def test_2d_row_major_order(self):
+        rng = np.random.default_rng(21)
+        a = (rng.random((13, 7)) > 0.6).astype(np.float32)
+        for split in (0, 1):
+            x = ht.array(a, split=split)
+            nz = ht.nonzero(x)
+            np.testing.assert_array_equal(
+                np.asarray(nz.numpy()), np.stack(np.nonzero(a), 1))
+
+    def test_3d(self):
+        rng = np.random.default_rng(22)
+        a = (rng.random((5, 6, 4)) > 0.7).astype(np.int32)
+        x = ht.array(a, split=1)
+        np.testing.assert_array_equal(
+            np.asarray(ht.nonzero(x).numpy()), np.stack(np.nonzero(a), 1))
+
+    def test_all_zero(self):
+        x = ht.array(np.zeros(17, np.float32), split=0)
+        assert ht.nonzero(x).shape == (0, 1)
+
+    def test_no_logical_materialization(self, monkeypatch):
+        a = np.arange(24, dtype=np.float32)
+        x = ht.array(a, split=0)
+
+        def boom(self):  # pragma: no cover
+            raise AssertionError("nonzero materialized the logical array")
+
+        monkeypatch.setattr(ht.DNDarray, "_logical", boom)
+        nz = ht.nonzero(x)
+        monkeypatch.undo()
+        np.testing.assert_array_equal(
+            np.asarray(nz.numpy()), np.stack(np.nonzero(a), 1))
+
+
+class TestDispatcherRobustness:
+    """Array-valued keys must never be element-compared during dispatch."""
+
+    a = np.arange(60, dtype=np.float32).reshape(12, 5)
+
+    def test_ellipsis_with_nparray_key(self):
+        x = ht.array(self.a, split=0)
+        idx = np.array([0, 5, 11])
+        assert_array_equal(x[idx, ...], self.a[idx, ...], rtol=0)
+        assert_array_equal(x[..., np.array([0, 4])],
+                           self.a[..., np.array([0, 4])], rtol=0)
+
+    def test_ellipsis_with_dndarray_key(self):
+        x = ht.array(self.a, split=0)
+        idx = ht.array(np.array([1, 3]))
+        assert_array_equal(x[idx, ...], self.a[np.array([1, 3]), ...], rtol=0)
+
+    def test_eq_non_operand_returns_notimplemented(self):
+        x = ht.array(self.a, split=0)
+        assert x.__eq__(Ellipsis) is NotImplemented
+        assert x.__ne__(object()) is NotImplemented
+        assert x.__lt__(Ellipsis) is NotImplemented
+        # Python falls back to identity for == with NotImplemented
+        assert (x == Ellipsis) is False or isinstance(x == Ellipsis, bool)
+        assert x in [Ellipsis, None, x]  # `in` must not crash
