@@ -116,21 +116,35 @@ def _index_dtype():
 
 
 def _network_sort(key_block, payload_blocks, rounds, role_tables, c, descending,
-                  axis_name):
+                  axis_name, tie_block=None):
     """Run the merge-split network on per-device blocks, inside shard_map.
 
     ``key_block``: (..., c) sort keys, last axis is the (local chunk of the)
     sort axis. ``payload_blocks``: tuple of same-shaped arrays co-sorted with
-    the keys. Returns (sorted key block, tuple of sorted payload blocks).
+    the keys. ``tie_block``: optional secondary key sorted ASCENDING within
+    equal primary keys — used by exact dtypes to keep padding rows (tie=1)
+    after real rows (tie=0) when the data itself contains the sentinel value,
+    so returned indices never point at padding. Returns (sorted key block,
+    tuple of sorted payload blocks).
     """
+    has_tie = tie_block is not None
 
     def _merge(vals, payloads):
-        order = jnp.argsort(vals, axis=-1, descending=descending, stable=True)
+        if has_tie:
+            # lexicographic (primary, tie): stable-sort by the tie first,
+            # then stable-sort by the primary, and compose the permutations
+            o2 = jnp.argsort(payloads[0], axis=-1, stable=True)
+            v2 = jnp.take_along_axis(vals, o2, axis=-1)
+            o1 = jnp.argsort(v2, axis=-1, descending=descending, stable=True)
+            order = jnp.take_along_axis(o2, o1, axis=-1)
+        else:
+            order = jnp.argsort(vals, axis=-1, descending=descending, stable=True)
         return (
             jnp.take_along_axis(vals, order, axis=-1),
             tuple(jnp.take_along_axis(pl, order, axis=-1) for pl in payloads),
         )
 
+    payload_blocks = ((tie_block,) if has_tie else ()) + tuple(payload_blocks)
     xl, pls = _merge(key_block, tuple(payload_blocks))
     me = jax.lax.axis_index(axis_name)
     for pairs, role in zip(rounds, role_tables):
@@ -160,7 +174,7 @@ def _network_sort(key_block, payload_blocks, rounds, role_tables, c, descending,
         xl = pick(both_v[..., :c], both_v[..., c:], xl)
         pls = tuple(pick(bp[..., :c], bp[..., c:], pl)
                     for bp, pl in zip(both_p, pls))
-    return xl, pls
+    return xl, (pls[1:] if has_tie else pls)
 
 
 def _role_tables(rounds, p):
@@ -211,10 +225,15 @@ def distributed_sort_fn(phys_shape, jdt, axis: int, n: int, descending: bool, co
                 keys, (xl, jnp.broadcast_to(gpos, xl.shape)), rounds, roles,
                 c, descending, comm.axis_name)
         else:
+            # the sentinel is a representable value for exact dtypes, so a
+            # padding tie-break key keeps real sentinel-valued rows (tie=0)
+            # ahead of padding rows (tie=1) — indices stay < n (round-2
+            # advisor finding)
             xl = jnp.where(gpos < n, xl, _sentinel(jdt, descending))
+            tie = jnp.broadcast_to((gpos >= n).astype(jnp.int8), xl.shape)
             xl, (gi,) = _network_sort(
                 xl, (jnp.broadcast_to(gpos, xl.shape),), rounds, roles, c,
-                descending, comm.axis_name)
+                descending, comm.axis_name, tie_block=tie)
         return jnp.moveaxis(xl, -1, axis), jnp.moveaxis(gi, -1, axis)
 
     fn = jax.jit(

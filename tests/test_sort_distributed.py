@@ -106,6 +106,27 @@ def test_sort_bool():
     np.testing.assert_array_equal(np.asarray(v.numpy()), np.sort(data))
 
 
+def test_sort_exact_dtype_sentinel_values():
+    """Round-2 advisor regression: for exact dtypes the padding sentinel
+    (iinfo.max / True) is a representable value; when the data contains it
+    the returned indices must still be a permutation of range(n) (the
+    padding tie-break key keeps padding rows behind real sentinel-valued
+    rows)."""
+    imax = np.iinfo(np.int32).max
+    data = np.array([3, imax, 0, imax, 7, imax, -2, 5, imax, 1, imax],
+                    np.int32)  # 11 elements over 8 devices: padded shards
+    _check_sorted(data, 0, False, split=0)
+    _check_sorted(data, 0, True, split=0)
+    imin = np.iinfo(np.int32).min
+    data = np.array([imin, 3, imin, imin, 0, 9, imin], np.int32)
+    _check_sorted(data, 0, False, split=0)
+    _check_sorted(data, 0, True, split=0)
+    # bool hits the sentinel (True) whenever any True is present
+    bdata = np.array([1, 0, 1, 1, 0, 1, 1, 0, 1, 1], bool)
+    _check_sorted(bdata, 0, False, split=0)
+    _check_sorted(bdata, 0, True, split=0)
+
+
 def test_batcher_rounds_depth():
     # O(log^2 p) rounds, disjoint pairs per round
     for p in range(1, 33):
