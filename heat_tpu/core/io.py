@@ -146,15 +146,75 @@ def load_hdf5(
         )
 
 
+def _np_save_dtype(data: DNDarray):
+    """NumPy storage dtype for a DNDarray (bf16 widens to f32: neither h5py
+    nor netCDF4 stores bfloat16)."""
+    jdt = jnp.dtype(data.larray.dtype)
+    return np.dtype(np.float32) if jdt == jnp.bfloat16 else np.dtype(jdt)
+
+
+def _iter_shard_blocks(data: DNDarray, order: bool = False):
+    """Yield ``(logical_slices, np_block)`` once per distinct shard of the
+    physical array, trimmed to the logical extent (padding removed).
+
+    This is the write-side analog of the chunked loads: peak host memory is
+    one shard, never the gathered global array — the reference's
+    rank-ordered/mpio parallel writes (``heat/core/io.py:147-233,487``).
+    ``order=True`` yields shards sorted by their split-axis offset (the
+    rank-ordered CSV stream)."""
+    np_dtype = _np_save_dtype(data)
+    split = data.split
+    if split is None or data.comm.size == 1:
+        block = np.asarray(data.larray.addressable_shards[0].data
+                           if data.larray.is_fully_addressable and split is None
+                           else data._logical(), np_dtype)
+        yield tuple(slice(0, s) for s in data.gshape), block
+        return
+    n = data.gshape[split]
+    phys = data.larray.shape[split]
+    shards = data.larray.addressable_shards
+    if order:
+        shards = sorted(shards, key=lambda s: s.index[split].start or 0)
+    seen = set()
+    for sh in shards:
+        lo = sh.index[split].start or 0
+        if lo in seen:  # replicated copies on other grid axes
+            continue
+        seen.add(lo)
+        hi = min(sh.index[split].stop or phys, n)
+        if hi <= lo:
+            continue  # pure-padding shard
+        block = np.asarray(sh.data, np_dtype)
+        take = hi - lo
+        if block.shape[split] > take:
+            trim = [slice(None)] * data.ndim
+            trim[split] = slice(0, take)
+            block = block[tuple(trim)]
+        slices = tuple(
+            slice(lo, hi) if i == split else slice(0, s)
+            for i, s in enumerate(data.gshape)
+        )
+        yield slices, block
+
+
 def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
-    """Save to HDF5 (reference ``io.py:147``)."""
+    """Save to HDF5 shard-by-shard (reference rank-ordered/mpio writes,
+    ``io.py:147-233``): the dataset is created at the global shape and each
+    device shard's valid slice streams in — O(shard) host memory, never a
+    full gather (round-1/round-2 finding)."""
     if not supports_hdf5():
         raise RuntimeError("hdf5 is required for HDF5 operations, but h5py is not available")
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, not {type(data)}")
-    arr = data.numpy()
     with h5py.File(path, mode) as handle:
-        handle.create_dataset(dataset, data=arr, **kwargs)
+        dset = handle.create_dataset(
+            dataset, shape=data.gshape, dtype=_np_save_dtype(data), **kwargs
+        )
+        for slices, block in _iter_shard_blocks(data):
+            if data.ndim == 0:
+                dset[()] = block
+            else:
+                dset[slices] = block
 
 
 def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
@@ -173,15 +233,25 @@ def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, devic
 
 
 def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs) -> None:
-    """Save to NetCDF (reference ``io.py:348``)."""
+    """Save to NetCDF shard-by-shard (reference merged-slice parallel writes,
+    ``io.py:348,487``): the variable is created at the global shape and each
+    device shard's valid slice streams in — O(shard) host memory."""
     if not supports_netcdf():
         raise RuntimeError("netcdf is required for NetCDF operations, but netCDF4 is not available")
-    arr = data.numpy()
+    if not isinstance(data, DNDarray):
+        raise TypeError(f"data must be a DNDarray, not {type(data)}")
     with nc.Dataset(path, mode) as handle:
-        for i, s in enumerate(arr.shape):
+        for i, s in enumerate(data.gshape):
             handle.createDimension(f"dim_{i}", s)
-        var = handle.createVariable(variable, arr.dtype, tuple(f"dim_{i}" for i in range(arr.ndim)))
-        var[:] = arr
+        var = handle.createVariable(
+            variable, _np_save_dtype(data),
+            tuple(f"dim_{i}" for i in range(data.ndim)),
+        )
+        for slices, block in _iter_shard_blocks(data):
+            if data.ndim == 0:
+                var[()] = block
+            else:
+                var[slices] = block
 
 
 def load_csv(
@@ -252,12 +322,21 @@ def save_csv(
     trunc: bool = False,
     **kwargs,
 ) -> None:
-    """Save to CSV (reference ``io.py:860``)."""
-    arr = data.numpy()
-    if decimals >= 0:
-        arr = np.round(arr, decimals)
-    header = "\n".join(header_lines) if header_lines else ""
-    np.savetxt(path, arr, delimiter=sep, header=header, comments="")
+    """Save to CSV with a rank-ordered shard stream (reference ``io.py:860``):
+    rows are written shard by shard in global row order — O(shard) host
+    memory. Column-split arrays resplit to rows on-device first (one
+    all_to_all program, no host gather)."""
+    if not isinstance(data, DNDarray):
+        raise TypeError(f"data must be a DNDarray, not {type(data)}")
+    if data.ndim > 1 and data.split not in (None, 0):
+        data = data.resplit(0)
+    with open(path, "w", encoding="utf-8") as handle:
+        if header_lines:
+            handle.write("\n".join(header_lines) + "\n")
+        for _, block in _iter_shard_blocks(data, order=True):
+            if decimals >= 0:
+                block = np.round(block, decimals)
+            np.savetxt(handle, np.atleast_1d(block), delimiter=sep)
 
 
 def load_npy_from_path(path: str, dtype=types.float32, split=0, device=None, comm=None) -> DNDarray:

@@ -59,6 +59,81 @@ class TestIO:
         np.testing.assert_allclose(y.numpy(), np.concatenate([a, b]))
 
 
+class TestParallelSave:
+    """Saves stream per-shard slices — never the gathered global array
+    (reference rank-ordered/mpio writes, ``heat/core/io.py:147-233,487``;
+    round-1/round-2 finding)."""
+
+    def _no_gather(self, monkeypatch):
+        """Make any full-gather during save an error."""
+
+        def boom(self):  # pragma: no cover - the assertion
+            raise AssertionError("save path gathered the global array")
+
+        monkeypatch.setattr(ht.DNDarray, "numpy", boom)
+        monkeypatch.setattr(ht.DNDarray, "_logical", boom)
+
+    @pytest.mark.parametrize("split", [0, 1])
+    def test_hdf5_save_no_gather(self, tmp_path, split, monkeypatch):
+        data = np.random.default_rng(2).random((23, 7)).astype(np.float32)
+        x = ht.array(data, split=split)
+        path = str(tmp_path / "p.h5")
+        self._no_gather(monkeypatch)
+        ht.save_hdf5(x, path, "data")
+        monkeypatch.undo()
+        y = ht.load_hdf5(path, "data")
+        np.testing.assert_allclose(y.numpy(), data, rtol=1e-6)
+
+    def test_csv_save_no_gather_row_split(self, tmp_path, monkeypatch):
+        data = np.random.default_rng(3).random((19, 3)).astype(np.float32)
+        x = ht.array(data, split=0)
+        path = str(tmp_path / "p.csv")
+        self._no_gather(monkeypatch)
+        ht.save_csv(x, path)
+        monkeypatch.undo()
+        y = ht.load_csv(path)
+        np.testing.assert_allclose(y.numpy(), data, rtol=1e-4, atol=1e-5)
+
+    def test_csv_save_column_split_resplits(self, tmp_path):
+        data = np.random.default_rng(4).random((6, 11)).astype(np.float32)
+        path = str(tmp_path / "c.csv")
+        ht.save_csv(ht.array(data, split=1), path)
+        y = ht.load_csv(path)
+        np.testing.assert_allclose(y.numpy(), data, rtol=1e-4, atol=1e-5)
+
+    def test_hdf5_save_1d_uneven(self, tmp_path):
+        data = np.arange(13, dtype=np.float32)  # prime: padded shards
+        path = str(tmp_path / "u.h5")
+        ht.save_hdf5(ht.array(data, split=0), path, "d")
+        np.testing.assert_allclose(ht.load_hdf5(path, "d").numpy(), data)
+
+    def test_hdf5_save_bf16_widens(self, tmp_path):
+        data = np.linspace(0, 1, 16, dtype=np.float32)
+        x = ht.array(data, split=0, dtype=ht.bfloat16)
+        path = str(tmp_path / "b.h5")
+        ht.save_hdf5(x, path, "d")
+        y = ht.load_hdf5(path, "d")
+        np.testing.assert_allclose(y.numpy(), data, atol=1e-2)
+
+    def test_netcdf_save_no_gather(self, tmp_path, monkeypatch):
+        if not ht.io.supports_netcdf():
+            pytest.skip("netCDF4 not available")
+        data = np.random.default_rng(5).random((17, 4)).astype(np.float32)
+        x = ht.array(data, split=0)
+        path = str(tmp_path / "p.nc")
+        self._no_gather(monkeypatch)
+        ht.save_netcdf(x, path, "v")
+        monkeypatch.undo()
+        y = ht.load_netcdf(path, "v")
+        np.testing.assert_allclose(y.numpy(), data, rtol=1e-6)
+
+    def test_save_replicated(self, tmp_path):
+        data = np.arange(20, dtype=np.float32).reshape(4, 5)
+        path = str(tmp_path / "r.h5")
+        ht.save_hdf5(ht.array(data), path, "d")
+        np.testing.assert_allclose(ht.load_hdf5(path, "d").numpy(), data)
+
+
 class TestCommFacade:
     def test_chunk(self):
         comm = ht.get_comm()
