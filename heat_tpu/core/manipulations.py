@@ -130,21 +130,151 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
             out_split = non_none[0] if non_none else None
             break
     dtype = types.result_type(*arrays)
+    comm = arrays[0].comm
+    # distributed path: all inputs split along the concatenation axis — each
+    # input streams through a destination-scatter ring (no all-gather;
+    # reference ``:188`` moves boundary chunks point-to-point)
+    if (
+        out_split == axis
+        and comm.size > 1
+        and all(a.split == axis and a.shape[axis] > 0 for a in arrays)
+    ):
+        from . import _manips
+
+        ns = [a.shape[axis] for a in arrays]
+        n_out = sum(ns)
+        gshape = tuple(
+            n_out if i == axis else s for i, s in enumerate(arrays[0].gshape)
+        )
+        phys = [a.larray.astype(dtype.jax_type()) for a in arrays]
+        c_out = comm.chunk_size(n_out)
+        fn = _manips.ring_concat_fn(
+            [p.shape for p in phys], jnp.dtype(dtype.jax_type()), axis, ns,
+            c_out, comm)
+        out = fn(*phys)
+        return DNDarray(out, gshape, dtype, axis, arrays[0].device, comm)
+    # all inputs share a split on some OTHER axis: the concat axis is
+    # unsharded, so the join is purely shard-local (their split-axis physical
+    # extents coincide — same logical size, same padding)
+    if (
+        out_split is not None
+        and out_split != axis
+        and all(a.split == out_split for a in arrays)
+    ):
+        phys = [a.larray.astype(dtype.jax_type()) for a in arrays]
+        res = jnp.concatenate(phys, axis=axis)
+        gshape = tuple(
+            sum(a.shape[axis] for a in arrays) if i == axis else s
+            for i, s in enumerate(arrays[0].gshape)
+        )
+        return DNDarray(res, gshape, dtype, out_split, arrays[0].device,
+                        arrays[0].comm)
     logicals = [a._logical().astype(dtype.jax_type()) for a in arrays]
     res = jnp.concatenate(logicals, axis=axis)
     return _wrap_logical(res, out_split, arrays[0], dtype=dtype)
 
 
+def _diag_construct_distributed(a: DNDarray, offset: int):
+    """diag(1-D split vector) -> (L, L) row-split matrix, built shard-locally
+    after one ring shift of the vector into the output row chunking
+    (reference ``:512``). Row ``j`` holds ``w[j]`` at column ``j + offset``
+    where ``w`` is the vector zero-extended to length ``L``."""
+    import jax
+    from jax import shard_map
+    from . import factories
+
+    comm = a.comm
+    n = a.shape[0]
+    L = n + abs(offset)
+    if offset > 0:
+        w = concatenate(
+            [a, factories.zeros(offset, dtype=a.dtype, split=0, comm=comm)], 0)
+    elif offset < 0:
+        w = concatenate(
+            [factories.zeros(-offset, dtype=a.dtype, split=0, comm=comm), a], 0)
+    else:
+        w = a
+    c = w.larray.shape[0] // comm.size
+    jdt = w.larray.dtype
+
+    def body(wb):
+        me = jax.lax.axis_index(comm.axis_name)
+        gpos = me * c + jnp.arange(c)
+        col = gpos + offset
+        ok = (gpos < L) & (col >= 0) & (col < L)
+        block = jnp.zeros((c, L), jdt)
+        block = block.at[jnp.arange(c), jnp.clip(col, 0, L - 1)].set(
+            jnp.where(ok, wb, 0))
+        return block
+
+    fn = jax.jit(shard_map(body, mesh=comm.mesh, in_specs=comm.spec(1, 0),
+                           out_specs=comm.spec(2, 0), check_vma=False))
+    return DNDarray(fn(w.larray), (L, L), a.dtype, 0, a.device, comm)
+
+
 def diag(a: DNDarray, offset: int = 0) -> DNDarray:
     """Extract or construct a diagonal (reference ``:512``)."""
     if a.ndim == 1:
+        if a.split == 0 and a.comm.size > 1 and a.shape[0] > 0:
+            return _diag_construct_distributed(a, int(offset))
         res = jnp.diag(a._logical(), k=offset)
         return _wrap_logical(res, 0 if a.split is not None else None, a)
     return diagonal(a, offset=offset)
 
 
+def _diagonal_extract_distributed(a: DNDarray, offset: int):
+    """diagonal of a row-split 2-D matrix: each row's diagonal element is
+    shard-local; the length-``L`` prefix re-chunks through the mask ring."""
+    import jax
+    from jax import shard_map
+
+    comm = a.comm
+    n, m = a.shape
+    L = max(0, min(n, m - offset) if offset >= 0 else min(n + offset, m))
+    if L == 0:
+        return DNDarray.from_logical(
+            jnp.zeros((0,), a.larray.dtype), None, a.device, comm)
+    c = a.larray.shape[0] // comm.size
+
+    def body(ab):
+        me = jax.lax.axis_index(comm.axis_name)
+        gpos = me * c + jnp.arange(c)
+        col = gpos + offset
+        ok = (gpos < n) & (col >= 0) & (col < m)
+        vals = jnp.take_along_axis(
+            ab, jnp.clip(col, 0, m - 1)[:, None], axis=1)[:, 0]
+        return jnp.where(ok, vals, 0)
+
+    fn = jax.jit(shard_map(body, mesh=comm.mesh, in_specs=comm.spec(2, 0),
+                           out_specs=comm.spec(1, 0), check_vma=False))
+    w = DNDarray(fn(a.larray), (n,), a.dtype, 0, a.device, comm)
+    # the diagonal occupies rows [lo, lo + L); re-chunk it into canonical
+    # length-L layout with the mask ring (order preserved)
+    lo = max(0, -offset)
+    if lo == 0 and L == n:
+        return w
+    rows = np.arange(n)
+    return w[(rows >= lo) & (rows < lo + L)]
+
+
 def diagonal(a: DNDarray, offset: int = 0, dim1: int = 0, dim2: int = 1) -> DNDarray:
     """Extract a diagonal (reference ``:587``)."""
+    if (
+        a.ndim == 2
+        and {dim1, dim2} == {0, 1}
+        and a.split is not None
+        and a.comm.size > 1
+        and a.size > 0
+    ):
+        if (dim1, dim2) == (1, 0):
+            from .linalg import transpose
+
+            return diagonal(transpose(a), offset=offset, dim1=0, dim2=1)
+        if a.split == 1:
+            from .linalg import transpose
+
+            return _diagonal_extract_distributed(transpose(a), -int(offset))
+        return _diagonal_extract_distributed(a, int(offset))
     res = jnp.diagonal(a._logical(), offset=offset, axis1=dim1, axis2=dim2)
     out_split = None
     if a.split is not None:
@@ -181,13 +311,21 @@ def expand_dims(a: DNDarray, axis: int) -> DNDarray:
 
 
 def flatten(a: DNDarray) -> DNDarray:
-    """Collapse to 1-D (reference ``:900``)."""
+    """Collapse to 1-D (reference ``:900``). Distributed arrays go through
+    the ring re-chunking reshape (no gather)."""
+    if a.split is not None and a.comm.size > 1 and a.size > 0:
+        return reshape(a, (a.size,), new_split=0)
     res = a._logical().reshape(-1)
     return _wrap_logical(res, 0 if a.split is not None else None, a)
 
 
 def flip(a: DNDarray, axis=None) -> DNDarray:
-    """Reverse element order along axes (reference ``:960``)."""
+    """Reverse element order along axes (reference ``:960``).
+
+    Non-split axes flip shard-locally; the split axis flips through the
+    destination-scatter ring (:mod:`heat_tpu.core._manips`) — pairwise
+    ``ppermute`` only, no all-gather (reference moves whole shards
+    point-to-point)."""
     if axis is None:
         axes = tuple(range(a.ndim))
     else:
@@ -195,6 +333,16 @@ def flip(a: DNDarray, axis=None) -> DNDarray:
             sanitize_axis(a.shape, ax) for ax in axis
         )
     if a.split is not None and a.split in axes:
+        if a.comm.size > 1 and a.shape[a.split] > 0:
+            from . import _manips
+
+            other = tuple(ax for ax in axes if ax != a.split)
+            phys = jnp.flip(a.larray, axis=other) if other else a.larray
+            fn = _manips.ring_flip_fn(
+                phys.shape, jnp.dtype(phys.dtype), a.split,
+                a.shape[a.split], a.comm)
+            return DNDarray(fn(phys), a.gshape, a.dtype, a.split, a.device,
+                            a.comm)
         res = jnp.flip(a._logical(), axis=axes)
         return _wrap_logical(res, a.split, a)
     res = jnp.flip(a.larray, axis=axes)
@@ -245,15 +393,74 @@ def moveaxis(x: DNDarray, source, destination) -> DNDarray:
     return transpose(x, order)
 
 
+def _normalize_pad_width(pad_width, ndim):
+    """NumPy pad_width forms -> ((before, after), ...) per axis, or None."""
+    try:
+        pw = np.asarray(pad_width, dtype=np.int64)
+    except (ValueError, TypeError):
+        return None
+    if pw.ndim == 0:
+        return ((int(pw), int(pw)),) * ndim
+    if pw.shape == (2,):
+        return ((int(pw[0]), int(pw[1])),) * ndim
+    if pw.shape == (1,):
+        return ((int(pw[0]), int(pw[0])),) * ndim
+    if pw.shape == (ndim, 2):
+        return tuple((int(a), int(b)) for a, b in pw)
+    if pw.shape == (1, 2):
+        return ((int(pw[0, 0]), int(pw[0, 1])),) * ndim
+    return None
+
+
 def pad(array: DNDarray, pad_width, mode: str = "constant", constant_values=0) -> DNDarray:
-    """Pad an array (reference ``:1128``)."""
-    # normalize pad_width like numpy
-    res = jnp.pad(
-        array._logical(),
-        pad_width,
-        mode=mode,
-        **({"constant_values": constant_values} if mode == "constant" else {}),
-    )
+    """Pad an array (reference ``:1128``).
+
+    Pads that leave the split axis untouched apply shard-locally; a padded
+    split axis grows through a ring concatenation with constant blocks
+    (constant mode) — no logical materialization either way."""
+    kw = {"constant_values": constant_values} if mode == "constant" else {}
+    pw = _normalize_pad_width(pad_width, array.ndim)
+    if (
+        pw is not None
+        and array.split is not None
+        and array.comm.size > 1
+        and array.size > 0
+        and (mode != "constant" or np.ndim(constant_values) == 0)
+    ):
+        split = array.split
+        before, after = pw[split]
+        other = tuple((0, 0) if i == split else p for i, p in enumerate(pw))
+        phys = array.larray
+        if any(p != (0, 0) for p in other):
+            phys = jnp.pad(phys, other, mode=mode, **kw)
+        gshape = tuple(
+            s + (0 if i == split else pw[i][0] + pw[i][1])
+            for i, s in enumerate(array.gshape)
+        )
+        out = DNDarray(phys, gshape, array.dtype, split, array.device,
+                       array.comm)
+        if before == 0 and after == 0:
+            return out
+        if mode == "constant":
+            from . import factories
+
+            parts = []
+            if before:
+                shp = tuple(before if i == split else s
+                            for i, s in enumerate(gshape))
+                parts.append(factories.full(
+                    shp, constant_values, dtype=array.dtype, split=split,
+                    comm=array.comm))
+            parts.append(out)
+            if after:
+                shp = tuple(after if i == split else s
+                            for i, s in enumerate(gshape))
+                parts.append(factories.full(
+                    shp, constant_values, dtype=array.dtype, split=split,
+                    comm=array.comm))
+            return concatenate(parts, axis=split)
+        # non-constant modes on the split axis need neighbor data: fall back
+    res = jnp.pad(array._logical(), pad_width, mode=mode, **kw)
     return _wrap_logical(res, array.split, array)
 
 
@@ -273,7 +480,38 @@ def redistribute(arr: DNDarray, lshape_map=None, target_map=None) -> DNDarray:
 
 
 def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
-    """Repeat elements (reference ``:1770``)."""
+    """Repeat elements (reference ``:1770``).
+
+    Scalar repeats on a distributed array stay gather-free: along the split
+    axis every row fans out through a destination-scatter ring
+    (:mod:`heat_tpu.core._manips`); along other axes the repeat is
+    shard-local; ``axis=None`` flattens first (ring reshape). Array-valued
+    ``repeats`` produce data-dependent shapes and use the logical path."""
+    scalar_rep = isinstance(repeats, (int, np.integer)) and not isinstance(
+        repeats, bool)
+    if scalar_rep and repeats > 0 and a.split is not None \
+            and a.comm.size > 1 and a.size > 0:
+        if axis is None:
+            flat = a if a.ndim == 1 and a.split == 0 else flatten(a)
+            return repeat(flat, repeats, 0)
+        axis = sanitize_axis(a.shape, axis)
+        if axis != a.split:
+            res = jnp.repeat(a.larray, repeats, axis=axis)
+            gshape = tuple(
+                s * repeats if i == axis else s for i, s in enumerate(a.gshape)
+            )
+            return DNDarray(res, gshape, a.dtype, a.split, a.device, a.comm)
+        from . import _manips
+
+        n = a.shape[axis]
+        comm = a.comm
+        c_out = comm.chunk_size(n * repeats)
+        fn = _manips.ring_repeat_fn(
+            a.larray.shape, jnp.dtype(a.larray.dtype), axis, n, int(repeats),
+            c_out, comm)
+        gshape = tuple(
+            s * repeats if i == axis else s for i, s in enumerate(a.gshape))
+        return DNDarray(fn(a.larray), gshape, a.dtype, axis, a.device, comm)
     if isinstance(repeats, DNDarray):
         repeats = repeats._logical()
     res = jnp.repeat(a._logical(), repeats, axis=axis)
@@ -301,6 +539,41 @@ def reshape(a: DNDarray, *shape, new_split=None, **kwargs) -> DNDarray:
         raise ValueError(f"cannot reshape array of size {a.size} into shape {shape}")
     if new_split is None:
         new_split = a.split if a.split is None else builtins_min(a.split, len(shape) - 1)
+    if (
+        a.split is not None
+        and a.comm.size > 1
+        and a.size > 0
+        and len(shape) > 0
+        and a.ndim > 0
+    ):
+        # distributed re-chunking of the row-major flat sequence (reference's
+        # Alltoallv formulation): resplit to rows, ring-exchange flat ranges,
+        # resplit to the target split — never materializes the logical array
+        from . import _manips
+
+        src = a if a.split == 0 else a.resplit(0)
+        c_out = a.comm.chunk_size(shape[0])
+        r_in = int(np.prod(src.larray.shape[1:], dtype=np.int64))
+        r_out = int(np.prod(shape[1:], dtype=np.int64))
+        c_in = src.larray.shape[0] // a.comm.size
+        if c_in * r_in == c_out * r_out:
+            # per-device flat ranges coincide (e.g. flatten of split=0, or
+            # folding trailing dims): the reshape is purely shard-local — no
+            # ring needed (review finding: the ring wasted (p-1)x shard
+            # traffic here). Pin the output sharding so XLA keeps it local.
+            new_phys = (c_out * a.comm.size,) + tuple(shape[1:])
+            phys = jax.jit(
+                lambda t: t.reshape(new_phys),
+                out_shardings=a.comm.sharding(len(shape), 0))(src.larray)
+            res = DNDarray(phys, shape, a.dtype, 0, a.device, a.comm)
+        else:
+            fn = _manips.ring_reshape_fn(
+                src.larray.shape, jnp.dtype(src.larray.dtype), shape, c_out,
+                a.comm)
+            res = DNDarray(fn(src.larray), shape, a.dtype, 0, a.device, a.comm)
+        if new_split != 0:
+            res = res.resplit(new_split)
+        return res
     res = a._logical().reshape(shape)
     return _wrap_logical(res, new_split, a)
 
@@ -315,18 +588,43 @@ def resplit(arr: DNDarray, axis=None) -> DNDarray:
 
 
 def roll(x: DNDarray, shift, axis=None) -> DNDarray:
-    """Circular shift (reference ``:1985``)."""
+    """Circular shift (reference ``:1985``).
+
+    Non-split axes roll shard-locally; the split axis rolls through the
+    destination-scatter ring (:mod:`heat_tpu.core._manips`) — the
+    static-shape rendering of the reference's rank-to-rank shard rotation.
+    """
     if axis is None:
+        if x.ndim == 1 and x.split == 0:
+            total = sum(shift) if isinstance(shift, (tuple, list)) else shift
+            return roll(x, total, 0)
         res = jnp.roll(x._logical().reshape(-1), shift).reshape(x.shape)
         return _wrap_logical(res, x.split, x)
-    if x.split is not None and (
-        axis == x.split
-        or (isinstance(axis, (tuple, list)) and sanitize_axis(x.shape, x.split) in
-            tuple(sanitize_axis(x.shape, ax) for ax in axis))
-    ):
-        res = jnp.roll(x._logical(), shift, axis)
+    axes = ((int(axis),) if isinstance(axis, (int, np.integer))
+            else tuple(int(ax) for ax in axis))
+    shifts = ((int(shift),) * len(axes) if isinstance(shift, (int, np.integer))
+              else tuple(int(s) for s in shift))
+    if len(shifts) != len(axes):
+        raise ValueError("shift and axis must have the same length")
+    axes = tuple(sanitize_axis(x.shape, ax) for ax in axes)
+    if x.split is not None and x.split in axes:
+        if x.comm.size > 1 and x.shape[x.split] > 0:
+            from . import _manips
+
+            split_shift = sum(s for s, ax in zip(shifts, axes) if ax == x.split)
+            other = [(s, ax) for s, ax in zip(shifts, axes) if ax != x.split]
+            phys = x.larray
+            if other:
+                phys = jnp.roll(phys, [s for s, _ in other],
+                                [ax for _, ax in other])
+            fn = _manips.ring_roll_fn(
+                phys.shape, jnp.dtype(phys.dtype), x.split,
+                x.shape[x.split], split_shift, x.comm)
+            return DNDarray(fn(phys), x.gshape, x.dtype, x.split, x.device,
+                            x.comm)
+        res = jnp.roll(x._logical(), shifts, axes)
         return _wrap_logical(res, x.split, x)
-    res = jnp.roll(x.larray, shift, axis)
+    res = jnp.roll(x.larray, shifts, axes)
     return DNDarray(res, x.gshape, x.dtype, x.split, x.device, x.comm)
 
 
@@ -480,10 +778,35 @@ def swapaxes(x: DNDarray, axis1: int, axis2: int) -> DNDarray:
     return transpose(x, axes)
 
 
+def _tile_distributed(x: DNDarray, reps) -> Optional[DNDarray]:
+    """Gather-free tile when no new leading dims appear: non-split axes tile
+    shard-locally, the split axis tiles as a ring concatenation of ``r``
+    copies (reference ``tile``, ``manipulations.py:3574``)."""
+    reps = (reps,) if isinstance(reps, (int, np.integer)) else tuple(reps)
+    if x.split is None or x.comm.size <= 1 or x.size == 0 or \
+            len(reps) > x.ndim or any(int(r) <= 0 for r in reps):
+        return None
+    reps = (1,) * (x.ndim - len(reps)) + tuple(int(r) for r in reps)
+    split = x.split
+    r_split = reps[split]
+    other = tuple(1 if i == split else r for i, r in enumerate(reps))
+    phys = jnp.tile(x.larray, other) if any(r != 1 for r in other) else x.larray
+    gshape = tuple(s * other[i] for i, s in enumerate(x.gshape))
+    base = DNDarray(phys, gshape, x.dtype, split, x.device, x.comm)
+    if r_split == 1:
+        return base
+    return concatenate([base] * r_split, axis=split)
+
+
 def tile(x: DNDarray, reps) -> DNDarray:
-    """Tile an array (reference ``:3574``)."""
+    """Tile an array (reference ``:3574``). Same-rank tilings of distributed
+    arrays run shard-local + ring concat (:func:`_tile_distributed`);
+    rank-raising tilings (new leading dims) use the logical path."""
     if isinstance(reps, DNDarray):
         reps = reps.numpy().tolist()
+    dist = _tile_distributed(x, reps)
+    if dist is not None:
+        return dist
     res = jnp.tile(x._logical(), reps)
     out_split = x.split
     if out_split is not None:
@@ -493,8 +816,29 @@ def tile(x: DNDarray, reps) -> DNDarray:
 
 def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool = True, out=None):
     """Top-k values and indices (reference ``:3830``; custom MPI op
-    ``mpi_topk`` ``:3971`` becomes ``lax.top_k``)."""
+    ``mpi_topk`` ``:3971``).
+
+    Along a split axis this is the reference's tournament, XLA-style: local
+    ``lax.top_k`` per shard, an all-gather of the ``p*k`` candidates (O(p k)
+    bytes, never the data), and a final ``top_k``
+    (:func:`heat_tpu.core._manips.split_topk_fn`)."""
     dim = sanitize_axis(a.shape, dim)
+    if a.split == dim and a.comm.size > 1 and 0 < k <= a.shape[dim]:
+        from . import _manips
+
+        fn = _manips.split_topk_fn(
+            a.larray.shape, jnp.dtype(a.larray.dtype), dim, a.shape[dim],
+            int(k), bool(largest), a.comm)
+        vals_rep, idx_rep = fn(a.larray)
+        vals = jnp.moveaxis(vals_rep, -1, dim)
+        idx = jnp.moveaxis(idx_rep, -1, dim)
+        vals_d = _wrap_logical(vals, a.split, a)
+        idx_d = _wrap_logical(idx, a.split, a)
+        if out is not None:
+            out[0].larray = vals_d.larray
+            out[1].larray = idx_d.larray
+            return out
+        return vals_d, idx_d
     if a.split == dim:
         logical = a._logical()
     else:

@@ -1,0 +1,350 @@
+"""Distributed split-axis manipulations (``heat_tpu/core/_manips.py``).
+
+Round-2 VERDICT #4: concatenate/reshape/roll/flip on a split axis must not
+gather — the compiled programs may use pairwise collective-permute only
+(same assertion style as ``test_sort_distributed.py``). Reference behavior:
+``heat/core/manipulations.py:188`` (concatenate), ``:1817`` (reshape),
+``:1985`` (roll), ``:1343`` (flip).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core import _manips
+
+from utils import assert_array_equal
+
+
+rng = np.random.default_rng(13)
+
+
+class TestRoll:
+    @pytest.mark.parametrize("shift", [0, 1, -1, 5, -7, 23, 100])
+    def test_roll_1d(self, shift):
+        a = rng.standard_normal(23).astype(np.float32)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.roll(x, shift, 0), np.roll(a, shift, 0), rtol=0)
+
+    def test_roll_2d_split_axis(self):
+        a = rng.standard_normal((19, 4)).astype(np.float32)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.roll(x, 7, 0), np.roll(a, 7, 0), rtol=0)
+
+    def test_roll_both_axes(self):
+        a = rng.standard_normal((11, 6)).astype(np.float32)
+        x = ht.array(a, split=0)
+        out = ht.roll(x, (3, 2), (0, 1))
+        assert_array_equal(out, np.roll(a, (3, 2), (0, 1)), rtol=0)
+        assert out.split == 0
+
+    def test_roll_nonsplit_axis_local(self):
+        a = rng.standard_normal((9, 8)).astype(np.float32)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.roll(x, 3, 1), np.roll(a, 3, 1), rtol=0)
+
+    def test_roll_flat_1d_split(self):
+        a = rng.standard_normal(17).astype(np.float32)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.roll(x, 5), np.roll(a, 5), rtol=0)
+
+    def test_roll_repeated_split_axis(self):
+        a = rng.standard_normal(15).astype(np.float32)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.roll(x, (2, 3), (0, 0)),
+                           np.roll(a, (2, 3), (0, 0)), rtol=0)
+
+
+class TestFlip:
+    @pytest.mark.parametrize("n", [5, 16, 31])
+    def test_flip_1d(self, n):
+        a = rng.standard_normal(n).astype(np.float32)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.flip(x, 0), np.flip(a, 0), rtol=0)
+
+    def test_flip_all_axes_2d(self):
+        a = rng.standard_normal((13, 5)).astype(np.float32)
+        x = ht.array(a, split=0)
+        out = ht.flip(x)
+        assert_array_equal(out, np.flip(a), rtol=0)
+        assert out.split == 0
+
+    def test_flipud_fliplr(self):
+        a = rng.standard_normal((10, 7)).astype(np.float32)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.flipud(x), np.flipud(a), rtol=0)
+        assert_array_equal(ht.fliplr(x), np.fliplr(a), rtol=0)
+
+    def test_flip_split1(self):
+        a = rng.standard_normal((4, 21)).astype(np.float32)
+        x = ht.array(a, split=1)
+        assert_array_equal(ht.flip(x, 1), np.flip(a, 1), rtol=0)
+
+
+class TestConcatenate:
+    def test_concat_split_axis_1d(self):
+        a = rng.standard_normal(13).astype(np.float32)
+        b = rng.standard_normal(9).astype(np.float32)
+        x = ht.concatenate([ht.array(a, split=0), ht.array(b, split=0)], 0)
+        assert_array_equal(x, np.concatenate([a, b]), rtol=0)
+        assert x.split == 0
+
+    def test_concat_split_axis_2d(self):
+        a = rng.standard_normal((7, 3)).astype(np.float32)
+        b = rng.standard_normal((12, 3)).astype(np.float32)
+        c = rng.standard_normal((2, 3)).astype(np.float32)
+        arrays = [ht.array(v, split=0) for v in (a, b, c)]
+        out = ht.concatenate(arrays, 0)
+        assert_array_equal(out, np.concatenate([a, b, c]), rtol=0)
+
+    def test_concat_axis1_split1(self):
+        a = rng.standard_normal((3, 11)).astype(np.float32)
+        b = rng.standard_normal((3, 6)).astype(np.float32)
+        out = ht.concatenate([ht.array(a, split=1), ht.array(b, split=1)], 1)
+        assert_array_equal(out, np.concatenate([a, b], 1), rtol=0)
+        assert out.split == 1
+
+    def test_concat_dtype_promotion(self):
+        a = np.arange(5, dtype=np.int32)
+        b = np.linspace(0, 1, 7, dtype=np.float32)
+        out = ht.concatenate([ht.array(a, split=0), ht.array(b, split=0)], 0)
+        assert out.dtype == ht.float32
+        assert_array_equal(out, np.concatenate([a.astype(np.float32), b]),
+                           rtol=0)
+
+
+class TestReshape:
+    @pytest.mark.parametrize("shape_in,shape_out", [
+        ((24,), (4, 6)), ((4, 6), (24,)), ((6, 4), (8, 3)),
+        ((3, 5, 4), (15, 4)), ((30,), (2, 3, 5)), ((13, 2), (26,)),
+    ])
+    def test_reshape_split0(self, shape_in, shape_out):
+        a = rng.standard_normal(shape_in).astype(np.float32)
+        x = ht.array(a, split=0)
+        out = ht.reshape(x, shape_out)
+        assert_array_equal(out, a.reshape(shape_out), rtol=0)
+        assert out.split == 0
+
+    def test_reshape_split1_resplits(self):
+        a = rng.standard_normal((4, 18)).astype(np.float32)
+        x = ht.array(a, split=1)
+        out = ht.reshape(x, (8, 9), new_split=1)
+        assert_array_equal(out, a.reshape(8, 9), rtol=0)
+        assert out.split == 1
+
+    def test_reshape_minus_one(self):
+        a = rng.standard_normal((12, 5)).astype(np.float32)
+        x = ht.array(a, split=0)
+        out = ht.reshape(x, (-1,))
+        assert_array_equal(out, a.reshape(-1), rtol=0)
+
+    def test_flatten_ravel(self):
+        a = rng.standard_normal((9, 4)).astype(np.float32)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.flatten(x), a.reshape(-1), rtol=0)
+        assert_array_equal(ht.ravel(x), a.reshape(-1), rtol=0)
+
+
+class TestRepeatTile:
+    def test_repeat_split_axis(self):
+        a = rng.standard_normal(11).astype(np.float32)
+        x = ht.array(a, split=0)
+        for r in (1, 2, 3):
+            out = ht.repeat(x, r, 0)
+            assert_array_equal(out, np.repeat(a, r, 0), rtol=0)
+            assert out.split == 0
+
+    def test_repeat_2d_split_axis(self):
+        a = rng.standard_normal((9, 3)).astype(np.float32)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.repeat(x, 2, 0), np.repeat(a, 2, 0), rtol=0)
+
+    def test_repeat_nonsplit_axis_local(self):
+        a = rng.standard_normal((9, 3)).astype(np.float32)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.repeat(x, 3, 1), np.repeat(a, 3, 1), rtol=0)
+
+    def test_repeat_flat(self):
+        a = rng.standard_normal((5, 4)).astype(np.float32)
+        x = ht.array(a, split=0)
+        out = ht.repeat(x, 2)
+        assert_array_equal(out, np.repeat(a, 2), rtol=0)
+        assert out.split == 0
+
+    def test_repeat_array_repeats_fallback(self):
+        a = np.arange(6, dtype=np.float32)
+        x = ht.array(a, split=0)
+        reps = np.array([1, 2, 0, 3, 1, 1])
+        assert_array_equal(ht.repeat(x, reps, 0), np.repeat(a, reps, 0),
+                           rtol=0)
+
+    def test_tile_split_axis(self):
+        a = rng.standard_normal((7, 3)).astype(np.float32)
+        x = ht.array(a, split=0)
+        out = ht.tile(x, (3, 2))
+        assert_array_equal(out, np.tile(a, (3, 2)), rtol=0)
+        assert out.split == 0
+
+    def test_tile_1d(self):
+        a = rng.standard_normal(13).astype(np.float32)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.tile(x, 4), np.tile(a, 4), rtol=0)
+
+    def test_tile_rank_raising_fallback(self):
+        a = rng.standard_normal(6).astype(np.float32)
+        x = ht.array(a, split=0)
+        out = ht.tile(x, (2, 3))
+        assert_array_equal(out, np.tile(a, (2, 3)), rtol=0)
+
+
+class TestDiagPad:
+    @pytest.mark.parametrize("offset", [0, 1, -2, 5, -7])
+    def test_diag_construct(self, offset):
+        a = rng.standard_normal(13).astype(np.float32)
+        x = ht.array(a, split=0)
+        out = ht.diag(x, offset)
+        assert_array_equal(out, np.diag(a, offset), rtol=0)
+        assert out.split == 0
+
+    @pytest.mark.parametrize("offset", [0, 2, -3])
+    @pytest.mark.parametrize("split", [0, 1])
+    def test_diagonal_extract(self, offset, split):
+        a = rng.standard_normal((11, 14)).astype(np.float32)
+        x = ht.array(a, split=split)
+        out = ht.diagonal(x, offset)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.diagonal(a, offset), rtol=0)
+
+    def test_diagonal_swapped_dims(self):
+        a = rng.standard_normal((9, 9)).astype(np.float32)
+        x = ht.array(a, split=0)
+        np.testing.assert_allclose(
+            np.asarray(ht.diagonal(x, 1, dim1=1, dim2=0).numpy()),
+            np.diagonal(a, 1, axis1=1, axis2=0), rtol=0)
+
+    def test_pad_nonsplit_local(self):
+        a = rng.standard_normal((10, 4)).astype(np.float32)
+        x = ht.array(a, split=0)
+        out = ht.pad(x, ((0, 0), (2, 1)))
+        assert_array_equal(out, np.pad(a, ((0, 0), (2, 1))), rtol=0)
+        assert out.split == 0
+
+    def test_pad_split_axis_constant(self):
+        a = rng.standard_normal((7, 3)).astype(np.float32)
+        x = ht.array(a, split=0)
+        out = ht.pad(x, ((2, 3), (0, 0)), constant_values=5.0)
+        assert_array_equal(out, np.pad(a, ((2, 3), (0, 0)),
+                                       constant_values=5.0), rtol=0)
+
+    def test_pad_scalar_width(self):
+        a = rng.standard_normal((6, 4)).astype(np.float32)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.pad(x, 1), np.pad(a, 1), rtol=0)
+
+    def test_pad_reflect_nonsplit(self):
+        a = rng.standard_normal((8, 5)).astype(np.float32)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.pad(x, ((0, 0), (2, 2)), mode="reflect"),
+                           np.pad(a, ((0, 0), (2, 2)), mode="reflect"),
+                           rtol=0)
+
+    def test_concat_nonsplit_axis_local(self):
+        a = rng.standard_normal((9, 3)).astype(np.float32)
+        b = rng.standard_normal((9, 5)).astype(np.float32)
+        out = ht.concatenate([ht.array(a, split=0), ht.array(b, split=0)], 1)
+        assert_array_equal(out, np.concatenate([a, b], 1), rtol=0)
+        assert out.split == 0
+
+    def test_stack_split_arrays(self):
+        a = rng.standard_normal((9, 3)).astype(np.float32)
+        b = rng.standard_normal((9, 3)).astype(np.float32)
+        out = ht.stack([ht.array(a, split=0), ht.array(b, split=0)], axis=1)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.stack([a, b], 1), rtol=0)
+
+
+class TestSplitTopk:
+    """topk along the split axis: the reference's ``mpi_topk`` tournament as
+    local top_k + O(p*k) candidate gather + final top_k."""
+
+    @pytest.mark.parametrize("largest", [True, False])
+    @pytest.mark.parametrize("k", [1, 3, 10])
+    def test_topk_1d(self, k, largest):
+        a = rng.permutation(37).astype(np.float32)
+        x = ht.array(a, split=0)
+        v, i = ht.topk(x, k, largest=largest)
+        want = np.sort(a)[-k:][::-1] if largest else np.sort(a)[:k]
+        np.testing.assert_allclose(np.asarray(v.numpy()), want)
+        np.testing.assert_allclose(a[np.asarray(i.numpy())], want)
+
+    def test_topk_k_larger_than_chunk(self):
+        # k > per-device chunk: local candidates cap at the chunk size
+        a = rng.permutation(17).astype(np.float32)
+        x = ht.array(a, split=0)
+        v, i = ht.topk(x, 12)
+        np.testing.assert_allclose(np.asarray(v.numpy()),
+                                   np.sort(a)[-12:][::-1])
+
+    def test_topk_2d_split_axis(self):
+        a = rng.standard_normal((5, 21)).astype(np.float32)
+        x = ht.array(a, split=1)
+        v, i = ht.topk(x, 4, dim=1)
+        want = -np.sort(-a, axis=1)[:, :4]
+        np.testing.assert_allclose(np.asarray(v.numpy()), want, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.take_along_axis(a, np.asarray(i.numpy()), 1), want, rtol=1e-6)
+
+    def test_topk_int_smallest(self):
+        a = rng.permutation(29).astype(np.int32)
+        x = ht.array(a, split=0)
+        v, i = ht.topk(x, 5, largest=False)
+        np.testing.assert_array_equal(np.asarray(v.numpy()), np.sort(a)[:5])
+
+
+class TestNoAllGather:
+    """The compiled ring programs must contain no all-gather."""
+
+    def _assert_hlo(self, fn, *args):
+        hlo = fn.lower(*args).compile().as_text()
+        assert "all-gather" not in hlo
+        assert "collective-permute" in hlo
+
+    def test_roll_hlo(self):
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs a multi-device mesh")
+        x = ht.array(rng.standard_normal(37).astype(np.float32), split=0)
+        fn = _manips.ring_roll_fn(x.larray.shape, jnp.dtype(jnp.float32), 0,
+                                  37, 5, comm)
+        self._assert_hlo(fn, x.larray)
+
+    def test_flip_hlo(self):
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs a multi-device mesh")
+        x = ht.array(rng.standard_normal(37).astype(np.float32), split=0)
+        fn = _manips.ring_flip_fn(x.larray.shape, jnp.dtype(jnp.float32), 0,
+                                  37, comm)
+        self._assert_hlo(fn, x.larray)
+
+    def test_concat_hlo(self):
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs a multi-device mesh")
+        a = ht.array(rng.standard_normal(13).astype(np.float32), split=0)
+        b = ht.array(rng.standard_normal(9).astype(np.float32), split=0)
+        fn = _manips.ring_concat_fn(
+            [a.larray.shape, b.larray.shape], jnp.dtype(jnp.float32), 0,
+            [13, 9], comm.chunk_size(22), comm)
+        self._assert_hlo(fn, a.larray, b.larray)
+
+    def test_reshape_hlo(self):
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs a multi-device mesh")
+        x = ht.array(rng.standard_normal((24,)).astype(np.float32), split=0)
+        fn = _manips.ring_reshape_fn(x.larray.shape, jnp.dtype(jnp.float32),
+                                     (4, 6), comm.chunk_size(4), comm)
+        self._assert_hlo(fn, x.larray)
