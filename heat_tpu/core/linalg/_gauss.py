@@ -1,0 +1,99 @@
+"""Distributed Gauss-Jordan inverse and determinant.
+
+TPU-native counterpart of the reference's distributed Gauss-Jordan
+(``heat/core/linalg/basics.py:312`` inv, ``:160`` det, both row-wise loops
+of Bcast + local elimination). One jitted shard_map program over the
+row-split augmented matrix ``[A | I]``: a ``lax.fori_loop`` over the ``n``
+pivot columns where each step
+
+1. finds the global partial pivot with two scalar ``pmax`` reductions,
+2. broadcasts the pivot row and row ``k`` with two masked ``psum``s
+   (O(n) floats each — the reference's ``Bcast`` of the pivot row),
+3. swaps, normalizes, and eliminates locally (VPU row ops).
+
+O(n^2 / p) memory per device — a matrix larger than one device's HBM
+inverts without ever being materialized — and O(n^2) total communication,
+matching the reference's algorithm. Determinant falls out of the same
+elimination as ``sign * prod(pivots)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+
+from .._sort import _index_dtype
+
+__all__ = ["gauss_jordan_fn"]
+
+_GJ_CACHE: dict = {}
+
+
+def gauss_jordan_fn(phys_shape, jdt, n: int, comm):
+    """Jitted ``A_physical(split=0) -> (inv_physical(split=0), det)``.
+
+    Singular inputs produce inf/nan (the IEEE outcome of a zero pivot),
+    mirroring ``jnp.linalg.inv``'s non-raising semantics under jit.
+    """
+    key = ("gj", tuple(phys_shape), str(jdt), n, comm.cache_key)
+    fn = _GJ_CACHE.get(key)
+    if fn is not None:
+        return fn
+    p = comm.size
+    c = phys_shape[0] // p
+    idt = _index_dtype()
+    rdt = jnp.finfo(jdt).dtype if jnp.issubdtype(jdt, jnp.complexfloating) \
+        else jdt
+
+    def body(ab):
+        me = jax.lax.axis_index(comm.axis_name)
+        gpos = me * c + jnp.arange(c, dtype=idt)
+        eye = (gpos[:, None] == jnp.arange(n, dtype=idt)[None, :]).astype(jdt)
+        mat = jnp.concatenate([ab, eye], axis=1)  # (c, 2n)
+
+        def step(k, carry):
+            mat, det, sign = carry
+            col = jax.lax.dynamic_slice_in_dim(mat, k, 1, axis=1)[:, 0]
+            valid = (gpos >= k) & (gpos < n)
+            cand = jnp.where(valid, jnp.abs(col).astype(rdt),
+                             jnp.asarray(-jnp.inf, rdt))
+            loc_i = jnp.argmax(cand)
+            loc_v = cand[loc_i]
+            loc_g = gpos[loc_i]
+            gmax = jax.lax.pmax(loc_v, comm.axis_name)
+            piv_g = jax.lax.pmax(
+                jnp.where(loc_v == gmax, loc_g, jnp.asarray(-1, idt)),
+                comm.axis_name)
+            prow = jax.lax.psum(
+                jnp.where((gpos == piv_g)[:, None], mat, 0).sum(0),
+                comm.axis_name)
+            krow = jax.lax.psum(
+                jnp.where((gpos == k)[:, None], mat, 0).sum(0),
+                comm.axis_name)
+            # swap rows k and piv_g (no-op when they coincide)
+            mat = jnp.where((gpos == k)[:, None], prow[None, :], mat)
+            mat = jnp.where((gpos == piv_g)[:, None] & (piv_g != k),
+                            krow[None, :], mat)
+            piv = prow[k]
+            det = det * piv
+            sign = jnp.where(piv_g != k, -sign, sign)
+            prow_n = prow / piv
+            colk = jax.lax.dynamic_slice_in_dim(mat, k, 1, axis=1)[:, 0]
+            is_k = (gpos == k)[:, None]
+            mat = jnp.where(is_k, prow_n[None, :],
+                            mat - colk[:, None] * prow_n[None, :])
+            return mat, det, sign
+
+        mat, det, sign = jax.lax.fori_loop(
+            0, n, step,
+            (mat, jnp.ones((), jdt), jnp.ones((), jdt)))
+        return mat[:, n:], det * sign
+
+    spec = comm.spec(2, 0)
+    fn = jax.jit(
+        shard_map(body, mesh=comm.mesh, in_specs=spec,
+                  out_specs=(spec, comm.spec(0, None)), check_vma=False)
+    )
+    _GJ_CACHE[key] = fn
+    return fn

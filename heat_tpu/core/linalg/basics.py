@@ -134,11 +134,38 @@ def cross(a: DNDarray, b: DNDarray, axisa: int = -1, axisb: int = -1, axisc: int
     return DNDarray.from_logical(res, a.split, a.device, a.comm)
 
 
+def _gauss_jordan_path(a: DNDarray):
+    """The distributed Gauss-Jordan program for ``a`` when applicable
+    (2-D float/complex split matrix on a real mesh), else None. ``split=1``
+    routes through the transpose identities ``inv(A) = inv(A^T)^T`` and
+    ``det(A) = det(A^T)`` — transpose is a local permute + split remap."""
+    if (
+        a.ndim != 2
+        or a.split is None
+        or a.comm.size == 1
+        or a.shape[0] == 0
+        or not jnp.issubdtype(a.larray.dtype, jnp.inexact)
+    ):
+        return None
+    from ._gauss import gauss_jordan_fn
+
+    src = transpose(a) if a.split == 1 else a
+    return gauss_jordan_fn(
+        src.larray.shape, jnp.dtype(src.larray.dtype), src.shape[0], src.comm
+    ), src
+
+
 def det(a: DNDarray) -> DNDarray:
-    """Determinant (reference ``basics.py:160``, distributed Gauss-Jordan
-    there; XLA's fused LU on the gathered operand here — square matrices
-    that fit one chip, which covers the reference's practical envelope)."""
+    """Determinant (reference ``basics.py:160``): split matrices run the
+    distributed Gauss-Jordan elimination (``sign * prod(pivots)`` of the
+    same one-program loop as :func:`inv`, :mod:`._gauss`); replicated ones
+    use XLA's fused LU."""
     _square_check(a)
+    gj = _gauss_jordan_path(a)
+    if gj is not None:
+        fn, src = gj
+        _, d = fn(src.larray)
+        return DNDarray.from_logical(d, None, a.device, a.comm, dtype=a.dtype)
     res = jnp.linalg.det(a._logical())
     return DNDarray.from_logical(res, None, a.device, a.comm)
 
@@ -167,8 +194,17 @@ def dot(a: DNDarray, b: DNDarray, out=None) -> DNDarray:
 
 
 def inv(a: DNDarray) -> DNDarray:
-    """Matrix inverse (reference ``basics.py:312``)."""
+    """Matrix inverse (reference ``basics.py:312``): split matrices run the
+    distributed Gauss-Jordan over the row-split augmented ``[A | I]``
+    (:mod:`._gauss`) — O(n^2/p) memory per device, the matrix is never
+    materialized on one device. Replicated matrices use XLA's fused LU."""
     _square_check(a)
+    gj = _gauss_jordan_path(a)
+    if gj is not None:
+        fn, src = gj
+        invp, _ = fn(src.larray)
+        out = DNDarray(invp, src.gshape, src.dtype, 0, a.device, a.comm)
+        return transpose(out) if a.split == 1 else out
     res = jnp.linalg.inv(a._logical())
     return DNDarray.from_logical(res, a.split, a.device, a.comm)
 

@@ -1,0 +1,105 @@
+"""Distributed Gauss-Jordan inverse/determinant
+(``heat_tpu/core/linalg/_gauss.py``; reference
+``heat/core/linalg/basics.py:312`` inv, ``:160`` det — round-2 VERDICT #7:
+inv/det of a split matrix must not gather it)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core.linalg._gauss import gauss_jordan_fn
+
+from utils import assert_array_equal
+
+
+rng = np.random.default_rng(17)
+
+
+def _well_conditioned(n, dtype=np.float32):
+    a = rng.standard_normal((n, n))
+    a = a + n * np.eye(n)  # diagonally dominant: safe condition number
+    return a.astype(dtype)
+
+
+class TestInv:
+    @pytest.mark.parametrize("n", [3, 8, 13, 29])
+    def test_inv_split0(self, n):
+        a = _well_conditioned(n)
+        x = ht.array(a, split=0)
+        out = ht.linalg.inv(x)
+        assert out.split == 0
+        assert_array_equal(out, np.linalg.inv(a.astype(np.float64)),
+                           rtol=1e-3, atol=1e-4)
+
+    def test_inv_split1(self):
+        a = _well_conditioned(11)
+        x = ht.array(a, split=1)
+        out = ht.linalg.inv(x)
+        assert_array_equal(out, np.linalg.inv(a.astype(np.float64)),
+                           rtol=1e-3, atol=1e-4)
+
+    def test_inv_identity_roundtrip(self):
+        a = _well_conditioned(17)
+        x = ht.array(a, split=0)
+        prod = ht.matmul(ht.linalg.inv(x), x)
+        assert_array_equal(prod, np.eye(17), rtol=0, atol=1e-3)
+
+    def test_inv_needs_pivoting(self):
+        # zero on the diagonal: partial pivoting is exercised
+        a = np.array([[0.0, 2.0, 1.0],
+                      [1.0, 0.0, 3.0],
+                      [2.0, 1.0, 0.0]], np.float32)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.linalg.inv(x), np.linalg.inv(a.astype(np.float64)),
+                           rtol=1e-4, atol=1e-5)
+
+    def test_inv_float64(self):
+        a = _well_conditioned(9, np.float64)
+        x = ht.array(a, split=0)
+        assert_array_equal(ht.linalg.inv(x), np.linalg.inv(a),
+                           rtol=1e-10, atol=1e-12)
+
+    def test_inv_replicated_unchanged(self):
+        a = _well_conditioned(6)
+        x = ht.array(a)
+        assert_array_equal(ht.linalg.inv(x), np.linalg.inv(a.astype(np.float64)),
+                          rtol=1e-3, atol=1e-4)
+
+
+class TestDet:
+    @pytest.mark.parametrize("n", [2, 7, 16])
+    def test_det_split0(self, n):
+        a = _well_conditioned(n, np.float64)
+        x = ht.array(a, split=0)
+        d = ht.linalg.det(x)
+        np.testing.assert_allclose(float(d), np.linalg.det(a), rtol=1e-8)
+
+    def test_det_split1(self):
+        a = _well_conditioned(9, np.float64)
+        x = ht.array(a, split=1)
+        np.testing.assert_allclose(float(ht.linalg.det(x)), np.linalg.det(a),
+                                   rtol=1e-8)
+
+    def test_det_sign_from_pivot_swap(self):
+        a = np.array([[0.0, 1.0], [1.0, 0.0]], np.float64)  # det = -1
+        x = ht.array(a, split=0)
+        np.testing.assert_allclose(float(ht.linalg.det(x)), -1.0, rtol=1e-12)
+
+    def test_det_singular(self):
+        a = np.ones((4, 4), np.float32)
+        x = ht.array(a, split=0)
+        d = float(ht.linalg.det(x))
+        assert d == 0.0 or not np.isfinite(d) or abs(d) < 1e-5
+
+
+def test_gauss_jordan_no_allgather():
+    comm = ht.get_comm()
+    if comm.size == 1:
+        pytest.skip("needs a multi-device mesh")
+    a = _well_conditioned(13)
+    x = ht.array(a, split=0)
+    fn = gauss_jordan_fn(x.larray.shape, jnp.dtype(jnp.float32), 13, comm)
+    hlo = fn.lower(x.larray).compile().as_text()
+    assert "all-gather" not in hlo

@@ -229,6 +229,35 @@ class TestQRExtendedSweep:
             np.testing.assert_allclose((qr.Q @ qr.R).numpy(), a_np,
                                        rtol=1e-10, atol=1e-10)
 
+    @pytest.mark.parametrize("m,n", [(16, 16), (24, 40), (40, 24), (9, 30)])
+    def test_caqr_no_materialization(self, m, n, monkeypatch):
+        """Square/wide split=0 shapes (n < m*p) run the panel CAQR without
+        ever touching the logical array (round-2 VERDICT #6)."""
+        import heat_tpu as ht_mod
+
+        if ht.get_comm().size == 1:
+            pytest.skip("needs a multi-device mesh")
+        rng = np.random.default_rng(m + n)
+        a_np = rng.standard_normal((m, n)).astype(np.float32)
+        x = ht.array(a_np, split=0)
+
+        def boom(self):  # pragma: no cover
+            raise AssertionError("qr materialized the logical array")
+
+        monkeypatch.setattr(ht_mod.DNDarray, "_logical", boom)
+        qr = ht.linalg.qr(x)
+        monkeypatch.undo()
+        assert qr.Q.split == 0
+        k = min(m, n)
+        assert qr.Q.shape == (m, k) and qr.R.shape == (k, n)
+        np.testing.assert_allclose((qr.Q @ qr.R).numpy(), a_np,
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose((qr.Q.T @ qr.Q).numpy(), np.eye(k),
+                                   rtol=1e-4, atol=1e-4)
+        # R is upper triangular
+        r_np = qr.R.numpy()
+        np.testing.assert_allclose(r_np, np.triu(r_np), atol=0)
+
     def test_qr_error_paths(self):
         a = ht.array(np.zeros((8, 4), np.float32))
         with pytest.raises(TypeError):
