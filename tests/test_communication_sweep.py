@@ -1,0 +1,228 @@
+"""Collective sweep over (axis, dtype, communicator size).
+
+Mirror of the reference's 2,482-LoC ``heat/core/tests/test_communication.py``
+idiom: every collective exercised over axis permutations, the full dtype set
+(including native bf16 — the reference must bit-cast bf16 to int16 because
+MPI cannot reduce it, ``communication.py:137-138``; XLA reduces it
+natively — and complex), and multiple communicator sizes via ``Split``
+sub-communicators (the analog of the reference's ``mpirun -n 1..8`` ladder
+inside one mesh). Round-2 VERDICT #9.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+
+import heat_tpu as ht
+
+
+REDUCE_DTYPES = [np.float32, np.float64, np.int32, np.int64, jnp.bfloat16,
+                 np.complex64]
+ORDER_DTYPES = [np.float32, np.float64, np.int32, jnp.bfloat16]
+MOVE_DTYPES = [np.float32, np.int32, jnp.bfloat16, np.complex64, np.bool_]
+
+
+def _tol(dt):
+    return dict(rtol=2e-2, atol=2e-2) if jnp.dtype(dt) == jnp.bfloat16 \
+        else dict(rtol=1e-6, atol=1e-6)
+
+
+def _per_device(comm, shape, dt, seed=0):
+    """(size, *shape) np array of per-device blocks plus its sharded input
+    (device d holds blocks[d])."""
+    rng = np.random.default_rng(seed)
+    if jnp.dtype(dt) == jnp.bool_:
+        blocks = rng.random((comm.size,) + shape) > 0.5
+    elif jnp.issubdtype(jnp.dtype(dt), jnp.complexfloating):
+        blocks = (rng.standard_normal((comm.size,) + shape)
+                  + 1j * rng.standard_normal((comm.size,) + shape))
+    elif jnp.issubdtype(jnp.dtype(dt), jnp.integer):
+        blocks = rng.integers(-20, 20, (comm.size,) + shape)
+    else:
+        blocks = rng.standard_normal((comm.size,) + shape)
+    # round-trip through the target dtype so expectations are exact
+    blocks = np.asarray(jnp.asarray(np.asarray(blocks), dt))
+    arr = jnp.asarray(blocks).reshape((comm.size * shape[0],) + shape[1:])
+    sharded = jax.device_put(arr, comm.sharding(len(shape), 0))
+    return blocks, sharded
+
+
+def _run(comm, shape, body, sharded, out_split=0, out_ndim=None):
+    spec_in = comm.spec(len(shape), 0)
+    nd = len(shape) if out_ndim is None else out_ndim
+    spec_out = comm.spec(nd, out_split)
+    fn = shard_map(body, mesh=comm.mesh, in_specs=spec_in,
+                   out_specs=spec_out, check_vma=False)
+    return np.asarray(jax.jit(fn)(sharded))
+
+
+class TestReduceSweep:
+    @pytest.mark.parametrize("dtype", REDUCE_DTYPES)
+    @pytest.mark.parametrize("shape", [(2, 3), (1, 4, 2)])
+    def test_psum(self, dtype, shape):
+        comm = ht.get_comm()
+        blocks, sharded = _per_device(comm, shape, dtype, seed=1)
+        out = _run(comm, shape, lambda b: comm.psum(b), sharded)
+        want = blocks.astype(np.complex128 if np.iscomplexobj(blocks)
+                             else np.float64).sum(0)
+        expected = np.broadcast_to(want, (comm.size,) + shape).reshape(
+            out.shape)
+        np.testing.assert_allclose(
+            out.astype(expected.dtype), expected, **_tol(dtype))
+
+    @pytest.mark.parametrize("dtype", ORDER_DTYPES)
+    def test_pmax_pmin(self, dtype):
+        comm = ht.get_comm()
+        shape = (2, 3)
+        blocks, sharded = _per_device(comm, shape, dtype, seed=2)
+        out_max = _run(comm, shape, lambda b: comm.pmax(b), sharded)
+        out_min = _run(comm, shape, lambda b: comm.pmin(b), sharded)
+        np.testing.assert_allclose(
+            out_max.astype(np.float64).reshape((comm.size,) + shape)[0],
+            blocks.astype(np.float64).max(0), **_tol(dtype))
+        np.testing.assert_allclose(
+            out_min.astype(np.float64).reshape((comm.size,) + shape)[0],
+            blocks.astype(np.float64).min(0), **_tol(dtype))
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64, jnp.bfloat16])
+    def test_pmean(self, dtype):
+        comm = ht.get_comm()
+        shape = (2, 2)
+        blocks, sharded = _per_device(comm, shape, dtype, seed=3)
+        out = _run(comm, shape, lambda b: comm.pmean(b), sharded)
+        np.testing.assert_allclose(
+            out.astype(np.float64).reshape((comm.size,) + shape)[0],
+            blocks.astype(np.float64).mean(0), **_tol(dtype))
+
+
+class TestScanSweep:
+    @pytest.mark.parametrize("dtype", ORDER_DTYPES)
+    @pytest.mark.parametrize("inclusive", [False, True])
+    def test_scan_exscan(self, dtype, inclusive):
+        comm = ht.get_comm()
+        shape = (2, 2)
+        blocks, sharded = _per_device(comm, shape, dtype, seed=4)
+        op = comm.scan if inclusive else comm.exscan
+        out = _run(comm, shape, lambda b: op(b), sharded)
+        out = out.astype(np.float64).reshape((comm.size,) + shape)
+        acc = np.cumsum(blocks.astype(np.float64), axis=0)
+        want = acc if inclusive else acc - blocks.astype(np.float64)
+        np.testing.assert_allclose(out, want, **_tol(dtype))
+
+
+class TestGatherMoveSweep:
+    @pytest.mark.parametrize("dtype", MOVE_DTYPES)
+    @pytest.mark.parametrize("axis", [0, 1])
+    def test_all_gather(self, dtype, axis):
+        comm = ht.get_comm()
+        shape = (2, 3)
+        blocks, sharded = _per_device(comm, shape, dtype, seed=5)
+        out = _run(comm, shape, lambda b: comm.all_gather(b, axis=axis),
+                   sharded, out_split=0,
+                   out_ndim=2)
+        want_one = np.concatenate(list(blocks), axis=axis)
+        out = out.reshape((comm.size,) + want_one.shape)
+        cmp = (np.bool_ if dtype == np.bool_ else
+               np.complex128 if np.iscomplexobj(want_one) else np.float64)
+        for d in range(comm.size):
+            np.testing.assert_array_equal(out[d].astype(cmp),
+                                          want_one.astype(cmp))
+
+    @pytest.mark.parametrize("dtype", MOVE_DTYPES)
+    @pytest.mark.parametrize("split_axis,concat_axis", [(0, 1), (1, 0),
+                                                        (0, 0), (1, 1)])
+    def test_all_to_all(self, dtype, split_axis, concat_axis):
+        comm = ht.get_comm()
+        p = comm.size
+        shape = (p * 2, p * 3)  # divisible by p on both axes
+        blocks, sharded = _per_device(comm, shape, dtype, seed=6)
+        out = _run(comm, shape,
+                   lambda b: comm.all_to_all(b, split_axis, concat_axis),
+                   sharded)
+        # reference semantics (tiled): block d splits along split_axis into p
+        # pieces; device e receives piece e from every d, concatenated along
+        # concat_axis in d-order
+        pieces = [np.split(blocks[d], p, axis=split_axis) for d in range(p)]
+        want = np.concatenate(
+            [np.concatenate([pieces[d][e] for d in range(p)],
+                            axis=concat_axis)
+             for e in range(p)], axis=0)
+        cmp = (np.bool_ if dtype == np.bool_ else
+               np.complex128 if np.iscomplexobj(want) else np.float64)
+        np.testing.assert_array_equal(out.astype(cmp), want.astype(cmp))
+
+    @pytest.mark.parametrize("dtype", MOVE_DTYPES)
+    def test_ppermute_reverse_and_shift(self, dtype):
+        comm = ht.get_comm()
+        p = comm.size
+        shape = (1, 3)
+        blocks, sharded = _per_device(comm, shape, dtype, seed=7)
+        rev = [(i, p - 1 - i) for i in range(p)]
+        out = _run(comm, shape, lambda b: comm.ppermute(b, rev), sharded)
+        np.testing.assert_array_equal(
+            out.reshape((p,) + shape), blocks[::-1])
+        out2 = _run(comm, shape, lambda b: comm.ring_shift(b, 2), sharded)
+        np.testing.assert_array_equal(
+            out2.reshape((p,) + shape), np.roll(blocks, 2, axis=0))
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.int32, jnp.bfloat16,
+                                       np.complex64, np.bool_])
+    @pytest.mark.parametrize("root_kind", ["first", "last", "mid"])
+    def test_broadcast_from(self, dtype, root_kind):
+        comm = ht.get_comm()
+        p = comm.size
+        root = {"first": 0, "last": p - 1, "mid": p // 2}[root_kind]
+        shape = (2, 2)
+        blocks, sharded = _per_device(comm, shape, dtype, seed=8)
+        out = _run(comm, shape, lambda b: comm.broadcast_from(b, root),
+                   sharded)
+        out = out.reshape((p,) + shape)
+        for d in range(p):
+            np.testing.assert_array_equal(out[d], blocks[root].astype(
+                out.dtype) if dtype != np.bool_ else blocks[root])
+
+
+class TestSubcommLadder:
+    """The reference proves size-agnosticism by re-running under
+    ``mpirun -n 1..8``; here the same collectives run on Split
+    sub-communicators of every power-of-two size the mesh allows."""
+
+    def _sizes(self, comm):
+        s, out = 2, []
+        while s <= comm.size:
+            out.append(s)
+            s *= 2
+        return out
+
+    def test_psum_scan_ladder(self):
+        comm = ht.get_comm()
+        if comm.size < 2:
+            pytest.skip("needs >=2 devices")
+        for size in self._sizes(comm):
+            sub = comm.Split(list(range(size)))
+            blocks, sharded = _per_device(sub, (2,), np.float32, seed=size)
+            out = _run(sub, (2,), lambda b: sub.psum(b), sharded)
+            np.testing.assert_allclose(
+                out.reshape(size, 2)[0], blocks.sum(0), rtol=1e-6)
+            out = _run(sub, (2,), lambda b: sub.exscan(b), sharded)
+            np.testing.assert_allclose(
+                out.reshape(size, 2),
+                np.cumsum(blocks, 0) - blocks, rtol=1e-6)
+
+    def test_alltoall_ladder(self):
+        comm = ht.get_comm()
+        if comm.size < 2:
+            pytest.skip("needs >=2 devices")
+        for size in self._sizes(comm):
+            sub = comm.Split(list(range(size)))
+            shape = (size, 2)
+            blocks, sharded = _per_device(sub, shape, np.float32, seed=size)
+            out = _run(sub, shape, lambda b: sub.all_to_all(b, 0, 1), sharded)
+            pieces = [np.split(blocks[d], size, axis=0) for d in range(size)]
+            want = np.concatenate(
+                [np.concatenate([pieces[d][e] for d in range(size)], axis=1)
+                 for e in range(size)], axis=0)
+            np.testing.assert_array_equal(out, want)
