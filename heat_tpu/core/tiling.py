@@ -2,12 +2,16 @@
 
 ``SplitTiles`` (reference ``:14-330``) describes the per-device tiles of a
 DNDarray in every dimension; the reference uses it to drive ``resplit_``'s
-Send/Irecv loops. Here resharding is a single XLA program, so ``SplitTiles``
-survives purely as an *introspection* utility with the same accessors.
+Send/Irecv loops. Here resharding is a single XLA program, so the *transport*
+role is gone — but the tile algebra itself is functional: tiles can be read
+and written by tile index (``tiles[i]``, ``tiles[i] = v``), backed by the
+DNDarray's global indexing.
 
-``SquareDiagTiles`` (reference ``:331-1280``) exists to drive the tiled CAQR;
-our QR is blockwise TSQR (see ``linalg/qr.py``), which needs no tile
-bookkeeping — the class is provided for structural introspection only.
+``SquareDiagTiles`` (reference ``:331-1280``) drives the reference's tiled
+CAQR. Our QR is blockwise TSQR/panel-CAQR (``linalg/qr.py``) and needs no
+tile bookkeeping, but the class supports the reference's per-tile accessors
+(``get_start_stop``, ``__getitem__``/``__setitem__``, ``local_get``/
+``local_set``, ``match_tiles``) so tile-based user code ports directly.
 """
 
 from __future__ import annotations
@@ -16,9 +20,15 @@ from typing import List, Tuple
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from .dndarray import DNDarray
 
 __all__ = ["SplitTiles", "SquareDiagTiles"]
+
+
+def _ends_to_starts(ends: np.ndarray) -> np.ndarray:
+    return np.concatenate([[0], ends[:-1]])
 
 
 class SplitTiles:
@@ -64,14 +74,21 @@ class SplitTiles:
     def tile_dimensions(self) -> List[np.ndarray]:
         dims = []
         for ends in self.__tile_ends_per_dim:
-            starts = np.concatenate([[0], ends[:-1]])
+            starts = _ends_to_starts(ends)
             dims.append(ends - starts)
         return dims
 
-    def __getitem__(self, key) -> np.ndarray:
-        """Tile contents by tile index (gathered as numpy)."""
+    def __getitem__(self, key):
+        """Tile contents by tile index (reference returns the local torch
+        tile; here the tile block as a jnp array — O(tile), not O(array))."""
         slices = self._key_to_slices(key)
-        return self.__arr.numpy()[slices]
+        out = self.__arr[slices]
+        return out._logical() if isinstance(out, DNDarray) else jnp.asarray(out)
+
+    def __setitem__(self, key, value) -> None:
+        """Write a tile back (reference ``SplitTiles.__setitem__``)."""
+        slices = self._key_to_slices(key)
+        self.__arr[slices] = value
 
     def _key_to_slices(self, key):
         if not isinstance(key, tuple):
@@ -79,20 +96,31 @@ class SplitTiles:
         slices = []
         for dim, k in enumerate(key):
             ends = self.__tile_ends_per_dim[dim]
-            starts = np.concatenate([[0], ends[:-1]])
-            if isinstance(k, int):
+            starts = _ends_to_starts(ends)
+            if isinstance(k, (int, np.integer)):
                 slices.append(slice(int(starts[k]), int(ends[k])))
+            elif isinstance(k, slice):
+                if k.step not in (None, 1):
+                    raise NotImplementedError(
+                        "stepped tile slices are not supported (the skipped "
+                        "tiles would be silently included)")
+                ks = range(*k.indices(len(ends)))
+                if len(ks) == 0:
+                    slices.append(slice(0, 0))
+                else:
+                    slices.append(slice(int(starts[ks[0]]), int(ends[ks[-1]])))
             else:
-                raise NotImplementedError("only integer tile indices are supported")
+                raise NotImplementedError(
+                    "tile keys must be ints or slices of tile indices")
         return tuple(slices)
 
 
 class SquareDiagTiles:
     """Diagonal-aligned 2-D tile map (reference ``tiling.py:331``).
 
-    Introspection-only: computes the diagonal-square tile grid the reference
-    uses for its tiled QR. The TSQR in ``linalg/qr.py`` replaces the tile
-    algebra itself.
+    Computes the diagonal-square tile grid the reference uses for its tiled
+    QR and supports the per-tile accessor surface; the TSQR/panel-CAQR in
+    ``linalg/qr.py`` replaces the tile *algebra* (Householder merges).
     """
 
     def __init__(self, arr: DNDarray, tiles_per_proc: int = 1):
@@ -111,10 +139,13 @@ class SquareDiagTiles:
         row_ends = np.arange(tile, n + tile, tile).clip(max=n)
         col_ends = np.arange(tile, m + tile, tile).clip(max=m)
         self.__row_per_proc_list = [len(row_ends) // nprocs] * nprocs
-        self.__tile_rows = len(row_ends)
-        self.__tile_columns = len(col_ends)
-        self.__row_ends = row_ends
-        self.__col_ends = col_ends
+        self.__set_ends(row_ends, col_ends)
+
+    def __set_ends(self, row_ends, col_ends) -> None:
+        self.__row_ends = np.asarray(row_ends)
+        self.__col_ends = np.asarray(col_ends)
+        self.__tile_rows = len(self.__row_ends)
+        self.__tile_columns = len(self.__col_ends)
 
     @property
     def arr(self) -> DNDarray:
@@ -134,8 +165,71 @@ class SquareDiagTiles:
 
     @property
     def row_indices(self) -> List[int]:
-        return np.concatenate([[0], self.__row_ends[:-1]]).tolist()
+        return _ends_to_starts(self.__row_ends).tolist()
 
     @property
     def col_indices(self) -> List[int]:
-        return np.concatenate([[0], self.__col_ends[:-1]]).tolist()
+        return _ends_to_starts(self.__col_ends).tolist()
+
+    def get_start_stop(self, key) -> Tuple[int, int, int, int]:
+        """(row_start, row_stop, col_start, col_stop) of tile ``key`` =
+        (tile_row, tile_col) (reference ``get_start_stop``, ``:820``)."""
+        tr, tc = key if isinstance(key, tuple) else (key, slice(None))
+        row_starts = _ends_to_starts(self.__row_ends)
+        col_starts = _ends_to_starts(self.__col_ends)
+
+        def rng(idx, starts, ends):
+            if isinstance(idx, (int, np.integer)):
+                return int(starts[idx]), int(ends[idx])
+            if idx.step not in (None, 1):
+                raise NotImplementedError(
+                    "stepped tile slices are not supported (the skipped "
+                    "tiles would be silently included)")
+            ks = range(*idx.indices(len(ends)))
+            if len(ks) == 0:
+                return 0, 0
+            return int(starts[ks[0]]), int(ends[ks[-1]])
+
+        r0, r1 = rng(tr, row_starts, self.__row_ends)
+        c0, c1 = rng(tc, col_starts, self.__col_ends)
+        return r0, r1, c0, c1
+
+    def __getitem__(self, key):
+        """Tile (or tile-range) contents as a jnp array (reference ``:900``:
+        the local torch view)."""
+        r0, r1, c0, c1 = self.get_start_stop(key)
+        out = self.__arr[r0:r1, c0:c1]
+        return out._logical() if isinstance(out, DNDarray) else jnp.asarray(out)
+
+    def __setitem__(self, key, value) -> None:
+        """Write a tile back (reference ``:960``)."""
+        r0, r1, c0, c1 = self.get_start_stop(key)
+        self.__arr[r0:r1, c0:c1] = value
+
+    def local_get(self, key):
+        """Reference ``local_get`` (``:1000``): tile addressed in *local*
+        tile coordinates of one device's row block. Single-controller: local
+        tile row ``i`` of device ``d`` is global tile row
+        ``d * rows_per_proc + i``."""
+        return self[key]
+
+    def local_set(self, key, value) -> None:
+        self[key] = value
+
+    def match_tiles(self, other: "SquareDiagTiles") -> None:
+        """Align this tile map's boundaries with ``other`` where the global
+        extents coincide (reference ``match_tiles``, ``:1084``, used to give
+        Q/R tile maps compatible with A's). Boundaries on an axis are adopted
+        from ``other`` when that axis has the same global size; otherwise
+        they are clipped to this array's extent."""
+        if not isinstance(other, SquareDiagTiles):
+            raise TypeError(
+                f"other must be SquareDiagTiles, got {type(other)}")
+        n, m = self.__arr.shape
+        row_ends = (np.asarray(other.__row_ends)
+                    if other.__arr.shape[0] == n
+                    else np.unique(np.asarray(other.__row_ends).clip(max=n)))
+        col_ends = (np.asarray(other.__col_ends)
+                    if other.__arr.shape[1] == m
+                    else np.unique(np.asarray(other.__col_ends).clip(max=m)))
+        self.__set_ends(row_ends, col_ends)

@@ -124,6 +124,42 @@ class TestTiling:
         lm = tiles.lshape_map
         assert np.asarray(lm).shape[0] == x.comm.size
 
+    def test_split_tiles_get_set(self):
+        """Per-tile read/write (reference ``SplitTiles.__getitem__`` /
+        ``__setitem__``) — functional, not just introspection."""
+        a = np.arange(40, dtype=np.float32).reshape(8, 5)
+        x = ht.array(a.copy(), split=0)
+        tiles = ht.tiling.SplitTiles(x)
+        ends = np.asarray(tiles.tile_ends_per_dim[0])
+        t0 = np.asarray(tiles[0])
+        np.testing.assert_allclose(t0[:, :], a[: ends[0]])
+        tiles[0] = np.full_like(t0, -1.0)
+        got = np.asarray(x.numpy())
+        assert (got[: ends[0]] == -1.0).all()
+        np.testing.assert_allclose(got[ends[0]:], a[ends[0]:])
+
+    def test_square_diag_tiles_get_set_and_start_stop(self):
+        a = np.arange(144, dtype=np.float32).reshape(12, 12)
+        x = ht.array(a.copy(), split=0)
+        t = ht.tiling.SquareDiagTiles(x, tiles_per_proc=1)
+        r0, r1, c0, c1 = t.get_start_stop((0, 0))
+        assert (r1 - r0) >= 1 and (c1 - c0) >= 1
+        np.testing.assert_allclose(np.asarray(t[0, 0]), a[r0:r1, c0:c1])
+        t[0, 0] = 7.0
+        got = np.asarray(x.numpy())
+        assert (got[r0:r1, c0:c1] == 7.0).all()
+        # untouched region intact
+        np.testing.assert_allclose(got[r1:, c1:], a[r1:, c1:])
+
+    def test_square_diag_tiles_match(self):
+        x = ht.zeros((12, 12), split=0)
+        q = ht.zeros((12, 8), split=0)
+        tx = ht.tiling.SquareDiagTiles(x)
+        tq = ht.tiling.SquareDiagTiles(q)
+        tq.match_tiles(tx)
+        assert tq.row_indices == tx.row_indices  # same global row extent
+        assert max(c for c in tq.col_indices) < 8
+
 
 class TestVersion:
     def test_version_tuple(self):
