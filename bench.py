@@ -73,6 +73,35 @@ def tpu_kmeans_iter_per_s(n: int, d: int = 64, k: int = 8) -> float:
     return 1.0 / per_iter
 
 
+def tpu_cdist_gbps(n: int, d: int = 18) -> float:
+    """Sustained GB/s of the ring cdist at the reference's distance_matrix
+    shape family (SUSY: 40k x 18, ``benchmarks/distance_matrix``): bytes of
+    the produced distance matrix per second, timed by differencing two
+    repeat counts of the same compiled executable (same methodology as the
+    KMeans number)."""
+    import heat_tpu as ht
+
+    ht.random.seed(1)
+    x = ht.random.rand(n, d, dtype=ht.float32, split=0)
+
+    def timed(reps: int) -> float:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            dmat = ht.spatial.cdist(x, x)
+        float(np.asarray(dmat.larray[0, 0]))  # real completion fetch
+        return time.perf_counter() - t0
+
+    timed(1)  # compile + warm
+    lo, hi = 1, 3
+    t_lo = min(timed(lo) for _ in range(2))
+    t_hi = min(timed(hi) for _ in range(2))
+    per_call = (t_hi - t_lo) / (hi - lo)
+    if per_call <= 0:
+        per_call = t_hi / hi
+    out_bytes = float(n) * n * 4
+    return out_bytes / per_call / 1e9
+
+
 def torch_kmeans_time_per_iter(n: int, d: int = 64, k: int = 8, iters: int = 3) -> float:
     """Reference-equivalent local Lloyd iteration in PyTorch (CPU)."""
     import torch
@@ -130,6 +159,15 @@ def _measure_main(n: int) -> None:
     t_torch_full_est = t_torch_small * (n / min(n, N_TORCH))
     baseline_ips = 1.0 / t_torch_full_est
 
+    # companion figure from BASELINE.json: ring-cdist GB/s at the reference
+    # distance_matrix shape (40k x 18 on the accelerator; reduced on CPU)
+    n_cdist = 40_000 if backend != "cpu" else 8_000
+    try:
+        cdist_gbps = round(tpu_cdist_gbps(n_cdist), 3)
+    except Exception as exc:  # the headline metric still reports
+        sys.stderr.write(f"bench: cdist figure failed: {exc}\n")
+        cdist_gbps = None
+
     label = f"{n / 2 ** 20:.0f}M" if n >= 1 << 20 else str(n)
     print(
         json.dumps(
@@ -139,6 +177,8 @@ def _measure_main(n: int) -> None:
                 "unit": "iter/s",
                 "vs_baseline": round(ips / baseline_ips, 3),
                 "backend": backend,
+                "cdist_gbps": cdist_gbps,
+                "cdist_n": n_cdist,
             }
         )
     )
