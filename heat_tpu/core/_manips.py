@@ -1,19 +1,22 @@
-"""Distributed split-axis manipulations: destination-scatter ring programs.
+"""Distributed split-axis manipulations: scheduled block-window fetches.
 
 TPU-native counterparts of the reference's point-to-point/Alltoallv
 manipulations (``heat/core/manipulations.py``: concatenate ``:188``, reshape
-``:1817``, roll ``:1985``, flip ``:1343``). Each op is a *static* global-row
-permutation (or injection) along the split axis, so the XLA rendering is one
-jitted shard_map program: the data blocks rotate around the mesh in ``p``
-``ppermute`` steps and every device scatters the rows whose destination
-falls in its output range — O(chunk) memory per device, no materialization
-of the logical array, and no all-gather anywhere in the HLO (the round-2
-VERDICT #4 done-criterion).
+``:1817``, roll ``:1985``, flip ``:1343``). Each op is a *static* monotone
+source map along the split axis, so each output device needs a CONTIGUOUS
+range of source rows spanning only ~``c_out/c_in + 1`` source blocks. The
+(sender block -> receiver device) demand graph is computed in Python at
+trace time and greedily edge-colored into rounds where every round is a
+partial permutation — one ``ppermute`` each. Result: O(1) collective rounds
+and O(n) total traffic (vs O(p) rounds / O(p n) for a naive rotation ring),
+O(chunk) memory per device, no materialization of the logical array, and no
+all-gather anywhere in the HLO (the round-2 VERDICT #4 done-criterion).
 
 The canonical layout invariant (valid rows occupy global positions
-``0..n-1``, padding at the tail) holds for inputs and outputs alike;
-destinations are computed from *global* row positions, so padded and
-non-block-aligned shapes need no special cases.
+``0..n-1``, padding at the tail) holds for inputs and outputs alike; source
+positions are *global*, so padded and non-block-aligned shapes need no
+special cases — a receiver unserved in a round has owner -1 in its table
+entry, which the hit mask rejects (ppermute delivers zeros there).
 """
 
 from __future__ import annotations
@@ -37,38 +40,94 @@ __all__ = [
 _MANIP_CACHE: dict = {}
 
 
-def _scatter_ring(buf, out, me, owner0, c_in, c_out, dest_of, comm):
-    """Scatter ``buf``'s rows (rotating around the ring) into ``out`` by the
-    static destination map ``dest_of(global_row) -> global_row | -1``."""
-    p = comm.size
-    idt = _index_dtype()
-    for k in range(p):
-        owner = (owner0 - k) % p
-        gpos = owner * c_in + jnp.arange(c_in, dtype=idt)
-        dest = dest_of(gpos)
-        rel = dest - me * c_out
-        tgt = jnp.where((rel >= 0) & (rel < c_out) & (dest >= 0), rel, c_out)
-        out = out.at[tgt].set(buf, mode="drop")
-        if k < p - 1:
-            buf = comm.ring_shift(buf, 1)
+def _row_mask(hit, row_ndim):
+    return hit.reshape(hit.shape + (1,) * row_ndim)
+
+
+def _demand_blocks(src_at, glo: int, ghi: int, p: int, c_out: int,
+                   c_in: int):
+    """Per-output-device lists of source blocks needed, computed statically.
+
+    ``src_at(go) -> int`` is the (python) source-position map, monotone over
+    the valid output interval ``[glo, ghi)``; each device's needed source
+    rows therefore form a contiguous range, read off the clamped endpoints.
+    """
+    demands = []
+    for e in range(p):
+        lo = max(e * c_out, glo)
+        hi = min((e + 1) * c_out, ghi) - 1
+        if lo > hi:
+            demands.append([])
+            continue
+        s0, s1 = src_at(lo), src_at(hi)
+        b0, b1 = sorted((s0 // c_in, s1 // c_in))
+        b0, b1 = max(b0, 0), min(b1, p - 1)
+        demands.append(list(range(b0, b1 + 1)))
+    return demands
+
+
+def _schedule_block_fetch(demands, p: int):
+    """Greedy edge-coloring of the (sender block -> receiver device) demand
+    graph into rounds where every round is a partial permutation — one
+    ``ppermute`` each. Shift-like maps need only ~(c_out/c_in + 1) rounds
+    instead of the p rotations of a full ring. Returns
+    ``[(perm_pairs, owner_table)]`` with ``owner_table[e]`` = the block
+    device ``e`` receives that round (-1: none)."""
+    remaining = [list(s) for s in demands]
+    rounds = []
+    while any(remaining):
+        used = set()
+        perm = []
+        table = np.full(p, -1, np.int64)
+        progressed = False
+        for e in range(p):
+            for s in remaining[e]:
+                if s not in used:
+                    used.add(s)
+                    perm.append((s, e))
+                    table[e] = s
+                    remaining[e].remove(s)
+                    progressed = True
+                    break
+        if not progressed:  # cannot happen, but never loop forever
+            break
+        rounds.append((perm, table))
+    return rounds
+
+
+def _window_gather(buf, me, src, rounds, c_in, comm, out):
+    """Apply scheduled block fetches: ``out[i] = buf_global[src[i]]``.
+
+    ``src`` carries global source positions (-1 = no source). Receivers not
+    served in a round see owner -1 in their table entry and keep ``out``
+    unchanged (ppermute delivers zeros there, which the hit mask ignores)."""
+    for perm, table in rounds:
+        blk = jax.lax.ppermute(buf, comm.axis_name, perm=perm)
+        owner = jnp.asarray(table)[me]
+        rel = src - owner * c_in
+        hit = (owner >= 0) & (src >= 0) & (rel >= 0) & (rel < c_in)
+        take = jnp.take(blk, jnp.clip(rel, 0, c_in - 1), axis=0)
+        out = jnp.where(_row_mask(hit, buf.ndim - 1), take, out)
     return out
 
 
-def _ring_permute_factory(key, phys_shape, axis, c_out, make_dest, comm):
-    """Build & cache a jitted ``x_physical -> out_physical`` program whose
-    output block ``d`` holds rows ``[d*c_out, (d+1)*c_out)`` of the permuted
-    global sequence."""
+def _window_factory(key, phys_shape, axis, c_in, c_out, rounds, make_src,
+                    comm):
+    """Cache + compile the common single-input window program:
+    ``out[go] = in_global[make_src(go)]`` along ``axis`` with ``c_out`` rows
+    per device (roll/flip/repeat share this; concat and reshape have their
+    own bodies)."""
     fn = _MANIP_CACHE.get(key)
     if fn is not None:
         return fn
-    p = comm.size
-    c_in = phys_shape[axis] // p
+    idt = _index_dtype()
 
     def body(xb):
-        buf = jnp.moveaxis(xb, axis, 0)  # (c_in, rest...)
+        buf = jnp.moveaxis(xb, axis, 0)
         me = jax.lax.axis_index(comm.axis_name)
+        go = me * c_out + jnp.arange(c_out, dtype=idt)
         out = jnp.zeros((c_out,) + buf.shape[1:], buf.dtype)
-        out = _scatter_ring(buf, out, me, me, c_in, c_out, make_dest, comm)
+        out = _window_gather(buf, me, make_src(go), rounds, c_in, comm, out)
         return jnp.moveaxis(out, 0, axis)
 
     spec = comm.spec(len(phys_shape), axis)
@@ -82,37 +141,54 @@ def _ring_permute_factory(key, phys_shape, axis, c_out, make_dest, comm):
 
 def ring_roll_fn(phys_shape, jdt, axis: int, n: int, shift: int, comm):
     """``out[(g + shift) % n] = in[g]`` along the split axis (reference
-    ``roll``, ``manipulations.py:1985``)."""
+    ``roll``, ``manipulations.py:1985``). Two affine fetch segments (the
+    wrap), scheduled into O(1) ppermute rounds."""
     shift = int(shift) % n if n else 0
-    idt = _index_dtype()
-
-    def dest(gpos):
-        return jnp.where(gpos < n, (gpos + shift) % n, jnp.asarray(-1, idt))
-
     key = ("rroll", tuple(phys_shape), str(jdt), axis, n, shift, comm.cache_key)
-    c_out = phys_shape[axis] // comm.size
-    return _ring_permute_factory(key, phys_shape, axis, c_out, dest, comm)
+    if key in _MANIP_CACHE:
+        return _MANIP_CACHE[key]
+    p = comm.size
+    c = phys_shape[axis] // p
+    idt = _index_dtype()
+    s = shift
+    seg1 = _demand_blocks(lambda go: go - s + n, 0, min(s, n), p, c, c)
+    seg2 = _demand_blocks(lambda go: go - s, s, n, p, c, c)
+    rounds = _schedule_block_fetch(
+        [sorted(set(a) | set(b)) for a, b in zip(seg1, seg2)], p)
+
+    def src(go):
+        return jnp.where(go < n,
+                         jnp.where(go < s, go - s + n, go - s),
+                         jnp.asarray(-1, idt))
+
+    return _window_factory(key, phys_shape, axis, c, c, rounds, src, comm)
 
 
 def ring_flip_fn(phys_shape, jdt, axis: int, n: int, comm):
     """``out[n - 1 - g] = in[g]`` along the split axis (reference ``flip``,
-    ``manipulations.py:1343``)."""
-    idt = _index_dtype()
-
-    def dest(gpos):
-        return jnp.where(gpos < n, n - 1 - gpos, jnp.asarray(-1, idt))
-
+    ``manipulations.py:1343``): the block-reversal permutation plus its
+    neighbor, two ppermute rounds."""
     key = ("rflip", tuple(phys_shape), str(jdt), axis, n, comm.cache_key)
-    c_out = phys_shape[axis] // comm.size
-    return _ring_permute_factory(key, phys_shape, axis, c_out, dest, comm)
+    if key in _MANIP_CACHE:
+        return _MANIP_CACHE[key]
+    p = comm.size
+    c = phys_shape[axis] // p
+    idt = _index_dtype()
+    rounds = _schedule_block_fetch(
+        _demand_blocks(lambda go: n - 1 - go, 0, n, p, c, c), p)
+
+    def src(go):
+        return jnp.where(go < n, n - 1 - go, jnp.asarray(-1, idt))
+
+    return _window_factory(key, phys_shape, axis, c, c, rounds, src, comm)
 
 
 def ring_concat_fn(phys_shapes, jdt, axis: int, ns, c_out: int, comm):
     """Jitted ``(*x_physicals) -> out_physical``: concatenation of ``k``
     split arrays along their shared split axis (reference ``concatenate``,
-    ``manipulations.py:188``). Array ``i``'s valid rows shift by
-    ``sum(ns[:i])``; every input streams through its own ring into the
-    shared output block."""
+    ``manipulations.py:188``). Array ``i``'s rows shift by ``sum(ns[:i])``;
+    each input's boundary blocks move in O(c_out/c_in) scheduled ppermute
+    rounds (the reference's point-to-point boundary exchange)."""
     key = ("rconcat", tuple(map(tuple, phys_shapes)), str(jdt), axis,
            tuple(ns), c_out, comm.cache_key)
     fn = _MANIP_CACHE.get(key)
@@ -121,20 +197,26 @@ def ring_concat_fn(phys_shapes, jdt, axis: int, ns, c_out: int, comm):
     p = comm.size
     idt = _index_dtype()
     offsets = np.concatenate([[0], np.cumsum(ns)]).astype(np.int64)
+    cs = [int(s[axis]) // p for s in phys_shapes]
+    all_rounds = []
+    for i, n_i in enumerate(ns):
+        off = int(offsets[i])
+        dem = _demand_blocks(lambda go, off=off: go - off,
+                             off, off + int(n_i), p, c_out, cs[i])
+        all_rounds.append(_schedule_block_fetch(dem, p))
 
     def body(*xbs):
         me = jax.lax.axis_index(comm.axis_name)
+        go = me * c_out + jnp.arange(c_out, dtype=idt)
         first = jnp.moveaxis(xbs[0], axis, 0)
         out = jnp.zeros((c_out,) + first.shape[1:], first.dtype)
         for i, xb in enumerate(xbs):
             buf = jnp.moveaxis(xb, axis, 0)
             n_i, off = int(ns[i]), int(offsets[i])
-            c_in = buf.shape[0]
-
-            def dest(gpos, n_i=n_i, off=off):
-                return jnp.where(gpos < n_i, gpos + off, jnp.asarray(-1, idt))
-
-            out = _scatter_ring(buf, out, me, me, c_in, c_out, dest, comm)
+            src = jnp.where((go >= off) & (go < off + n_i), go - off,
+                            jnp.asarray(-1, idt))
+            out = _window_gather(buf, me, src, all_rounds[i], cs[i], comm,
+                                 out)
         return jnp.moveaxis(out, 0, axis)
 
     specs = tuple(comm.spec(len(s), axis) for s in phys_shapes)
@@ -155,30 +237,60 @@ def ring_repeat_fn(phys_shape, jdt, axis: int, n: int, rep: int, c_out: int,
     with ``rep`` scatter sub-steps per rotation."""
     key = ("rrepeat", tuple(phys_shape), str(jdt), axis, n, rep, c_out,
            comm.cache_key)
+    if key in _MANIP_CACHE:
+        return _MANIP_CACHE[key]
+    p = comm.size
+    c_in = phys_shape[axis] // p
+    idt = _index_dtype()
+    rounds = _schedule_block_fetch(
+        _demand_blocks(lambda go: go // rep, 0, n * rep, p, c_out, c_in), p)
+
+    def src(go):
+        return jnp.where(go < n * rep, go // rep, jnp.asarray(-1, idt))
+
+    return _window_factory(key, phys_shape, axis, c_in, c_out, rounds, src,
+                           comm)
+
+
+def split_diff_fn(phys_shape, jdt, axis: int, n: int, comm):
+    """Jitted first-order ``diff`` along the split axis (reference ``diff``,
+    ``arithmetics.py:563``): ``out[g] = in[g+1] - in[g]`` for ``g < n-1``
+    (bool: xor, numpy semantics). One scheduled window pass serves both
+    source maps; output re-chunks to length ``n - 1``."""
+    key = ("sdiff", tuple(phys_shape), str(jdt), axis, n, comm.cache_key)
     fn = _MANIP_CACHE.get(key)
     if fn is not None:
         return fn
     p = comm.size
     c_in = phys_shape[axis] // p
+    c_out = comm.chunk_size(n - 1)
     idt = _index_dtype()
+    d1 = _demand_blocks(lambda go: go, 0, n - 1, p, c_out, c_in)
+    d2 = _demand_blocks(lambda go: go + 1, 0, n - 1, p, c_out, c_in)
+    rounds = _schedule_block_fetch(
+        [sorted(set(a) | set(b)) for a, b in zip(d1, d2)], p)
+    is_bool = jnp.dtype(jdt) == jnp.bool_
 
     def body(xb):
         buf = jnp.moveaxis(xb, axis, 0)
         me = jax.lax.axis_index(comm.axis_name)
-        out = jnp.zeros((c_out,) + buf.shape[1:], buf.dtype)
-        for k in range(p):
-            owner = (me - k) % p
-            gpos = owner * c_in + jnp.arange(c_in, dtype=idt)
-            for jj in range(rep):
-                dest = jnp.where(gpos < n, gpos * rep + jj,
-                                 jnp.asarray(-1, idt))
-                rel = dest - me * c_out
-                tgt = jnp.where((rel >= 0) & (rel < c_out) & (dest >= 0),
-                                rel, c_out)
-                out = out.at[tgt].set(buf, mode="drop")
-            if k < p - 1:
-                buf = comm.ring_shift(buf, 1)
-        return jnp.moveaxis(out, 0, axis)
+        go = me * c_out + jnp.arange(c_out, dtype=idt)
+        valid = go < n - 1
+        srcs = (jnp.where(valid, go, jnp.asarray(-1, idt)),
+                jnp.where(valid, go + 1, jnp.asarray(-1, idt)))
+        outs = [jnp.zeros((c_out,) + buf.shape[1:], buf.dtype)
+                for _ in srcs]
+        for perm, table in rounds:
+            blk = jax.lax.ppermute(buf, comm.axis_name, perm=perm)
+            owner = jnp.asarray(table)[me]
+            for j, src in enumerate(srcs):
+                rel = src - owner * c_in
+                hit = (owner >= 0) & (src >= 0) & (rel >= 0) & (rel < c_in)
+                take = jnp.take(blk, jnp.clip(rel, 0, c_in - 1), axis=0)
+                outs[j] = jnp.where(_row_mask(hit, buf.ndim - 1), take,
+                                    outs[j])
+        res = (outs[1] != outs[0]) if is_bool else (outs[1] - outs[0])
+        return jnp.moveaxis(res, 0, axis)
 
     spec = comm.spec(len(phys_shape), axis)
     fn = jax.jit(
@@ -278,22 +390,18 @@ def ring_reshape_fn(in_phys_shape, jdt, out_gshape, c_out: int, comm):
     total = int(np.prod(out_gshape, dtype=np.int64))
     local_in = c1 * r1
     local_out = c_out * r2
+    # the flat sequence is preserved: re-chunking is the identity map over
+    # flat positions, so each device needs ~local_out/local_in + 1 windows
+    rounds = _schedule_block_fetch(
+        _demand_blocks(lambda f: f, 0, total, p, local_out, local_in), p)
 
     def body(xb):
         flat = xb.reshape(-1)  # this device's contiguous flat range
         me = jax.lax.axis_index(comm.axis_name)
         f = me * local_out + jnp.arange(local_out, dtype=idt)  # my out slots
+        src = jnp.where(f < total, f, jnp.asarray(-1, idt))
         out = jnp.zeros((local_out,), flat.dtype)
-        q = f // r1  # source global row
-        col = f % r1
-        for k in range(p):
-            o = (me - k) % p
-            rel = (q - o * c1) * r1 + col
-            hit = (q >= o * c1) & (q < (o + 1) * c1) & (f < total)
-            take = flat[jnp.clip(rel, 0, local_in - 1)]
-            out = jnp.where(hit, take, out)
-            if k < p - 1:
-                flat = comm.ring_shift(flat, 1)
+        out = _window_gather(flat, me, src, rounds, local_in, comm, out)
         return out.reshape((c_out,) + tuple(out_gshape[1:]))
 
     fn = jax.jit(

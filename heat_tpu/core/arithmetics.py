@@ -105,6 +105,60 @@ def diff(a: DNDarray, n: int = 1, axis: int = -1, prepend=None, append=None) -> 
     if n < 0:
         raise ValueError(f"diff requires that n be a positive number, got {n}")
     axis = sanitize_axis(a.shape, axis)
+    if a.split is not None and a.comm.size > 1 and a.shape[axis] > 1:
+        from . import manipulations
+
+        # fold prepend/append into the array (distributed concat), then
+        # difference gather-free
+        if prepend is not None or append is not None:
+            for val, at_front in ((prepend, True), (append, False)):
+                if val is None:
+                    continue
+                # promote, never truncate (numpy: diff(int, prepend=0.5) is
+                # float) — review finding
+                jv = val.larray if isinstance(val, _D) else jnp.asarray(val)
+                pdt = jnp.promote_types(a.larray.dtype, jv.dtype)
+                if jnp.dtype(pdt) != jnp.dtype(a.larray.dtype):
+                    a = a.astype(types.canonical_heat_type(pdt))
+                vd = val if isinstance(val, _D) else _D.from_logical(
+                    jnp.asarray(val, pdt), None, a.device, a.comm)
+                if isinstance(val, _D) and \
+                        jnp.dtype(vd.larray.dtype) != jnp.dtype(pdt):
+                    vd = vd.astype(types.canonical_heat_type(pdt))
+                if vd.ndim == 0:
+                    shp = tuple(1 if i == axis else s
+                                for i, s in enumerate(a.gshape))
+                    vd = vd.reshape(shp)
+                pair = ([vd.resplit(a.split), a] if at_front
+                        else [a, vd.resplit(a.split)])
+                a = manipulations.concatenate(pair, axis=axis)
+            return diff(a, n=n, axis=axis)
+        if axis != a.split:
+            # shard-local: the differenced axis is unsharded
+            res = jnp.diff(a.larray, n=n, axis=axis)
+            gshape = tuple(
+                s - n if i == axis else s for i, s in enumerate(a.gshape))
+            if gshape[axis] <= 0:
+                return diff(a.resplit(None), n=n, axis=axis)
+            return _D(res, gshape, a.dtype, a.split, a.device, a.comm)
+        from . import _manips
+
+        if a.shape[axis] - n <= 0:  # numpy: repeated diffs empty out
+            gshape = tuple(0 if i == axis else s
+                           for i, s in enumerate(a.gshape))
+            return _D.from_logical(
+                jnp.zeros(gshape, a.larray.dtype), None, a.device, a.comm,
+                dtype=a.dtype)
+        out = a
+        for _ in range(n):
+            fn = _manips.split_diff_fn(
+                out.larray.shape, jnp.dtype(out.larray.dtype), axis,
+                out.shape[axis], out.comm)
+            gshape = tuple(
+                s - 1 if i == axis else s for i, s in enumerate(out.gshape))
+            out = _D(fn(out.larray), gshape, out.dtype, axis, out.device,
+                     out.comm)
+        return out
     logical = a._logical()
     kwargs = {}
     for name, val in (("prepend", prepend), ("append", append)):

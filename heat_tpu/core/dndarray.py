@@ -1393,6 +1393,81 @@ def _slice_len(sl: slice, n: int) -> builtins.int:
     return len(range(*sl.indices(n)))
 
 
+def _getitem_paired_arrays(x: DNDarray, key) -> Optional[DNDarray]:
+    """Paired integer-array keys over the LEADING axes (reference
+    ``dndarray.py:656-912`` multi-array cases, e.g. ``x[rows, cols]``):
+    the advanced group collapses to ONE flat index via ravel_multi_index,
+    the leading axes merge through the distributed reshape (O(chunk) ring),
+    and the flat single-array ring path finishes the job. Requires >= 2
+    advanced indices (ints count), all at axes ``0..g`` with the split axis
+    inside the group and only full/basic slices after — NumPy places the
+    broadcast dims first there, which matches the flat result layout."""
+    if x.split is None or x.comm.size <= 1 or x.ndim < 2:
+        return None
+    keys = list(key) if isinstance(key, tuple) else [key]
+    if any(k is None or isinstance(k, builtins.bool) for k in keys):
+        return None
+    if any(k is Ellipsis for k in keys):
+        i = next(j for j, k in enumerate(keys) if k is Ellipsis)
+        n_explicit = sum(_index_axis_span(k) for k in keys if k is not Ellipsis)
+        keys[i:i + 1] = [slice(None)] * (x.ndim - n_explicit)
+        if any(k is Ellipsis for k in keys):
+            return None
+    keys += [slice(None)] * (x.ndim - sum(_index_axis_span(k) for k in keys))
+    if len(keys) != x.ndim:
+        return None
+
+    def as_idx(k):
+        if isinstance(k, builtins.int):
+            return np.asarray(k)
+        if isinstance(k, list):
+            k = np.asarray(k)
+        if isinstance(k, DNDarray):
+            if k.larray.dtype == jnp.bool_:
+                return None
+            k = np.asarray(k.numpy())
+        if isinstance(k, (np.ndarray, jnp.ndarray)):
+            if k.dtype == np.bool_ or k.ndim > 1:
+                return None
+            return np.asarray(k, dtype=np.int64)
+        return None
+
+    adv = [i for i, k in enumerate(keys) if not isinstance(k, slice)]
+    n_arrays = sum(1 for i in adv
+                   if not isinstance(keys[i], builtins.int))
+    if n_arrays < 2 or adv != list(range(len(adv))):
+        return None  # single-array keys belong to the mixed path
+    g = len(adv)
+    if not (x.split < g):
+        return None
+    idxs = []
+    for i in range(g):
+        arr = as_idx(keys[i])
+        if arr is None:
+            return None
+        n_i = x.gshape[i]
+        arr = np.where(arr < 0, arr + n_i, arr)
+        if arr.size and ((arr < 0).any() or (arr >= n_i).any()):
+            raise IndexError(
+                f"index out of bounds for axis {i} with size {n_i}")
+        idxs.append(arr)
+    try:
+        m = np.broadcast_shapes(*[a.shape for a in idxs])
+    except ValueError:
+        return None
+    if len(m) != 1:
+        return None
+    idxs = [np.broadcast_to(a, m).astype(np.int64) for a in idxs]
+    combined = np.ravel_multi_index(tuple(idxs), x.gshape[:g])
+    from . import manipulations
+
+    flat_shape = (int(np.prod(x.gshape[:g], dtype=np.int64)),) + x.gshape[g:]
+    xm = manipulations.reshape(x, flat_shape, new_split=0)
+    rest = tuple(keys[g:])
+    sub_key = (combined,) + rest if rest else combined
+    return _getitem_impl(xm, sub_key)
+
+
 def _getitem_mixed(x: DNDarray, keys, arr_pos, kind, arr) -> Optional[DNDarray]:
     """Execute a mixed key from :func:`_match_mixed_key` without logical
     materialization. Array at the split axis: apply the basic keys
@@ -1566,6 +1641,9 @@ def _getitem_impl(x: DNDarray, key):
         res = _getitem_mixed(x, *mixed)
         if res is not None:
             return res
+    paired = _getitem_paired_arrays(x, key)
+    if paired is not None:
+        return paired
     key = _normalize_key(x, key)
     if _basic_key_fast_path(x, key):
         sub = x.larray[key]

@@ -129,7 +129,33 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
 
 
 def cross(a: DNDarray, b: DNDarray, axisa: int = -1, axisb: int = -1, axisc: int = -1, axis: int = -1) -> DNDarray:
-    """Vector cross product (reference ``basics.py:60``)."""
+    """Vector cross product (reference ``basics.py:60``).
+
+    ``axis`` overrides ``axisa``/``axisb``/``axisc`` exactly as in the
+    reference (``basics.py:97-100``). The product is elementwise across the
+    batch dims, so matching split operands with the vector axis unsharded
+    compute shard-locally (where the reference *raises* for split == axisa,
+    ``basics.py:105``); mismatched layouts fall back to the logical path."""
+    if axis != -1:
+        # explicit axis overrides the per-operand axes (reference
+        # ``basics.py:97-100``); the all-defaults case keeps -1 so operands
+        # of different ndim still broadcast (review finding)
+        axisa = axisb = axisc = sanitize_axis(a.shape, axis)
+    va = sanitize_axis(a.shape, axisa)
+    if (
+        a.split is not None
+        and a.split == b.split
+        and a.gshape == b.gshape
+        and a.larray.shape == b.larray.shape
+        and va == sanitize_axis(b.shape, axisb) == sanitize_axis(a.shape, axisc)
+        and a.split != va
+        and a.shape[va] == 3  # 3-vectors keep the axis: shape is preserved
+    ):
+        res = jnp.cross(a.larray, b.larray, axisa=axisa, axisb=axisb,
+                        axisc=axisc)
+        return DNDarray(
+            res, a.gshape, types.canonical_heat_type(res.dtype),
+            a.split, a.device, a.comm)
     res = jnp.cross(a._logical(), b._logical(), axisa=axisa, axisb=axisb, axisc=axisc)
     return DNDarray.from_logical(res, a.split, a.device, a.comm)
 

@@ -17,6 +17,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import shard_map
 
 from . import _operations, arithmetics, types
 from .dndarray import DNDarray
@@ -153,10 +154,59 @@ def _axes(x, axis):
     return (axis,) if isinstance(axis, int) else axis
 
 
+def _aligned_weight_phys(x: DNDarray, weights):
+    """Weights as a physical array aligned with ``x``'s shards (same split,
+    same chunks), or None when the alignment needs a fallback."""
+    if weights is None:
+        return jnp.ones(x.larray.shape, jnp.float64 if jax.config.jax_enable_x64
+                        else jnp.float32)
+    if isinstance(weights, DNDarray):
+        if weights.split == x.split and weights.larray.shape == x.larray.shape:
+            return weights.larray
+        return None
+    w = jnp.asarray(weights)
+    if x.split is None or w.shape != x.gshape:
+        return w if w.shape == x.larray.shape else None
+    pad = x.larray.shape[x.split] - x.gshape[x.split]
+    if pad:
+        cfg = [(0, pad if i == x.split else 0) for i in range(x.ndim)]
+        w = jnp.pad(w, cfg)
+    return jax.device_put(w, x.comm.sharding(x.ndim, x.split))
+
+
 def bincount(x: DNDarray, weights=None, minlength: int = 0) -> DNDarray:
-    """Count occurrences of non-negative ints (reference ``statistics.py:389``)."""
+    """Count occurrences of non-negative ints (reference ``statistics.py:389``).
+
+    Split arrays count shard-locally and merge with one psum (the
+    reference's Allreduce of per-rank counts); only the global max (the
+    output length — a dynamic shape) syncs to host."""
     if not types.heat_type_is_exact(x.dtype):
         raise TypeError("bincount requires an integer array")
+    if x.split is not None and x.comm.size > 1 and x.ndim == 1 and x.size > 0:
+        comm = x.comm
+        lo = int(jnp.min(x.filled(0)))
+        if lo < 0:
+            raise ValueError("bincount requires non-negative entries")
+        # NB: plain ``max`` is this module's reduction, not the builtin
+        length = int(np.maximum(int(minlength), int(jnp.max(x.filled(0))) + 1))
+        w_phys = _aligned_weight_phys(x, weights)
+        if w_phys is not None:
+            valid = x.valid_mask()
+            wdt = (jnp.int64 if jax.config.jax_enable_x64 else jnp.int32) \
+                if weights is None else w_phys.dtype
+
+            def body(xb, wb, vb):
+                wv = jnp.where(vb, wb.astype(wdt), 0)
+                counts = jnp.bincount(
+                    jnp.clip(xb, 0, length - 1), weights=wv, length=length)
+                return jax.lax.psum(counts, comm.axis_name)
+
+            fn = jax.jit(shard_map(
+                body, mesh=comm.mesh,
+                in_specs=(comm.spec(1, 0),) * 3,
+                out_specs=comm.spec(1, None), check_vma=False))
+            res = fn(x.larray, w_phys, valid)
+            return DNDarray.from_logical(res, None, x.device, comm)
     logical = x._logical()
     w = None
     if weights is not None:
@@ -213,10 +263,63 @@ def cov(m: DNDarray, y=None, rowvar: bool = True, bias: bool = False, ddof=None)
     return arithmetics.div(c, float(norm))
 
 
+def _hist_counts_distributed(x: DNDarray, edges, weights):
+    """psum of per-shard histograms against fixed ``edges`` (the
+    reference's Allreduce of local torch.histc counts), or None when the
+    weights cannot be chunk-aligned."""
+    comm = x.comm
+    w_phys = _aligned_weight_phys(x, weights)
+    if w_phys is None:
+        return None
+    wdt = (jnp.int64 if jax.config.jax_enable_x64 else jnp.int32) \
+        if weights is None else w_phys.dtype
+    edges_j = jnp.asarray(edges)
+
+    def body(xb, wb, vb):
+        wv = jnp.where(vb, wb.astype(wdt), 0).reshape(-1)
+        h, _ = jnp.histogram(xb.reshape(-1), bins=edges_j, weights=wv)
+        return jax.lax.psum(h, comm.axis_name)
+
+    fn = jax.jit(shard_map(
+        body, mesh=comm.mesh,
+        in_specs=(comm.spec(x.ndim, x.split),) * 3,
+        out_specs=comm.spec(1, None), check_vma=False))
+    return fn(x.larray, w_phys, x.valid_mask())
+
+
+def _minmax_scalars(x: DNDarray):
+    """Global (min, max) with padding neutralized — two scalar fetches."""
+    jdt = x.larray.dtype
+    if jdt == jnp.bool_:
+        hi_fill, lo_fill = False, True
+    elif jnp.issubdtype(jdt, jnp.inexact):
+        hi_fill, lo_fill = -jnp.inf, jnp.inf
+    else:
+        info = jnp.iinfo(jdt)
+        hi_fill, lo_fill = info.min, info.max
+    lo = float(jnp.min(x.filled(lo_fill)))
+    hi = float(jnp.max(x.filled(hi_fill)))
+    return lo, hi
+
+
 def histc(input: DNDarray, bins: int = 100, min=0, max=0, out=None) -> DNDarray:
-    """Histogram with uniform bins (reference ``statistics.py:660``)."""
-    logical = input._logical().reshape(-1)
+    """Histogram with uniform bins (reference ``statistics.py:660``): split
+    arrays histogram shard-locally against the shared edges and merge with
+    one psum."""
     lo, hi = float(min), float(max)
+    if input.split is not None and input.comm.size > 1 and input.size > 0:
+        if lo == 0 and hi == 0:
+            lo, hi = _minmax_scalars(input)
+        if lo == hi:  # degenerate range expands like jnp.histogram's
+            lo, hi = lo - 0.5, hi + 0.5
+        edges = np.linspace(lo, hi, int(bins) + 1)
+        res = _hist_counts_distributed(input, edges, None)
+        if res is not None:
+            result = DNDarray.from_logical(
+                res.astype(input.dtype.jax_type()), None, input.device,
+                input.comm)
+            return _operations._finalize(result, out)
+    logical = input._logical().reshape(-1)
     if lo == 0 and hi == 0:
         lo = float(logical.min()) if logical.size else 0.0
         hi = float(logical.max()) if logical.size else 1.0
@@ -226,7 +329,35 @@ def histc(input: DNDarray, bins: int = 100, min=0, max=0, out=None) -> DNDarray:
 
 
 def histogram(a: DNDarray, bins=10, range=None, normed=None, weights=None, density=None):
-    """NumPy-style histogram (reference ``statistics.py:700``)."""
+    """NumPy-style histogram (reference ``statistics.py:700``): split arrays
+    histogram shard-locally against shared edges and merge with one psum;
+    density normalizes after the merge."""
+    if (
+        a.split is not None
+        and a.comm.size > 1
+        and a.size > 0
+        and not isinstance(bins, DNDarray)
+    ):
+        if np.ndim(bins) == 0:
+            if range is not None:
+                lo, hi = float(range[0]), float(range[1])
+            else:
+                lo, hi = _minmax_scalars(a)
+                if lo == hi:
+                    lo, hi = lo - 0.5, hi + 0.5
+            edges = np.linspace(lo, hi, int(bins) + 1)
+        else:
+            edges = np.asarray(bins, dtype=np.float64)
+        res = _hist_counts_distributed(a, edges, weights)
+        if res is not None:
+            if density:
+                total = float(jnp.sum(res))
+                res = res / (total * jnp.asarray(np.diff(edges)))
+            return (
+                DNDarray.from_logical(res, None, a.device, a.comm),
+                DNDarray.from_logical(jnp.asarray(edges), None, a.device,
+                                      a.comm),
+            )
     logical = a._logical().reshape(-1)
     w = weights._logical().reshape(-1) if isinstance(weights, DNDarray) else weights
     hist, edges = jnp.histogram(logical, bins=bins, range=range, weights=w, density=density)

@@ -1,0 +1,72 @@
+"""Distributed diff along the split axis (reference ``arithmetics.py:377``):
+two-source window fetch, re-chunked output, no gather."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+rng = np.random.default_rng(41)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3])
+def test_diff_orders(n):
+    a = rng.standard_normal(29).astype(np.float32)
+    x = ht.array(a, split=0)
+    np.testing.assert_allclose(np.asarray(ht.diff(x, n=n).numpy()),
+                               np.diff(a, n=n), rtol=1e-4, atol=1e-5)
+
+
+def test_diff_2d_both_axes():
+    a = rng.standard_normal((13, 6)).astype(np.float32)
+    x = ht.array(a, split=0)
+    np.testing.assert_allclose(np.asarray(ht.diff(x, axis=0).numpy()),
+                               np.diff(a, axis=0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ht.diff(x, axis=1).numpy()),
+                               np.diff(a, axis=1), rtol=1e-5)
+
+
+def test_diff_prepend_append():
+    a = rng.standard_normal(17).astype(np.float32)
+    x = ht.array(a, split=0)
+    np.testing.assert_allclose(
+        np.asarray(ht.diff(x, prepend=1.5).numpy()),
+        np.diff(a, prepend=1.5), rtol=1e-5)
+    app = np.array([0.5, -0.5], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(ht.diff(x, append=app).numpy()),
+        np.diff(a, append=app), rtol=1e-5)
+
+
+def test_diff_bool_is_xor():
+    b = rng.random(19) > 0.5
+    np.testing.assert_array_equal(
+        np.asarray(ht.diff(ht.array(b, split=0)).numpy()), np.diff(b))
+
+
+def test_diff_over_length_empty():
+    x = ht.array(np.arange(5, dtype=np.float32), split=0)
+    assert ht.diff(x, n=7).shape == (0,)
+
+
+def test_diff_prepend_promotes_dtype():
+    # review regression: int array + float prepend must promote, not
+    # truncate (split and unsplit paths must agree)
+    x = ht.array(np.arange(8, dtype=np.int32), split=0)
+    out = ht.diff(x, prepend=0.5)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.diff(np.arange(8), prepend=0.5))
+
+
+def test_diff_no_gather(monkeypatch):
+    a = rng.standard_normal(21).astype(np.float32)
+    x = ht.array(a, split=0)
+
+    def boom(self):  # pragma: no cover
+        raise AssertionError("diff materialized the logical array")
+
+    monkeypatch.setattr(ht.DNDarray, "_logical", boom)
+    out = ht.diff(x)
+    monkeypatch.undo()
+    np.testing.assert_allclose(np.asarray(out.numpy()), np.diff(a), rtol=1e-5)

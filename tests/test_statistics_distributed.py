@@ -1,0 +1,124 @@
+"""Distributed bincount/histc/histogram (reference ``statistics.py:389,660,
+700``: local counts + Allreduce; here local counts + one psum, no gather)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+rng = np.random.default_rng(31)
+
+
+class TestBincount:
+    def test_basic_uneven(self):
+        a = rng.integers(0, 9, 43).astype(np.int32)
+        x = ht.array(a, split=0)
+        np.testing.assert_array_equal(
+            np.asarray(ht.bincount(x).numpy()), np.bincount(a))
+
+    def test_minlength(self):
+        a = np.array([1, 1, 3], np.int32)
+        x = ht.array(a, split=0)
+        np.testing.assert_array_equal(
+            np.asarray(ht.bincount(x, minlength=8).numpy()),
+            np.bincount(a, minlength=8))
+
+    def test_weights(self):
+        a = rng.integers(0, 5, 21).astype(np.int32)
+        w = rng.random(21).astype(np.float32)
+        x = ht.array(a, split=0)
+        np.testing.assert_allclose(
+            np.asarray(ht.bincount(x, weights=w).numpy()),
+            np.bincount(a, weights=w), rtol=1e-5)
+
+    def test_split_weights(self):
+        a = rng.integers(0, 4, 17).astype(np.int32)
+        w = rng.random(17).astype(np.float32)
+        x = ht.array(a, split=0)
+        wd = ht.array(w, split=0)
+        np.testing.assert_allclose(
+            np.asarray(ht.bincount(x, weights=wd).numpy()),
+            np.bincount(a, weights=w), rtol=1e-5)
+
+    def test_negative_raises(self):
+        x = ht.array(np.array([1, -2, 3], np.int32), split=0)
+        with pytest.raises(ValueError):
+            ht.bincount(x)
+
+    def test_no_gather(self, monkeypatch):
+        a = rng.integers(0, 6, 29).astype(np.int32)
+        x = ht.array(a, split=0)
+
+        def boom(self):  # pragma: no cover
+            raise AssertionError("bincount materialized the logical array")
+
+        monkeypatch.setattr(ht.DNDarray, "_logical", boom)
+        out = ht.bincount(x)
+        monkeypatch.undo()
+        np.testing.assert_array_equal(np.asarray(out.numpy()), np.bincount(a))
+
+
+class TestHistogram:
+    def test_histc(self):
+        a = rng.standard_normal(37).astype(np.float32)
+        x = ht.array(a, split=0)
+        got = np.asarray(ht.histc(x, bins=7, min=-2.0, max=2.0).numpy())
+        want, _ = np.histogram(a, bins=7, range=(-2.0, 2.0))
+        np.testing.assert_array_equal(got, want)
+
+    def test_histc_auto_range(self):
+        a = rng.standard_normal(25).astype(np.float32)
+        x = ht.array(a, split=0)
+        got = np.asarray(ht.histc(x, bins=5).numpy())
+        want, _ = np.histogram(a, bins=5, range=(a.min(), a.max()))
+        np.testing.assert_array_equal(got, want)
+
+    def test_histogram_counts_and_edges(self):
+        a = rng.standard_normal(41).astype(np.float32)
+        x = ht.array(a, split=0)
+        hist, edges = ht.histogram(x, bins=6)
+        want, wedges = np.histogram(a, bins=6, range=(a.min(), a.max()))
+        np.testing.assert_array_equal(np.asarray(hist.numpy()), want)
+        np.testing.assert_allclose(np.asarray(edges.numpy()), wedges,
+                                   rtol=1e-5)
+
+    def test_histogram_explicit_edges(self):
+        a = rng.random(33).astype(np.float32)
+        x = ht.array(a, split=0)
+        edges = np.array([0.0, 0.25, 0.5, 1.0])
+        hist, _ = ht.histogram(x, bins=edges)
+        want, _ = np.histogram(a, bins=edges)
+        np.testing.assert_array_equal(np.asarray(hist.numpy()), want)
+
+    def test_histogram_weights_density(self):
+        a = rng.random(29).astype(np.float32)
+        w = rng.random(29).astype(np.float32)
+        x = ht.array(a, split=0)
+        hist, edges = ht.histogram(x, bins=4, range=(0.0, 1.0), weights=w,
+                                   density=True)
+        want, _ = np.histogram(a, bins=4, range=(0.0, 1.0), weights=w,
+                               density=True)
+        np.testing.assert_allclose(np.asarray(hist.numpy()), want, rtol=1e-4)
+
+    def test_histc_all_equal_degenerate_range(self):
+        # review regression: distributed histc must expand a lo==hi range
+        # exactly like jnp.histogram does
+        x = ht.array(np.full(8, 5.0, np.float32), split=0)
+        got = np.asarray(ht.histc(x, bins=4).numpy())
+        want, _ = np.histogram(np.full(8, 5.0), bins=4, range=(4.5, 5.5))
+        np.testing.assert_array_equal(got, want)
+
+    def test_histogram_bool_input(self):
+        # review regression: bool dtype must not hit jnp.iinfo
+        b = np.array([True, False, True, True] * 4)
+        h, _ = ht.histogram(ht.array(b, split=0), bins=4)
+        want, _ = np.histogram(b, bins=4)
+        np.testing.assert_array_equal(np.asarray(h.numpy()), want)
+
+    def test_histogram_2d_input(self):
+        a = rng.standard_normal((9, 5)).astype(np.float32)
+        x = ht.array(a, split=0)
+        hist, _ = ht.histogram(x, bins=5, range=(-2.0, 2.0))
+        want, _ = np.histogram(a, bins=5, range=(-2.0, 2.0))
+        np.testing.assert_array_equal(np.asarray(hist.numpy()), want)
