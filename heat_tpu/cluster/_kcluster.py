@@ -76,8 +76,10 @@ class _KCluster(ClusteringMixin, BaseEstimator):
             return
         if self.init == "random":
             idx = ht_random.randint(0, x.shape[0], (k,), split=None, comm=x.comm)
-            rows = x._logical()[idx._logical()]
-            self._cluster_centers = DNDarray.from_logical(rows, None, x.device, x.comm)
+            # ring-gather the k sampled rows (the reference Bcasts each
+            # sampled row, ``_kcluster.py:87-194``) — no materialization
+            rows = x[np.asarray(idx.larray)].resplit(None)
+            self._cluster_centers = rows
             return
         if self.init in ("kmeans++", "probability_based"):
             self._cluster_centers = self._kmeanspp(x)
@@ -103,14 +105,18 @@ class _KCluster(ClusteringMixin, BaseEstimator):
         n = x.shape[0]
         k = self.n_clusters
         first = int(ht_random.randint(0, n, (1,), comm=x.comm)._logical()[0])
-        centers = x._logical()[first][None, :]
+
+        def row(i):  # one sampled row, ring-gathered — never the array
+            return x[np.asarray([i])].resplit(None)._logical()
+
+        centers = row(first)
         for _ in range(1, k):
             d2 = np.asarray(self._pairwise_sq_dist_to(x, centers))  # (n,), host
             u = float(ht_random.rand(1, comm=x.comm)._logical()[0])
             total = max(float(d2.sum()), 1e-30)
             cdf = np.cumsum(d2 / total)
             nxt = min(int(np.searchsorted(cdf, u)), n - 1)
-            centers = jnp.concatenate([centers, x._logical()[nxt][None, :]], axis=0)
+            centers = jnp.concatenate([centers, row(nxt)], axis=0)
         return DNDarray.from_logical(centers, None, x.device, x.comm)
 
     def _pairwise_sq_dist_to(self, x: DNDarray, centers) -> jnp.ndarray:

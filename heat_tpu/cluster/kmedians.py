@@ -1,23 +1,92 @@
 """KMedians clustering (reference ``heat/cluster/kmedians.py``).
 
 Same Lloyd skeleton as KMeans but the centroid update is the per-cluster
-coordinate-wise **median**; implemented as a masked ``nanmedian`` over the
-gathered per-cluster columns (order statistics are data-dependent; k and d
-are small, n is sharded for the assignment step).
+coordinate-wise **median**. Fully distributed: one jitted shard_map program
+per iteration runs the manhattan assignment shard-locally, then for each
+cluster sorts the member-masked columns with the block merge-split network
+(non-members and padding carry +inf keys, so the valid order statistics
+occupy the leading global positions) and selects the median ranks with two
+masked psums — the data is never gathered (the reference runs
+``ht.percentile`` per cluster over the split array the same way).
 """
 
 from __future__ import annotations
 
 from typing import Optional, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import shard_map
 
 from ..core.dndarray import DNDarray
 from ..core import types
+from ..core._sort import (_float_sort_key, _index_dtype, _network_sort,
+                          _role_tables, batcher_rounds)
 from ._kcluster import _KCluster
 
 __all__ = ["KMedians"]
+
+_STEP_CACHE: dict = {}
+
+
+def _kmedians_step_fn(phys_shape, k: int, n: int, comm):
+    """Jitted ``(x_phys, centroids) -> (new_centroids, shift, labels_phys)``:
+    one full Lloyd/median iteration over the mesh."""
+    key = ("kmed", tuple(phys_shape), k, n, comm.cache_key)
+    fn = _STEP_CACHE.get(key)
+    if fn is not None:
+        return fn
+    p = comm.size
+    c = phys_shape[0] // p
+    d = phys_shape[1]
+    rounds = batcher_rounds(p)
+    roles = _role_tables(rounds, p)
+    idt = _index_dtype()
+    kdt = jnp.int32  # float32 sort keys
+    pad_key = jnp.iinfo(kdt).max
+
+    def body(xb, cent):
+        me = jax.lax.axis_index(comm.axis_name)
+        gpos = me * c + jnp.arange(c, dtype=idt)
+        valid = gpos < n
+        dist = jnp.sum(jnp.abs(xb[:, None, :] - cent[None, :, :]), axis=-1)
+        labels = jnp.argmin(dist, axis=1)
+        member = (labels[:, None] == jnp.arange(k)[None, :]) & valid[:, None]
+        counts = jax.lax.psum(jnp.sum(member.astype(idt), axis=0),
+                              comm.axis_name)  # (k,)
+        meds = []
+        for j in range(k):
+            vals = xb.T  # (d, c)
+            mask = member[:, j][None, :]  # (1, c) broadcast over features
+            keys = jnp.where(mask, _float_sort_key(vals), pad_key)
+            _, (sv,) = _network_sort(keys, (vals,), rounds, roles, c, False,
+                                     comm.axis_name)
+            cnt = counts[j]
+            lo = jnp.maximum(cnt - 1, 0) // 2
+            hi = cnt // 2
+            vlo = jax.lax.psum(
+                jnp.sum(jnp.where((gpos == lo)[None, :], sv, 0), axis=1),
+                comm.axis_name)  # (d,)
+            vhi = jax.lax.psum(
+                jnp.sum(jnp.where((gpos == hi)[None, :], sv, 0), axis=1),
+                comm.axis_name)
+            med = 0.5 * (vlo + vhi)
+            meds.append(jnp.where(cnt > 0, med, cent[j]))
+        new_cent = jnp.stack(meds)
+        shift = jnp.sum((new_cent - cent) ** 2)
+        return new_cent, shift, labels
+
+    spec_x = comm.spec(2, 0)
+    fn = jax.jit(
+        shard_map(
+            body, mesh=comm.mesh, in_specs=(spec_x, comm.spec(2, None)),
+            out_specs=(comm.spec(2, None), comm.spec(0, None),
+                       comm.spec(1, 0)),
+            check_vma=False)
+    )
+    _STEP_CACHE[key] = fn
+    return fn
 
 
 class KMedians(_KCluster):
@@ -50,9 +119,27 @@ class KMedians(_KCluster):
         self._initialize_cluster_centers(x)
 
         k = self.n_clusters
-        logical = x._logical().astype(jnp.float32)
+        xp = x.larray.astype(jnp.float32)
         centroids = self._cluster_centers._logical().astype(jnp.float32)
+        n = x.shape[0]
 
+        if x.split == 0 and x.comm.size > 1 and n > 0:
+            step = _kmedians_step_fn(xp.shape, k, n, x.comm)
+            it = 0
+            labels = None
+            for it in range(1, self.max_iter + 1):
+                centroids, shift, labels = step(xp, centroids)
+                if self.tol >= 0 and float(shift) <= self.tol * self.tol:
+                    break
+            self._cluster_centers = DNDarray.from_logical(
+                centroids, None, x.device, x.comm)
+            self._labels = DNDarray(
+                labels, (n,), types.canonical_heat_type(labels.dtype), 0,
+                x.device, x.comm)
+            self._n_iter = it
+            return self
+
+        logical = x._logical().astype(jnp.float32)
         it = 0
         for it in range(1, self.max_iter + 1):
             labels = self._assign_labels(logical, centroids)

@@ -233,8 +233,8 @@ def ring_repeat_fn(phys_shape, jdt, axis: int, n: int, rep: int, c_out: int,
                    comm):
     """Jitted ``x_physical -> out_physical``: each valid row ``g`` fans out
     to output rows ``g*rep .. g*rep+rep-1`` along the split axis (reference
-    ``repeat``, ``manipulations.py:1770``, scalar repeats). One ring pass
-    with ``rep`` scatter sub-steps per rotation."""
+    ``repeat``, ``manipulations.py:1770``, scalar repeats). Receiver-side
+    map ``src(go) = go // rep`` through the scheduled window fetch."""
     key = ("rrepeat", tuple(phys_shape), str(jdt), axis, n, rep, c_out,
            comm.cache_key)
     if key in _MANIP_CACHE:

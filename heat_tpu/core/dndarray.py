@@ -1423,11 +1423,14 @@ def _getitem_paired_arrays(x: DNDarray, key) -> Optional[DNDarray]:
         if isinstance(k, list):
             k = np.asarray(k)
         if isinstance(k, DNDarray):
-            if k.larray.dtype == jnp.bool_:
+            if not jnp.issubdtype(k.larray.dtype, jnp.integer):
                 return None
             k = np.asarray(k.numpy())
         if isinstance(k, (np.ndarray, jnp.ndarray)):
-            if k.dtype == np.bool_ or k.ndim > 1:
+            # only true integer indexers: float arrays must keep falling to
+            # the general path, which rejects them like NumPy (review
+            # finding: silent truncation)
+            if k.ndim > 1 or not np.issubdtype(np.asarray(k).dtype, np.integer):
                 return None
             return np.asarray(k, dtype=np.int64)
         return None

@@ -138,9 +138,10 @@ def cross(a: DNDarray, b: DNDarray, axisa: int = -1, axisb: int = -1, axisc: int
     ``basics.py:105``); mismatched layouts fall back to the logical path."""
     if axis != -1:
         # explicit axis overrides the per-operand axes (reference
-        # ``basics.py:97-100``); the all-defaults case keeps -1 so operands
-        # of different ndim still broadcast (review finding)
-        axisa = axisb = axisc = sanitize_axis(a.shape, axis)
+        # ``basics.py:97-100``); keep it RELATIVE — jnp.cross resolves it
+        # against each operand, so different-ndim operands still broadcast
+        # (review findings, twice)
+        axisa = axisb = axisc = axis
     va = sanitize_axis(a.shape, axisa)
     if (
         a.split is not None

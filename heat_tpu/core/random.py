@@ -267,5 +267,10 @@ def permutation(x, split=None, device=None, comm=None) -> DNDarray:
         x = factories.array(x, split=split, device=device, comm=comm)
     n = x.shape[0]
     perm = randperm(n, split=None, comm=x.comm)
+    if x.split is not None and x.comm.size > 1 and n > 0:
+        # same permutation stream, gather-free application: split-0 rows go
+        # through the ring-gather getitem; other splits row-select locally
+        idx = np.asarray(perm.larray)
+        return x[idx]
     logical = x._logical()[perm._logical()]
     return DNDarray.from_logical(logical, x.split, x.device, x.comm, dtype=x.dtype)

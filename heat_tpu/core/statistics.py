@@ -154,6 +154,9 @@ def _axes(x, axis):
     return (axis,) if isinstance(axis, int) else axis
 
 
+_COUNT_CACHE: dict = {}
+
+
 def _aligned_weight_phys(x: DNDarray, weights):
     """Weights as a physical array aligned with ``x``'s shards (same split,
     same chunks), or None when the alignment needs a fallback."""
@@ -194,17 +197,22 @@ def bincount(x: DNDarray, weights=None, minlength: int = 0) -> DNDarray:
             valid = x.valid_mask()
             wdt = (jnp.int64 if jax.config.jax_enable_x64 else jnp.int32) \
                 if weights is None else w_phys.dtype
+            cache_key = ("bincount", x.larray.shape, str(x.larray.dtype),
+                         length, str(jnp.dtype(wdt)), comm.cache_key)
+            fn = _COUNT_CACHE.get(cache_key)
+            if fn is None:
+                def body(xb, wb, vb):
+                    wv = jnp.where(vb, wb.astype(wdt), 0)
+                    counts = jnp.bincount(
+                        jnp.clip(xb, 0, length - 1), weights=wv,
+                        length=length)
+                    return jax.lax.psum(counts, comm.axis_name)
 
-            def body(xb, wb, vb):
-                wv = jnp.where(vb, wb.astype(wdt), 0)
-                counts = jnp.bincount(
-                    jnp.clip(xb, 0, length - 1), weights=wv, length=length)
-                return jax.lax.psum(counts, comm.axis_name)
-
-            fn = jax.jit(shard_map(
-                body, mesh=comm.mesh,
-                in_specs=(comm.spec(1, 0),) * 3,
-                out_specs=comm.spec(1, None), check_vma=False))
+                fn = jax.jit(shard_map(
+                    body, mesh=comm.mesh,
+                    in_specs=(comm.spec(1, 0),) * 3,
+                    out_specs=comm.spec(1, None), check_vma=False))
+                _COUNT_CACHE[cache_key] = fn
             res = fn(x.larray, w_phys, valid)
             return DNDarray.from_logical(res, None, x.device, comm)
     logical = x._logical()
@@ -273,17 +281,23 @@ def _hist_counts_distributed(x: DNDarray, edges, weights):
         return None
     wdt = (jnp.int64 if jax.config.jax_enable_x64 else jnp.int32) \
         if weights is None else w_phys.dtype
-    edges_j = jnp.asarray(edges)
+    edges = np.asarray(edges, dtype=np.float64)
+    cache_key = ("hist", x.larray.shape, str(x.larray.dtype), x.split,
+                 edges.tobytes(), str(jnp.dtype(wdt)), comm.cache_key)
+    fn = _COUNT_CACHE.get(cache_key)
+    if fn is None:
+        edges_j = jnp.asarray(edges)
 
-    def body(xb, wb, vb):
-        wv = jnp.where(vb, wb.astype(wdt), 0).reshape(-1)
-        h, _ = jnp.histogram(xb.reshape(-1), bins=edges_j, weights=wv)
-        return jax.lax.psum(h, comm.axis_name)
+        def body(xb, wb, vb):
+            wv = jnp.where(vb, wb.astype(wdt), 0).reshape(-1)
+            h, _ = jnp.histogram(xb.reshape(-1), bins=edges_j, weights=wv)
+            return jax.lax.psum(h, comm.axis_name)
 
-    fn = jax.jit(shard_map(
-        body, mesh=comm.mesh,
-        in_specs=(comm.spec(x.ndim, x.split),) * 3,
-        out_specs=comm.spec(1, None), check_vma=False))
+        fn = jax.jit(shard_map(
+            body, mesh=comm.mesh,
+            in_specs=(comm.spec(x.ndim, x.split),) * 3,
+            out_specs=comm.spec(1, None), check_vma=False))
+        _COUNT_CACHE[cache_key] = fn
     return fn(x.larray, w_phys, x.valid_mask())
 
 
@@ -343,8 +357,8 @@ def histogram(a: DNDarray, bins=10, range=None, normed=None, weights=None, densi
                 lo, hi = float(range[0]), float(range[1])
             else:
                 lo, hi = _minmax_scalars(a)
-                if lo == hi:
-                    lo, hi = lo - 0.5, hi + 0.5
+            if lo == hi:  # numpy expands degenerate ranges, explicit or not
+                lo, hi = lo - 0.5, hi + 0.5
             edges = np.linspace(lo, hi, int(bins) + 1)
         else:
             edges = np.asarray(bins, dtype=np.float64)
