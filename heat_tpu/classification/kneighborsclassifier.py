@@ -34,21 +34,31 @@ class KNeighborsClassifier(ClassificationMixin, BaseEstimator):
         return self
 
     def predict(self, x: DNDarray) -> DNDarray:
-        """Vote among the k nearest training points (reference ``:80-136``)."""
+        """Vote among the k nearest training points (reference ``:80-136``).
+
+        The distance matrix stays split over the test rows — the k-nearest
+        selection and the vote are per-row local against the replicated
+        training labels, so only the winning labels exist per shard."""
         if self.x is None:
             raise RuntimeError("fit needs to be called before predict")
+        from ..core import types as _types
         from ..spatial.distance import cdist
 
+        if x.split not in (None, 0):
+            x = x.resplit(0)
         d = cdist(x, self.x.resplit(None), quadratic_expansion=True)
-        dl = d._logical()
         k = self.n_neighbors
         import jax
 
-        # k smallest distances → indices
-        _, idx = jax.lax.top_k(-dl, k)  # (n_test, k)
-        yl = self.y._logical().reshape(-1)
-        labels = yl[idx]  # (n_test, k)
+        # k smallest distances → indices; axis 1 is unsharded, so top_k is
+        # shard-local on the physical rows (padding rows produce garbage
+        # votes that stay in padding)
+        _, idx = jax.lax.top_k(-d.larray, k)  # (n_test_phys, k)
+        yl = self.y.resplit(None)._logical().reshape(-1)
+        labels = yl[idx]  # (n_test_phys, k)
         classes = jnp.unique(yl)
         votes = jnp.sum(labels[:, :, None] == classes[None, None, :], axis=1)
         winner = classes[jnp.argmax(votes, axis=1)]
-        return DNDarray.from_logical(winner, x.split, x.device, x.comm)
+        return DNDarray(
+            winner, (x.shape[0],), _types.canonical_heat_type(winner.dtype),
+            d.split, x.device, x.comm)

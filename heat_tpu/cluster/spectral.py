@@ -94,8 +94,9 @@ class Spectral(ClusteringMixin, BaseEstimator):
             self.n_clusters = int(jnp.argmax(gaps)) + 1
         k = int(self.n_clusters)
 
-        components = evecs._logical()[:, :k]
-        emb = DNDarray.from_logical(components, x.split, x.device, x.comm)
+        # leading-k column slice of the (possibly split) eigenvector matrix:
+        # columns are unsharded, so this is the basic shard-local getitem
+        emb = evecs[:, :k]
         if self.assign_labels == "kmeans":
             kmeans = KMeans(n_clusters=k, init="kmeans++")
             kmeans.fit(emb)

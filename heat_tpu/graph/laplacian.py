@@ -1,15 +1,41 @@
-"""Graph Laplacian construction (reference ``heat/graph/laplacian.py:73-141``)."""
+"""Graph Laplacian construction (reference ``heat/graph/laplacian.py:73-141``).
+
+Every assembly step is row-local on the physical shards: the degree vector
+(one row-sum, GSPMD psum over the column axis) replicates — O(n) floats —
+and thresholding, diagonal writes, and the D^-1/2 scaling apply per shard
+against the global row positions. The n x n similarity matrix itself is
+never gathered.
+"""
 
 from __future__ import annotations
 
 from typing import Callable, Optional
 
+import jax
 import jax.numpy as jnp
 
 from ..core import arithmetics, factories, types
 from ..core.dndarray import DNDarray
 
 __all__ = ["Laplacian"]
+
+
+def _row_positions(A: DNDarray):
+    """Global row index of every physical row (split=0) plus the row-valid
+    mask; for replicated A this is just arange."""
+    rows = A.larray.shape[0]
+    gpos = jnp.arange(rows)
+    return gpos, gpos < A.shape[0]
+
+
+def _set_diag(phys, gpos, value):
+    """Write ``value`` at the global diagonal positions of a row-split
+    (or replicated) physical block matrix."""
+    n = phys.shape[1]
+    col = jnp.clip(gpos, 0, n - 1)
+    onehot = col[:, None] == jnp.arange(n)[None, :]
+    ok = (gpos < n)[:, None] & onehot
+    return jnp.where(ok, jnp.asarray(value, phys.dtype), phys)
 
 
 class Laplacian:
@@ -47,42 +73,53 @@ class Laplacian:
         self.epsilon = (threshold_key, threshold_value)
         self.neighbours = neighbours
 
+    @staticmethod
+    def _degree_replicated(A: DNDarray):
+        """Degree vector as a replicated (n,) jnp array — O(n) floats, the
+        only cross-device product of the assembly."""
+        degree = arithmetics.sum(A, axis=1)
+        return degree.resplit(None)._logical()
+
     def _normalized_symmetric_L(self, A: DNDarray) -> DNDarray:
         """L_sym = I - D^-1/2 A D^-1/2 (reference ``laplacian.py:73``)."""
-        degree = arithmetics.sum(A, axis=1)
-        logical_A = A._logical()
-        d = degree._logical()
+        d = self._degree_replicated(A)
         inv_sqrt = jnp.where(d > 0, 1.0 / jnp.sqrt(d), 0.0)
-        L = -logical_A * inv_sqrt[:, None] * inv_sqrt[None, :]
-        n = A.shape[0]
-        L = L.at[jnp.arange(n), jnp.arange(n)].set(1.0)
-        return DNDarray.from_logical(L, A.split, A.device, A.comm)
+        gpos, _ = _row_positions(A)
+        row_scale = jnp.where(gpos < A.shape[0],
+                              inv_sqrt[jnp.clip(gpos, 0, A.shape[0] - 1)], 0.0)
+        L = -A.larray * row_scale[:, None] * inv_sqrt[None, :]
+        L = _set_diag(L, gpos, 1.0)
+        return DNDarray(L, A.gshape, types.canonical_heat_type(L.dtype),
+                        A.split, A.device, A.comm)
 
     def _simple_L(self, A: DNDarray) -> DNDarray:
-        """L = D - A (reference ``laplacian.py:105``)."""
-        degree = arithmetics.sum(A, axis=1)
-        logical_A = A._logical()
-        L = jnp.diag(degree._logical()) - logical_A
-        return DNDarray.from_logical(L, A.split, A.device, A.comm)
+        """L = D - A (reference ``laplacian.py:105``): the diagonal degree
+        lands on each row's owner; off-diagonal is -A shard-locally."""
+        d = self._degree_replicated(A)
+        gpos, _ = _row_positions(A)
+        n = A.shape[0]
+        dg = jnp.where(gpos < n, d[jnp.clip(gpos, 0, n - 1)], 0.0)
+        col = jnp.clip(gpos, 0, n - 1)
+        onehot = (col[:, None] == jnp.arange(n)[None, :]) & (gpos < n)[:, None]
+        L = jnp.where(onehot, dg[:, None], 0.0) - A.larray
+        return DNDarray(L, A.gshape, types.canonical_heat_type(L.dtype),
+                        A.split, A.device, A.comm)
 
     def construct(self, X: DNDarray) -> DNDarray:
         """Build L from data (reference ``laplacian.py:118-141``)."""
         S = self.similarity_metric(X)
+        if S.split not in (None, 0):
+            S = S.resplit(0)
+        gpos, _ = _row_positions(S)
+        phys = S.larray
         if self.mode == "eNeighbour":
             key, value = self.epsilon
-            logical = S._logical()
             if key == "upper":
-                A = jnp.where(logical < value, logical, 0.0)
+                phys = jnp.where(phys < value, phys, 0.0)
             else:
-                A = jnp.where(logical > value, logical, 0.0)
-            n = S.shape[0]
-            A = A.at[jnp.arange(n), jnp.arange(n)].set(0.0)
-            S = DNDarray.from_logical(A, S.split, S.device, S.comm)
-        else:
-            logical = S._logical()
-            n = S.shape[0]
-            A = logical.at[jnp.arange(n), jnp.arange(n)].set(0.0)
-            S = DNDarray.from_logical(A, S.split, S.device, S.comm)
+                phys = jnp.where(phys > value, phys, 0.0)
+        A = _set_diag(phys, gpos, 0.0)
+        S = DNDarray(A, S.gshape, S.dtype, S.split, S.device, S.comm)
         if self.definition == "simple":
             return self._simple_L(S)
         return self._normalized_symmetric_L(S)

@@ -39,15 +39,31 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         return self.partial_fit(x, y, classes=None, sample_weight=sample_weight)
 
     def partial_fit(self, x: DNDarray, y: DNDarray, classes=None, sample_weight=None) -> "GaussianNB":
-        """Incremental fit (reference ``gaussianNB.py:200``)."""
+        """Incremental fit (reference ``gaussianNB.py:200``).
+
+        The per-class moment accumulation runs on the physical shards: a
+        validity-masked one-hot GEMM whose contraction over the sample axis
+        is psum'd by GSPMD (the reference's Allreduce of per-rank moments,
+        ``:131-199``) — the data is never gathered. Class discovery on a
+        split label vector uses the distributed ``unique``."""
         if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
             raise TypeError("x and y need to be DNDarrays")
         if y.shape[0] != x.shape[0]:
             raise ValueError(
                 f"y has {y.shape[0]} samples but x has {x.shape[0]}"
             )
-        xl = x._logical().astype(jnp.float64)
-        yl = y._logical().reshape(-1)
+        if x.split not in (None, 0):
+            x = x.resplit(0)
+        if y.split != x.split:
+            y = y.resplit(x.split)
+        n = x.shape[0]
+        rowvalid = (x.valid_mask()[:, 0] if x.ndim > 1 else x.valid_mask()) \
+            if x.split == 0 else jnp.ones((x.larray.shape[0],), jnp.bool_)
+        # padding discipline: any non-finite garbage in the pad rows would
+        # poison the moment GEMMs via 0 * inf = NaN (review finding)
+        xl = jnp.where(rowvalid[:, None] if x.ndim > 1 else rowvalid,
+                       x.larray, 0).astype(jnp.float64)
+        yl = y.larray.reshape(-1)
 
         if classes is not None:
             class_vals = np.asarray(
@@ -56,25 +72,32 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         elif self.classes_ is not None:
             class_vals = np.asarray(self.classes_.numpy())
         else:
-            class_vals = np.unique(np.asarray(yl))
+            from ..core.manipulations import unique as ht_unique
+
+            class_vals = np.asarray(ht_unique(y, sorted=True).numpy())
         k = len(class_vals)
         classes_j = jnp.asarray(class_vals)
 
-        onehot = (yl[:, None] == classes_j[None, :]).astype(jnp.float64)  # (n, k)
+        onehot = ((yl[:, None] == classes_j[None, :]) & rowvalid[:, None]
+                  ).astype(jnp.float64)  # (n_phys, k)
         if sample_weight is not None:
-            w = (
-                sample_weight._logical()
-                if isinstance(sample_weight, DNDarray)
-                else jnp.asarray(sample_weight)
-            ).reshape(-1, 1)
-            onehot = onehot * w
-        counts = jnp.sum(onehot, axis=0)  # (k,)
-        sums = onehot.T @ xl  # (k, d)
+            if isinstance(sample_weight, DNDarray):
+                w = sample_weight.resplit(x.split).larray
+            else:
+                w = DNDarray.from_logical(
+                    jnp.asarray(sample_weight).reshape(-1), x.split,
+                    x.device, x.comm).larray
+            onehot = onehot * jnp.where(rowvalid, w.reshape(-1), 0
+                                        ).reshape(-1, 1)
+        counts = jnp.sum(onehot, axis=0)  # (k,) — GSPMD psum
+        sums = onehot.T @ xl  # (k, d) — contraction over the sharded axis
         means = sums / jnp.maximum(counts, 1e-30)[:, None]
         sq = onehot.T @ (xl * xl)
         variances = sq / jnp.maximum(counts, 1e-30)[:, None] - means**2
 
-        eps = self.var_smoothing * float(jnp.var(xl, axis=0).max())
+        s1 = jnp.sum(xl, axis=0) / n  # xl is already padding-masked
+        s2 = jnp.sum(xl * xl, axis=0) / n
+        eps = self.var_smoothing * float(jnp.max(s2 - s1 * s1))
         if self.theta_ is None:
             new_counts, new_means, new_vars = counts, means, variances
         else:
@@ -119,8 +142,12 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         return self
 
     def _joint_log_likelihood(self, x: DNDarray):
-        """Per-class joint log likelihood (reference ``gaussianNB.py:391``)."""
-        xl = x._logical().astype(jnp.float64)
+        """Per-class joint log likelihood (reference ``gaussianNB.py:391``):
+        shard-local rows against the replicated class moments. Returns
+        ``(jll_physical, x)`` with ``x`` normalized to a row split."""
+        if x.split not in (None, 0):
+            x = x.resplit(0)
+        xl = x.larray.astype(jnp.float64)
         means = jnp.asarray(self.theta_.numpy())  # (k, d)
         variances = jnp.asarray(self.var_.numpy())
         priors = jnp.asarray(self.class_prior_.numpy())
@@ -129,7 +156,7 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         const = -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * variances), axis=1)  # (k,)
         diff = xl[:, None, :] - means[None, :, :]
         mahal = -0.5 * jnp.sum(diff * diff / variances[None, :, :], axis=2)
-        return log_prior[None, :] + const[None, :] + mahal
+        return log_prior[None, :] + const[None, :] + mahal, x
 
     def logsumexp(self, a, axis=None, b=None, keepdims=False, return_sign=False):
         """Stable log-sum-exp (reference ``gaussianNB.py:407``)."""
@@ -139,22 +166,32 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
             if isinstance(a, DNDarray) else res
 
     def predict(self, x: DNDarray) -> DNDarray:
-        """Class prediction (reference ``gaussianNB.py:360``)."""
-        jll = self._joint_log_likelihood(x)
+        """Class prediction (reference ``gaussianNB.py:360``): argmax per
+        shard row, output stays split."""
+        jll, xs = self._joint_log_likelihood(x)
         idx = jnp.argmax(jll, axis=1)
         classes = jnp.asarray(self.classes_.numpy())
-        return DNDarray.from_logical(classes[idx], x.split, x.device, x.comm)
+        return DNDarray(
+            classes[idx], (xs.shape[0],),
+            types.canonical_heat_type(classes.dtype), xs.split, xs.device,
+            xs.comm)
 
     def predict_log_proba(self, x: DNDarray) -> DNDarray:
         """Log class probabilities (reference ``gaussianNB.py:440``)."""
-        jll = self._joint_log_likelihood(x)
+        jll, xs = self._joint_log_likelihood(x)
         norm = jax_logsumexp(jll, axis=1, keepdims=True)
-        return DNDarray.from_logical(jll - norm, x.split, x.device, x.comm)
+        res = jll - norm
+        return DNDarray(
+            res, (xs.shape[0], res.shape[1]),
+            types.canonical_heat_type(res.dtype), xs.split, xs.device,
+            xs.comm)
 
     def predict_proba(self, x: DNDarray) -> DNDarray:
         """Class probabilities (reference ``gaussianNB.py:470``)."""
         lp = self.predict_log_proba(x)
-        return DNDarray.from_logical(jnp.exp(lp._logical()), x.split, x.device, x.comm)
+        return DNDarray(
+            jnp.exp(lp.larray), lp.gshape, lp.dtype, lp.split, lp.device,
+            lp.comm)
 
 
 def jax_logsumexp(a, axis=None, keepdims=False):
