@@ -885,6 +885,12 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False,
         from ._setops import distributed_unique
 
         return distributed_unique(a, return_inverse, return_counts)
+    if (axis is None and not return_inverse and a.split is not None
+            and a.comm.size > 1 and a.ndim > 1 and a.size > 0):
+        # numpy flattens for axis=None: the distributed flatten (ring
+        # reshape) feeds the 1-D distributed pipeline. Inverse indices keep
+        # the logical path (their shape convention is backend-specific).
+        return unique(flatten(a), sorted=sorted, return_counts=return_counts)
     logical = a._logical()
     if return_inverse or return_counts:
         res, *rest = jnp.unique(

@@ -113,12 +113,20 @@ class DataLoader:
 def dataset_shuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
     """Globally shuffle the sample axis of every array in the dataset
     (reference ``datatools.py:246``: pairwise Send/Irecv of shard halves;
-    here one permutation gather scheduled by XLA)."""
+    here the shared permutation applies through the ring-gather getitem —
+    O(chunk) per device, no materialization)."""
+    import numpy as _np
+
     n = len(dataset)
-    perm = ht_random.randperm(n, comm=dataset.arrays[0].comm)._logical()
+    perm = _np.asarray(
+        ht_random.randperm(n, comm=dataset.arrays[0].comm).larray)
     for i, a in enumerate(dataset.arrays):
-        shuffled = a._logical()[perm]
-        dataset.arrays[i] = DNDarray.from_logical(shuffled, a.split, a.device, a.comm, dtype=a.dtype)
+        if a.split is not None and a.comm.size > 1 and n > 0:
+            dataset.arrays[i] = a[perm]
+        else:
+            shuffled = a._logical()[jnp.asarray(perm)]
+            dataset.arrays[i] = DNDarray.from_logical(
+                shuffled, a.split, a.device, a.comm, dtype=a.dtype)
 
 
 def dataset_ishuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
