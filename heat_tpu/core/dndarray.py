@@ -1226,6 +1226,21 @@ def _check_int_bounds(x, key):
                     raise IndexError(
                         f"index {k} is out of bounds for axis {axis} with size {n}"
                     )
+            elif (
+                isinstance(k, (np.ndarray, jnp.ndarray))
+                and k.size
+                and k.dtype != np.bool_
+                and jnp.issubdtype(k.dtype, jnp.integer)
+                and axis < x.ndim
+            ):
+                # NumPy raises for out-of-range array indices; jax would
+                # silently clamp them (two scalar fetches — the general
+                # path materializes anyway)
+                n = x.gshape[axis]
+                lo, hi = int(k.min()), int(k.max())
+                if lo < -n or hi >= n:
+                    raise IndexError(
+                        f"index out of bounds for axis {axis} with size {n}")
             axis += _index_axis_span(k)
 
     check(pre, 0)
@@ -1504,8 +1519,7 @@ def _getitem_mixed(x: DNDarray, keys, arr_pos, kind, arr) -> Optional[DNDarray]:
         return None
     n_axis = x.gshape[arr_pos]
     if kind == "bool":
-        mask = arr.numpy() if isinstance(arr, DNDarray) else np.asarray(arr)
-        idx_np = np.nonzero(np.asarray(mask, bool))[0]
+        idx_np = _mask_to_indices(arr)
     else:
         if isinstance(arr, DNDarray):
             arr = np.asarray(arr.numpy())
@@ -1718,10 +1732,8 @@ def _setitem_split_axis_advanced(x: DNDarray, kind, arr, value) -> builtins.bool
             return True
         # value varies per selected position: reduce to the integer-scatter
         # path over the kept positions
-        if isinstance(arr, DNDarray):
-            arr = np.asarray(arr.numpy())
-        idx = np.nonzero(np.asarray(arr, bool))[0]
-        return _setitem_split_axis_advanced(x, "int", idx, value)
+        return _setitem_split_axis_advanced(x, "int", _mask_to_indices(arr),
+                                            value)
 
     idx_phys, m = _index_physical(x, arr)
     if m == 0:
@@ -1755,10 +1767,63 @@ def _setitem_split_axis_advanced(x: DNDarray, kind, arr, value) -> builtins.bool
     return True
 
 
+def _mask_to_indices(arr) -> np.ndarray:
+    """Boolean mask (np/list/DNDarray) -> kept int positions (shared by the
+    bool branches of getitem/setitem dispatch)."""
+    if isinstance(arr, DNDarray):
+        arr = np.asarray(arr.numpy())
+    return np.nonzero(np.asarray(arr, bool))[0]
+
+
+def _setitem_mixed(x: DNDarray, keys, arr_pos, kind, arr, value) -> builtins.bool:
+    """Mixed-key assignment ``x[idx, 2:5] = v`` without materializing the
+    logical array: read-modify-write through the rings — gather the
+    addressed rows, apply the basic sub-key locally on the split-0 rows,
+    scatter them back. (NumPy leaves duplicate-index write order
+    unspecified; here duplicates resolve to the gathered-then-written row.)
+    """
+    if arr_pos != x.split:
+        return False
+    # (all-full-slice sub-keys only reach here after the direct scatter
+    # already declined the value shape — the RMW below may still broadcast)
+    if kind == "bool":
+        arr = _mask_to_indices(arr)
+        kind = "int"
+    rows = _getitem_split_axis_advanced(x, kind, arr)  # m at the split pos
+    if rows.ndim == 0 or rows.gshape[x.split] == 0:
+        # still validate the value's shape like NumPy does for empty
+        # selections (review finding: a silent no-op hides shape bugs)
+        target = tuple(
+            _slice_len(k, x.gshape[i]) if isinstance(k, slice)
+            else (0 if i == arr_pos else None)
+            for i, k in enumerate(keys))
+        target = tuple(t for t in target if t is not None)
+        vshape = np.shape(value.larray if isinstance(value, DNDarray)
+                          else value)
+        try:
+            np.broadcast_shapes(vshape, target)
+        except ValueError:
+            raise ValueError(
+                f"could not broadcast value of shape {vshape} to indexing "
+                f"result of shape {target}")
+        return True
+    # the basic sub-keys address the non-split dims of the gathered rows
+    rows_key = tuple(slice(None) if i == arr_pos else k
+                     for i, k in enumerate(keys))
+    _setitem_impl(rows, rows_key, value)
+    if not _setitem_split_axis_advanced(x, "int", arr, rows):
+        raise AssertionError(
+            "mixed-setitem scatter-back declined rows it just gathered")
+    return True
+
+
 def _setitem_impl(x: DNDarray, key, value):
     """Global assignment (reference ``__setitem__``, ``dndarray.py:1363-1652``)."""
     adv = _match_split_axis_array_key(x, key)
     if adv is not None and _setitem_split_axis_advanced(x, *adv, value):
+        return
+    mixed = _match_mixed_key(x, key)
+    if mixed is not None and _setitem_mixed(x, *mixed, value):
         return
     key = _normalize_key(x, key)
     if isinstance(value, DNDarray):

@@ -892,10 +892,13 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False,
         # the logical path (their shape convention is backend-specific).
         return unique(flatten(a), sorted=sorted, return_counts=return_counts)
     logical = a._logical()
+    # equal_nan=False: each NaN is its own unique, matching the reference's
+    # torch.unique semantics and the distributed pipeline (modern numpy
+    # collapses NaNs by default)
     if return_inverse or return_counts:
         res, *rest = jnp.unique(
             logical, return_inverse=return_inverse,
-            return_counts=return_counts, axis=axis)
+            return_counts=return_counts, axis=axis, equal_nan=False)
         out = [_wrap_logical(res, None, a)]
         if return_inverse:
             inverse = rest.pop(0)
@@ -904,7 +907,7 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False,
         if return_counts:
             out.append(_wrap_logical(rest.pop(0), None, a))
         return tuple(out)
-    res = jnp.unique(logical, axis=axis)
+    res = jnp.unique(logical, axis=axis, equal_nan=False)
     return _wrap_logical(res, None, a)
 
 

@@ -505,8 +505,12 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
         return _operations._finalize(result, out)
     logical = x._logical()
     qa = jnp.asarray(q, dtype=ftype)
-    res = jnp.percentile(logical.astype(ftype),
-                         qa, axis=axis_s, method=interpolation, keepdims=keepdims)
+    # jnp.percentile rejects q with rank > 1; flatten and restore (the
+    # distributed path supports N-D q natively)
+    res = jnp.percentile(logical.astype(ftype), qa.reshape(-1),
+                         axis=axis_s, method=interpolation, keepdims=keepdims)
+    if qa.ndim != 1:
+        res = res.reshape(tuple(qa.shape) + res.shape[1:])
     result = DNDarray.from_logical(res, None, x.device, x.comm)
     return _operations._finalize(result, out)
 

@@ -115,10 +115,8 @@ def dataset_shuffle(dataset: Dataset, attrs: Optional[List] = None) -> None:
     (reference ``datatools.py:246``: pairwise Send/Irecv of shard halves;
     here the shared permutation applies through the ring-gather getitem —
     O(chunk) per device, no materialization)."""
-    import numpy as _np
-
     n = len(dataset)
-    perm = _np.asarray(
+    perm = np.asarray(
         ht_random.randperm(n, comm=dataset.arrays[0].comm).larray)
     for i, a in enumerate(dataset.arrays):
         if a.split is not None and a.comm.size > 1 and n > 0:

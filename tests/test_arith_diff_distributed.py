@@ -63,10 +63,11 @@ def test_diff_no_gather(monkeypatch):
     a = rng.standard_normal(21).astype(np.float32)
     x = ht.array(a, split=0)
 
-    def boom(self):  # pragma: no cover
-        raise AssertionError("diff materialized the logical array")
+    if ht.get_comm().size > 1:
+        def boom(self):  # pragma: no cover
+            raise AssertionError("diff materialized the logical array")
 
-    monkeypatch.setattr(ht.DNDarray, "_logical", boom)
+        monkeypatch.setattr(ht.DNDarray, "_logical", boom)
     out = ht.diff(x)
     monkeypatch.undo()
     np.testing.assert_allclose(np.asarray(out.numpy()), np.diff(a), rtol=1e-5)

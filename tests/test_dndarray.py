@@ -221,7 +221,10 @@ class TestMethodParity:
         z = ht.zeros((8,), split=0)
         assert z.halo_prev is None and z.halo_next is None
         z.get_halo(1)
-        assert z.halo_prev is not None
+        if z.comm.size > 1:
+            assert z.halo_prev is not None
+        else:  # no neighbors at 1 device (reference keeps None there too)
+            assert z.halo_prev is None
 
     def test_save_method(self, tmp_path):
         x = ht.arange(20, dtype=ht.float32, split=0)

@@ -14,6 +14,8 @@ rng = np.random.default_rng(23)
 
 
 def _no_big_gather(monkeypatch):
+    if ht.get_comm().size == 1:
+        return  # logical path IS the implementation at 1 device
     orig = ht.DNDarray._logical
 
     def guarded(self):

@@ -19,9 +19,30 @@ from heat_tpu.core import dndarray as dnd_mod
 from utils import assert_array_equal
 
 
+def _multi():
+    return ht.get_comm().size > 1
+
+
 def _ring_detects(x, key):
-    """The dispatcher recognizes ``key`` as a ring-program case."""
-    return dnd_mod._match_split_axis_array_key(x, key) is not None
+    """The dispatcher recognizes ``key`` as a ring-program case (trivially
+    true at 1 device, where the ring paths are disabled by design)."""
+    return not _multi() or dnd_mod._match_split_axis_array_key(x, key) is not None
+
+
+def _guard_materialize(monkeypatch, limit, message):
+    """Fail if anything materializes >= limit elements; no-op at 1 device
+    (the distributed paths are disabled there and the logical path is the
+    correct implementation)."""
+    if not _multi():
+        return
+    orig = ht.DNDarray._logical
+
+    def guarded(self):
+        if self.size >= limit:
+            raise AssertionError(message)
+        return orig(self)
+
+    monkeypatch.setattr(ht.DNDarray, "_logical", guarded)
 
 
 class TestRingCompress:
@@ -222,10 +243,8 @@ class TestMixedKeys:
     # shape (3, 23, 4); tests split axis 1 (length 23: uneven over 8 devices)
 
     def _no_logical(self, monkeypatch):
-        def boom(self):  # pragma: no cover
-            raise AssertionError("mixed key materialized the logical array")
-
-        monkeypatch.setattr(ht.DNDarray, "_logical", boom)
+        _guard_materialize(monkeypatch, 1,
+                           "mixed key materialized the logical array")
 
     def test_idx_then_slice(self, monkeypatch):
         b = np.arange(60, dtype=np.float32).reshape(12, 5)
@@ -244,7 +263,8 @@ class TestMixedKeys:
         out = x[0:2, idx]
         monkeypatch.undo()
         assert_array_equal(out, self.a[0:2, idx], rtol=0)
-        assert out.split == 1
+        if _multi():
+            assert out.split == 1
 
     def test_int_then_split_idx(self, monkeypatch):
         x = ht.array(self.a, split=1)
@@ -279,7 +299,8 @@ class TestMixedKeys:
         out = x[idx]
         monkeypatch.undo()
         assert_array_equal(out, self.a[idx], rtol=0)
-        assert out.split == 1
+        if _multi():
+            assert out.split == 1
 
     def test_nonsplit_mask_local(self, monkeypatch):
         b = np.arange(48, dtype=np.float32).reshape(6, 8)
@@ -315,10 +336,8 @@ class TestPairedArrays:
     a = np.arange(6 * 19 * 4, dtype=np.float32).reshape(6, 19, 4)
 
     def _no_logical(self, monkeypatch):
-        def boom(self):  # pragma: no cover
-            raise AssertionError("paired key materialized the logical array")
-
-        monkeypatch.setattr(ht.DNDarray, "_logical", boom)
+        _guard_materialize(monkeypatch, 1,
+                           "paired key materialized the logical array")
 
     def test_two_arrays_split0(self, monkeypatch):
         b = np.arange(84, dtype=np.float32).reshape(12, 7)
@@ -413,15 +432,80 @@ class TestDistributedNonzero:
     def test_no_logical_materialization(self, monkeypatch):
         a = np.arange(24, dtype=np.float32)
         x = ht.array(a, split=0)
-
-        def boom(self):  # pragma: no cover
-            raise AssertionError("nonzero materialized the logical array")
-
-        monkeypatch.setattr(ht.DNDarray, "_logical", boom)
+        _guard_materialize(monkeypatch, 1,
+                           "nonzero materialized the logical array")
         nz = ht.nonzero(x)
         monkeypatch.undo()
         np.testing.assert_array_equal(
             np.asarray(nz.numpy()), np.stack(np.nonzero(a), 1))
+
+
+class TestMixedSetitem:
+    """Mixed-key assignment stays gather-free: ring gather -> local basic
+    write on the rows -> ring scatter back."""
+
+    a = np.arange(3 * 17 * 4, dtype=np.float32).reshape(17, 3, 4).transpose(1, 0, 2).copy()
+    # shape (3, 17, 4), split axis 1 in tests
+
+    def _no_materialize(self, monkeypatch, full_size):
+        """Fail the test if anything materializes the FULL array (the
+        gathered selection rows are allowed — they are O(selection))."""
+        _guard_materialize(monkeypatch, full_size,
+                           "mixed setitem materialized the array")
+
+    def test_idx_then_slice(self, monkeypatch):
+        b = np.arange(96, dtype=np.float32).reshape(12, 8)
+        x = ht.array(b.copy(), split=0)
+        idx = np.array([0, 11, 5])
+        self._no_materialize(monkeypatch, b.size)
+        x[idx, 2:5] = -1.0
+        monkeypatch.undo()
+        want = b.copy()
+        want[idx, 2:5] = -1.0
+        np.testing.assert_allclose(np.asarray(x.numpy()), want)
+
+    def test_slice_then_split_idx_rows_value(self, monkeypatch):
+        x = ht.array(self.a.copy(), split=1)
+        idx = np.array([16, 2, 9])
+        vals = np.full((2, 3, 4), 7.0, np.float32)
+        self._no_materialize(monkeypatch, self.a.size)
+        x[0:2, idx] = vals
+        monkeypatch.undo()
+        want = self.a.copy()
+        want[0:2, idx] = vals
+        np.testing.assert_allclose(np.asarray(x.numpy()), want)
+
+    def test_int_then_split_idx(self, monkeypatch):
+        x = ht.array(self.a.copy(), split=1)
+        idx = np.array([4, 10])
+        self._no_materialize(monkeypatch, self.a.size)
+        x[1, idx] = 0.0
+        monkeypatch.undo()
+        want = self.a.copy()
+        want[1, idx] = 0.0
+        np.testing.assert_allclose(np.asarray(x.numpy()), want)
+
+    def test_mask_with_slice(self, monkeypatch):
+        x = ht.array(self.a.copy(), split=1)
+        mask = np.arange(17) % 4 == 1
+        self._no_materialize(monkeypatch, self.a.size)
+        x[0:2, mask, 3] = 5.0
+        monkeypatch.undo()
+        want = self.a.copy()
+        want[0:2, mask, 3] = 5.0
+        np.testing.assert_allclose(np.asarray(x.numpy()), want)
+
+    def test_scalar_then_broadcast_row(self, monkeypatch):
+        b = np.arange(60, dtype=np.float32).reshape(15, 4)
+        x = ht.array(b.copy(), split=0)
+        idx = np.array([14, 0, 7, 7])
+        row = np.array([1.0, 2.0, 3.0], np.float32)
+        self._no_materialize(monkeypatch, b.size)
+        x[idx, 1:4] = row
+        monkeypatch.undo()
+        want = b.copy()
+        want[idx, 1:4] = row
+        np.testing.assert_allclose(np.asarray(x.numpy()), want)
 
 
 class TestDispatcherRobustness:

@@ -65,7 +65,10 @@ class TestParallelSave:
     round-1/round-2 finding)."""
 
     def _no_gather(self, monkeypatch):
-        """Make any full-gather during save an error."""
+        """Make any full-gather during save an error (no-op at 1 device,
+        where shard 0 IS the global array)."""
+        if ht.get_comm().size == 1:
+            return
 
         def boom(self):  # pragma: no cover - the assertion
             raise AssertionError("save path gathered the global array")

@@ -22,6 +22,13 @@ def _grid(shape):
     return ht.MeshGrid(shape, ("dp", "pp", "tp", "sp"))
 
 
+def _need_devices(n):
+    import jax
+
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices, have {len(jax.devices())}")
+
+
 def _rmsnorm(x, scale):
     var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     return x * jax.lax.rsqrt(var + 1e-6) * scale
@@ -173,6 +180,7 @@ class TestFullComposition:
 
 class TestZigzagSchedule:
     def test_zigzag_matches_ring_schedule_loss_and_grads(self):
+        _need_devices(4)
         """The flagship with attn_schedule='zigzag' computes the same math:
         identical loss and gradients to the naive ring schedule on an sp
         grid."""
@@ -205,6 +213,7 @@ class TestZigzagSchedule:
                                 attn_schedule="spiral")
 
     def test_zigzag_with_pipeline_stages(self):
+        _need_devices(8)  # (1, 2, 1, 4) grid
         """zigzag sp composes with pp microbatching (layout round-trip sits
         outside the pipeline loop)."""
         import jax
@@ -382,6 +391,7 @@ class TestGenerate:
         np.testing.assert_array_equal(got, want)
 
     def test_sampling_and_validation(self):
+        _need_devices(2)
         grid = ht.MeshGrid((1, 1, 1, 1), ("dp", "pp", "tp", "sp"),
                            devices=jax.devices()[:1])
         cfg = TransformerLMConfig(vocab=11, d_model=8, n_heads=2, n_layers=1)
@@ -405,6 +415,7 @@ class TestGenerate:
             model.generate(params, prompts, 0)
 
     def test_dp_shards_sample_independently(self):
+        _need_devices(2)
         """Identical prompts on different dp shards must draw DIFFERENT
         sampling noise (per-shard key fold) — a replicated key generated
         identical continuations across shards."""
@@ -451,6 +462,7 @@ class TestShardedCheckpointRoundtrip:
 
 class TestUlyssesSchedule:
     def test_ulysses_matches_ring_loss_and_grads(self):
+        _need_devices(4)
         grid = ht.MeshGrid((1, 1, 1, 4), ("dp", "pp", "tp", "sp"),
                            devices=jax.devices()[:4])
         toks_np = np.random.default_rng(0).integers(0, 32, (2, 16))
@@ -471,6 +483,7 @@ class TestUlyssesSchedule:
                                        rtol=1e-4, atol=1e-5)
 
     def test_head_divisibility_validated(self):
+        _need_devices(4)
         grid = ht.MeshGrid((1, 1, 1, 4), ("dp", "pp", "tp", "sp"),
                            devices=jax.devices()[:4])
         cfg = TransformerLMConfig(vocab=32, d_model=12, n_heads=3,
