@@ -252,6 +252,93 @@ def ring_repeat_fn(phys_shape, jdt, axis: int, n: int, rep: int, c_out: int,
                            comm)
 
 
+def ring_pad_fn(phys_shape, jdt, axis: int, n: int, before: int, after: int,
+                mode: str, comm):
+    """Jitted split-axis pad for the boundary-sourcing modes (reference
+    ``pad``, ``manipulations.py:1128``): ``reflect``/``symmetric``/``edge``/
+    ``wrap``. Each pad region is a static (piecewise-monotone) source map
+    into the valid rows, so the scheduled window fetch applies: the body
+    copies through, the margins fetch their mirror/edge/wrap sources."""
+    key = ("rpad", tuple(phys_shape), str(jdt), axis, n, before, after, mode,
+           comm.cache_key)
+    fn = _MANIP_CACHE.get(key)
+    if fn is not None:
+        return fn
+    p = comm.size
+    c_in = phys_shape[axis] // p
+    n_out = n + before + after
+    c_out = comm.chunk_size(n_out)
+    idt = _index_dtype()
+
+    def src_py(go):
+        """Python mirror of the traced map (for demand computation)."""
+        rel = go - before
+        if 0 <= rel < n:
+            return rel
+        if mode == "edge":
+            return 0 if rel < 0 else n - 1
+        if mode == "wrap":
+            return rel % n
+        if mode == "symmetric":
+            period = 2 * n
+            r = rel % period if rel >= 0 else (rel % period + period) % period
+            return r if r < n else period - 1 - r
+        # reflect: period 2n-2 (no repeated edge)
+        period = max(2 * n - 2, 1)
+        r = rel % period if rel >= 0 else (rel % period + period) % period
+        return r if r < n else period - r
+
+    # demands: evaluate per region (each monotone); union per receiver
+    regions = [(0, before), (before, before + n), (before + n, n_out)]
+    demands = [set() for _ in range(p)]
+    for glo, ghi in regions:
+        for e in range(p):
+            lo = max(e * c_out, glo)
+            hi = min((e + 1) * c_out, ghi) - 1
+            if lo > hi:
+                continue
+            # piecewise-monotone: sample the endpoints AND the interior
+            # extrema candidates (period fold points); small intervals are
+            # sampled exhaustively so a missed extremum cannot drop a block
+            if hi - lo < 4096:
+                cand = set(range(lo, hi + 1))
+            else:
+                cand = {lo, hi}
+                if mode in ("reflect", "symmetric", "wrap"):
+                    period = {"reflect": max(2 * n - 2, 1),
+                              "symmetric": 2 * n, "wrap": n}[mode]
+                    k0 = (lo - before) // period
+                    k1 = (hi - before) // period + 1
+                    for k in range(k0, k1 + 1):
+                        for boundary in (before + k * period,
+                                         before + k * period + n - 1,
+                                         before + k * period + n):
+                            if lo <= boundary <= hi:
+                                cand.add(boundary)
+            srcs = [src_py(g) for g in cand]
+            b0, b1 = max(min(srcs) // c_in, 0), min(max(srcs) // c_in, p - 1)
+            demands[e].update(range(b0, b1 + 1))
+    rounds = _schedule_block_fetch([sorted(d) for d in demands], p)
+
+    def src_traced(go):
+        rel = go - before
+        if mode == "edge":
+            src = jnp.clip(rel, 0, n - 1)
+        elif mode == "wrap":
+            src = rel % n
+        elif mode == "symmetric":
+            r = rel % (2 * n)
+            src = jnp.where(r < n, r, 2 * n - 1 - r)
+        else:  # reflect
+            period = max(2 * n - 2, 1)
+            r = rel % period
+            src = jnp.where(r < n, r, period - r)
+        return jnp.where(go < n_out, src, jnp.asarray(-1, idt)).astype(idt)
+
+    return _window_factory(key, phys_shape, axis, c_in, c_out, rounds,
+                           src_traced, comm)
+
+
 def split_diff_fn(phys_shape, jdt, axis: int, n: int, comm):
     """Jitted first-order ``diff`` along the split axis (reference ``diff``,
     ``arithmetics.py:563``): ``out[g] = in[g+1] - in[g]`` for ``g < n-1``

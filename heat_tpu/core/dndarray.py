@@ -1798,8 +1798,8 @@ def _setitem_mixed(x: DNDarray, keys, arr_pos, kind, arr, value) -> builtins.boo
             else (0 if i == arr_pos else None)
             for i, k in enumerate(keys))
         target = tuple(t for t in target if t is not None)
-        vshape = np.shape(value.larray if isinstance(value, DNDarray)
-                          else value)
+        vshape = (value.gshape if isinstance(value, DNDarray)
+                  else np.shape(value))  # logical, never the padded physical
         try:
             np.broadcast_shapes(vshape, target)
         except ValueError:

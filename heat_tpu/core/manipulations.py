@@ -459,7 +459,19 @@ def pad(array: DNDarray, pad_width, mode: str = "constant", constant_values=0) -
                     shp, constant_values, dtype=array.dtype, split=split,
                     comm=array.comm))
             return concatenate(parts, axis=split)
-        # non-constant modes on the split axis need neighbor data: fall back
+        if mode in ("reflect", "symmetric", "edge", "wrap") and \
+                array.shape[split] > (1 if mode == "reflect" else 0):
+            from . import _manips
+
+            n = array.shape[split]
+            fn = _manips.ring_pad_fn(
+                out.larray.shape, jnp.dtype(out.larray.dtype), split, n,
+                before, after, mode, array.comm)
+            g2 = tuple(s + (before + after if i == split else 0)
+                       for i, s in enumerate(out.gshape))
+            return DNDarray(fn(out.larray), g2, array.dtype, split,
+                            array.device, array.comm)
+        # other modes on the split axis: fall back
     res = jnp.pad(array._logical(), pad_width, mode=mode, **kw)
     return _wrap_logical(res, array.split, array)
 

@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Weak-scaling harness for the headline benchmarks (BASELINE.json north
+star: KMeans iter/s and cdist GB/s at >=90% weak-scaling efficiency
+1 -> 256 chips on v5e).
+
+Per device count d in the ladder, each subprocess builds a d-device mesh
+and measures the fused KMeans Lloyd step at n = BASE_N * d points (weak
+scaling: constant work per device) and the ring cdist at rows = CD_N *
+sqrt(d). Efficiency(d) = throughput(d) / (d * throughput(1)) for KMeans
+(throughput scales with devices under perfect weak scaling).
+
+On real TPU hardware run WITHOUT the CPU forcing:
+
+    python scripts/weak_scaling.py --devices 1,4,16,64,256
+
+On the virtual CPU mesh (methodology check; numbers are NOT hardware
+results — all virtual devices share the host's cores, so efficiency
+reflects scheduler overhead, not ICI):
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/weak_scaling.py
+
+Prints one JSON line per ladder step plus a final summary line.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def measure(n_points: int, d_feats: int, k: int) -> dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, _REPO)
+    import heat_tpu as ht
+    from heat_tpu.cluster.kmeans import _lloyd_fori_fn
+
+    ht.random.seed(0)
+    x = ht.random.rand(n_points, d_feats, dtype=ht.float32, split=0)
+    comm = x.comm
+    cents = jnp.asarray(
+        np.random.default_rng(0).random((k, d_feats), dtype=np.float32))
+    run = _lloyd_fori_fn(x.larray.shape, jnp.dtype(jnp.float32), k, n_points,
+                         comm)
+
+    def timed(iters):
+        t0 = time.perf_counter()
+        _, inertia, _ = run(x.larray, cents, iters)
+        float(np.asarray(inertia))
+        return time.perf_counter() - t0
+
+    timed(1)
+    lo, hi = 2, 12
+    t_lo = min(timed(lo) for _ in range(3))
+    t_hi = min(timed(hi) for _ in range(3))
+    per = (t_hi - t_lo) / (hi - lo)
+    if per <= 0:
+        per = t_hi / hi
+    return {"devices": comm.size, "n": n_points,
+            "kmeans_iter_per_s": round(1.0 / per, 3)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", default="1,2,4,8",
+                    help="comma-separated mesh-size ladder")
+    ap.add_argument("--base-n", type=int, default=1 << 18,
+                    help="points per device (weak scaling)")
+    ap.add_argument("--feats", type=int, default=64)
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--measure", type=int, default=0,
+                    help="(internal) run one measurement at this point count")
+    args = ap.parse_args()
+
+    if args.measure:
+        print(json.dumps(measure(args.measure, args.feats, args.k)))
+        return
+
+    ladder = [int(d) for d in args.devices.split(",")]
+    results = []
+    for d in ladder:
+        env = dict(os.environ)
+        if env.get("JAX_PLATFORMS") == "cpu" or not env.get(
+                "PALLAS_AXON_POOL_IPS"):
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            flags = [f for f in env.get("XLA_FLAGS", "").split()
+                     if "host_platform_device_count" not in f]
+            flags.append(f"--xla_force_host_platform_device_count={d}")
+            env["XLA_FLAGS"] = " ".join(flags).strip()
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--measure", str(args.base_n * d),
+             "--feats", str(args.feats), "--k", str(args.k)],
+            env=env, capture_output=True, text=True, timeout=1800, cwd=_REPO)
+        line = next((l for l in reversed(out.stdout.splitlines())
+                     if l.startswith("{")), None)
+        if line is None:
+            print(json.dumps({"devices": d, "error":
+                              (out.stderr or "no output").strip()[-300:]}))
+            continue
+        rec = json.loads(line)
+        results.append(rec)
+        print(json.dumps(rec))
+
+    if results and results[0].get("kmeans_iter_per_s"):
+        base = results[0]["kmeans_iter_per_s"]
+        print(json.dumps({
+            "summary": "weak_scaling_efficiency_vs_1dev",
+            "base_iter_per_s": base,
+            "efficiency": {
+                str(r["devices"]):
+                    round(r["kmeans_iter_per_s"] / base, 3)
+                for r in results
+            },
+            "note": "perfect weak scaling keeps iter/s constant as devices "
+                    "and points grow together; efficiency = iter/s(d) / "
+                    "iter/s(1)",
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -243,6 +243,22 @@ class TestDiagPad:
         x = ht.array(a, split=0)
         assert_array_equal(ht.pad(x, 1), np.pad(a, 1), rtol=0)
 
+    @pytest.mark.parametrize("mode", ["reflect", "symmetric", "edge", "wrap"])
+    @pytest.mark.parametrize("width", [(2, 3), (7, 0), (0, 5), (25, 30)])
+    def test_pad_boundary_modes_split_axis(self, mode, width):
+        a = rng.standard_normal((13, 3)).astype(np.float32)
+        x = ht.array(a, split=0)
+        out = ht.pad(x, (width, (0, 0)), mode=mode)
+        assert_array_equal(out, np.pad(a, (width, (0, 0)), mode=mode),
+                           rtol=0)
+        assert out.split == 0
+
+    def test_pad_wrap_1d_multi_period(self):
+        a = np.arange(5, dtype=np.float32)
+        x = ht.array(a, split=0)
+        out = ht.pad(x, (12, 17), mode="wrap")
+        assert_array_equal(out, np.pad(a, (12, 17), mode="wrap"), rtol=0)
+
     def test_pad_reflect_nonsplit(self):
         a = rng.standard_normal((8, 5)).astype(np.float32)
         x = ht.array(a, split=0)
