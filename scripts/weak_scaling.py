@@ -1,15 +1,16 @@
 #!/usr/bin/env python
-"""Weak-scaling harness for the headline benchmarks (BASELINE.json north
-star: KMeans iter/s and cdist GB/s at >=90% weak-scaling efficiency
-1 -> 256 chips on v5e).
+"""Weak-scaling harness for the headline KMeans benchmark (BASELINE.json
+north star: >=90% weak-scaling efficiency 1 -> 256 chips on v5e).
 
-Per device count d in the ladder, each subprocess builds a d-device mesh
-and measures the fused KMeans Lloyd step at n = BASE_N * d points (weak
-scaling: constant work per device) and the ring cdist at rows = CD_N *
-sqrt(d). Efficiency(d) = throughput(d) / (d * throughput(1)) for KMeans
-(throughput scales with devices under perfect weak scaling).
+Per device count d in the ladder, a subprocess builds a d-device mesh —
+the first d devices of the real backend, or a forced d-device virtual CPU
+mesh — and measures the fused KMeans Lloyd step at n = BASE_N * d points
+(weak scaling: constant work per device). Under perfect weak scaling
+iter/s stays CONSTANT as devices and points grow together, so
+efficiency(d) = iter_per_s(d) / iter_per_s(1).
 
-On real TPU hardware run WITHOUT the CPU forcing:
+On real TPU hardware run WITHOUT the CPU forcing (the ladder slices the
+first d chips of the pod):
 
     python scripts/weak_scaling.py --devices 1,4,16,64,256
 
@@ -32,18 +33,24 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def measure(n_points: int, d_feats: int, k: int) -> dict:
+def measure(n_points: int, d_feats: int, k: int, ndev: int) -> dict:
     import numpy as np
     import jax
     import jax.numpy as jnp
 
     sys.path.insert(0, _REPO)
     import heat_tpu as ht
+    from heat_tpu.core.communication import TPUCommunication
+
     from heat_tpu.cluster.kmeans import _lloyd_fori_fn
 
+    have = len(jax.devices())
+    if ndev > have:
+        return {"devices": ndev, "error": f"only {have} devices available"}
+    comm = TPUCommunication(jax.devices()[:ndev])
     ht.random.seed(0)
-    x = ht.random.rand(n_points, d_feats, dtype=ht.float32, split=0)
-    comm = x.comm
+    x = ht.random.rand(n_points, d_feats, dtype=ht.float32, split=0,
+                       comm=comm)
     cents = jnp.asarray(
         np.random.default_rng(0).random((k, d_feats), dtype=np.float32))
     run = _lloyd_fori_fn(x.larray.shape, jnp.dtype(jnp.float32), k, n_points,
@@ -76,10 +83,13 @@ def main():
     ap.add_argument("--k", type=int, default=8)
     ap.add_argument("--measure", type=int, default=0,
                     help="(internal) run one measurement at this point count")
+    ap.add_argument("--measure-devices", type=int, default=0,
+                    help="(internal) mesh size for the measurement")
     args = ap.parse_args()
 
     if args.measure:
-        print(json.dumps(measure(args.measure, args.feats, args.k)))
+        print(json.dumps(measure(args.measure, args.feats, args.k,
+                                 args.measure_devices)))
         return
 
     ladder = [int(d) for d in args.devices.split(",")]
@@ -94,11 +104,17 @@ def main():
                      if "host_platform_device_count" not in f]
             flags.append(f"--xla_force_host_platform_device_count={d}")
             env["XLA_FLAGS"] = " ".join(flags).strip()
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__),
-             "--measure", str(args.base_n * d),
-             "--feats", str(args.feats), "--k", str(args.k)],
-            env=env, capture_output=True, text=True, timeout=1800, cwd=_REPO)
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--measure", str(args.base_n * d),
+                 "--measure-devices", str(d),
+                 "--feats", str(args.feats), "--k", str(args.k)],
+                env=env, capture_output=True, text=True, timeout=1800,
+                cwd=_REPO)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"devices": d, "error": "timeout after 1800s"}))
+            continue
         line = next((l for l in reversed(out.stdout.splitlines())
                      if l.startswith("{")), None)
         if line is None:

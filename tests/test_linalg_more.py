@@ -271,3 +271,26 @@ class TestQRExtendedSweep:
         # reference parity: bool is an int subclass and passes (treated as 1)
         qr = ht.qr(a, tiles_per_proc=True)
         assert qr.Q is not None
+
+
+class TestSVDQuadrants:
+    """SVD covers the full split envelope: tall/square/wide at split 0 and 1
+    (TSQR/CAQR + small-R SVD, transpose identities, one reshard for the
+    remaining quadrants) — the reference ships an empty stub
+    (``heat/core/linalg/svd.py:1-5``)."""
+
+    @pytest.mark.parametrize("shape,split", [
+        ((100, 8), 0), ((40, 24), 0), ((24, 40), 0),
+        ((8, 100), 1), ((40, 24), 1), ((24, 40), 1), ((32, 32), 0),
+    ])
+    def test_reconstruction_and_values(self, shape, split):
+        rng = np.random.default_rng(shape[0] * 100 + shape[1] + split)
+        a = rng.standard_normal(shape).astype(np.float32)
+        u, sv, v = ht.linalg.svd(ht.array(a, split=split))
+        recon = (np.asarray(u.numpy()) @ np.diag(np.asarray(sv.numpy()))
+                 @ np.asarray(v.numpy()).T)
+        np.testing.assert_allclose(recon, a, rtol=1e-3, atol=1e-3)
+        only_s = ht.linalg.svd(ht.array(a, split=split), compute_uv=False)
+        np.testing.assert_allclose(
+            np.sort(np.asarray(only_s.numpy()))[::-1],
+            np.linalg.svd(a, compute_uv=False), rtol=1e-3, atol=1e-4)
