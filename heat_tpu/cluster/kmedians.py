@@ -2,12 +2,13 @@
 
 Same Lloyd skeleton as KMeans but the centroid update is the per-cluster
 coordinate-wise **median**. Fully distributed: one jitted shard_map program
-per iteration runs the manhattan assignment shard-locally, then for each
-cluster sorts the member-masked columns with the block merge-split network
-(non-members and padding carry +inf keys, so the valid order statistics
-occupy the leading global positions) and selects the median ranks with two
-masked psums — the data is never gathered (the reference runs
-``ht.percentile`` per cluster over the split array the same way).
+per iteration runs the manhattan assignment shard-locally, then ONE batched
+merge-split network sort over all (cluster, feature) columns at once
+(non-members and padding carry +inf keys, so each column's valid order
+statistics occupy its leading global positions — k-fold fewer collective
+rounds than per-cluster sorts, at k x block memory) and selects the median
+ranks with two masked psums — the data is never gathered (the reference
+runs ``ht.percentile`` per cluster over the split array the same way).
 """
 
 from __future__ import annotations
@@ -55,25 +56,24 @@ def _kmedians_step_fn(phys_shape, k: int, n: int, comm):
         member = (labels[:, None] == jnp.arange(k)[None, :]) & valid[:, None]
         counts = jax.lax.psum(jnp.sum(member.astype(idt), axis=0),
                               comm.axis_name)  # (k,)
-        meds = []
-        for j in range(k):
-            vals = xb.T  # (d, c)
-            mask = member[:, j][None, :]  # (1, c) broadcast over features
-            keys = jnp.where(mask, _float_sort_key(vals), pad_key)
-            _, (sv,) = _network_sort(keys, (vals,), rounds, roles, c, False,
-                                     comm.axis_name)
-            cnt = counts[j]
-            lo = jnp.maximum(cnt - 1, 0) // 2
-            hi = cnt // 2
-            vlo = jax.lax.psum(
-                jnp.sum(jnp.where((gpos == lo)[None, :], sv, 0), axis=1),
-                comm.axis_name)  # (d,)
-            vhi = jax.lax.psum(
-                jnp.sum(jnp.where((gpos == hi)[None, :], sv, 0), axis=1),
-                comm.axis_name)
-            med = 0.5 * (vlo + vhi)
-            meds.append(jnp.where(cnt > 0, med, cent[j]))
-        new_cent = jnp.stack(meds)
+        # ONE batched network sort over all (cluster, feature) columns —
+        # same total traffic as k separate sorts, k-fold fewer rounds
+        mask = member.T[:, None, :]  # (k, 1, c)
+        vals = jnp.broadcast_to(xb.T[None, :, :], (k, d, c))
+        keys = jnp.where(mask, _float_sort_key(vals), pad_key)
+        _, (sv,) = _network_sort(keys, (vals,), rounds, roles, c, False,
+                                 comm.axis_name)  # (k, d, c)
+        lo = jnp.maximum(counts - 1, 0) // 2  # (k,)
+        hi = counts // 2
+        sel = gpos[None, None, :]
+        vlo = jax.lax.psum(
+            jnp.sum(jnp.where(sel == lo[:, None, None], sv, 0), axis=-1),
+            comm.axis_name)  # (k, d)
+        vhi = jax.lax.psum(
+            jnp.sum(jnp.where(sel == hi[:, None, None], sv, 0), axis=-1),
+            comm.axis_name)
+        med = 0.5 * (vlo + vhi)
+        new_cent = jnp.where((counts > 0)[:, None], med, cent)
         shift = jnp.sum((new_cent - cent) ** 2)
         return new_cent, shift, labels
 

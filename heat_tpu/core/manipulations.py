@@ -1,11 +1,17 @@
 """Shape and data manipulation operations (reference ``heat/core/manipulations.py``).
 
 Strategy on the XLA backend: ops that do not touch the split axis run on the
-physical (padded) array with zero communication; ops that cross or transform
-the split axis run on the *logical* global view and re-shard the result —
-the data motion (the reference's Alltoallv machinery for ``reshape``
-``:1817``, sample-sort for ``sort`` ``:2263``, Allgatherv for ``unique``
-``:3051``) is scheduled by XLA instead of hand-written collectives.
+physical (padded) array with zero communication. Ops that cross or transform
+the split axis are GATHER-FREE compiled collective programs: static
+monotone source maps (concatenate/reshape/roll/flip/repeat/tile/pad/diag)
+run scheduled block-window fetches (:mod:`._manips` — O(1) ppermute rounds,
+the counterpart of the reference's Alltoallv ``:1817`` / point-to-point
+``:188`` machinery), ``sort`` runs the Batcher merge-split network
+(:mod:`._sort`, vs the reference's sample-sort ``:2263``), ``unique`` the
+three-phase pipeline (:mod:`._setops`, vs Allgatherv ``:3051``), and
+``topk`` the tournament reduction (vs ``mpi_topk`` ``:3971``). Only
+data-dependent-shape corners (array-valued repeats, axis= uniques) fall
+back to the logical view.
 """
 
 from __future__ import annotations
