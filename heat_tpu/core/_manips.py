@@ -252,6 +252,29 @@ def ring_repeat_fn(phys_shape, jdt, axis: int, n: int, rep: int, c_out: int,
                            comm)
 
 
+def ring_slice_fn(phys_shape, jdt, axis: int, start: int, step: int, L: int,
+                  c_out: int, comm):
+    """Jitted contiguous/strided slice along the split axis: ``out[go] =
+    in[start + go*step]`` for ``go < L`` (reference basic ``__getitem__``
+    slicing, ``dndarray.py:656-912``). An affine map — one scheduled window
+    fetch re-chunks the selection into canonical layout."""
+    key = ("rslice", tuple(phys_shape), str(jdt), axis, start, step, L,
+           c_out, comm.cache_key)
+    if key in _MANIP_CACHE:
+        return _MANIP_CACHE[key]
+    p = comm.size
+    c_in = phys_shape[axis] // p
+    idt = _index_dtype()
+    rounds = _schedule_block_fetch(
+        _demand_blocks(lambda go: start + go * step, 0, L, p, c_out, c_in), p)
+
+    def src(go):
+        return jnp.where(go < L, start + go * step, jnp.asarray(-1, idt))
+
+    return _window_factory(key, phys_shape, axis, c_in, c_out, rounds, src,
+                           comm)
+
+
 def ring_pad_fn(phys_shape, jdt, axis: int, n: int, before: int, after: int,
                 mode: str, comm):
     """Jitted split-axis pad for the boundary-sourcing modes (reference

@@ -1187,6 +1187,19 @@ def _normalize_key(x, key):
     return key
 
 
+def _expand_ellipsis(keys, ndim):
+    """Replace a single Ellipsis with the full slices it stands for (NumPy
+    arity rules via :func:`_index_axis_span`). Returns None when a second
+    Ellipsis makes the key invalid for the specialized dispatchers."""
+    if any(k is Ellipsis for k in keys):
+        i = next(j for j, k in enumerate(keys) if k is Ellipsis)
+        n_explicit = sum(_index_axis_span(k) for k in keys if k is not Ellipsis)
+        keys[i:i + 1] = [slice(None)] * (ndim - n_explicit)
+        if any(k is Ellipsis for k in keys):
+            return None
+    return keys
+
+
 def _index_axis_span(k) -> builtins.int:
     """How many array axes one key element consumes (NumPy arity rules):
     a boolean mask consumes ``mask.ndim`` axes, a scalar bool / None consume
@@ -1306,12 +1319,8 @@ def _match_split_axis_array_key(x: DNDarray, key):
         return None
     # identity tests only: ``in``/``index`` run ``==`` per element, which is
     # ambiguous for array-valued keys and dispatches DNDarray.__eq__
-    if any(k is Ellipsis for k in keys):
-        i = next(j for j, k in enumerate(keys) if k is Ellipsis)
-        n_explicit = sum(_index_axis_span(k) for k in keys if k is not Ellipsis)
-        keys[i:i + 1] = [slice(None)] * (x.ndim - n_explicit)
-        if any(k is Ellipsis for k in keys):
-            return None
+    if _expand_ellipsis(keys, x.ndim) is None:
+        return None
     keys += [slice(None)] * (x.ndim - sum(_index_axis_span(k) for k in keys))
     hit = None
     axis = 0
@@ -1356,12 +1365,8 @@ def _match_mixed_key(x: DNDarray, key):
     keys = list(key) if isinstance(key, tuple) else [key]
     if any(k is None or isinstance(k, builtins.bool) for k in keys):
         return None
-    if any(k is Ellipsis for k in keys):
-        i = next(j for j, k in enumerate(keys) if k is Ellipsis)
-        n_explicit = sum(_index_axis_span(k) for k in keys if k is not Ellipsis)
-        keys[i:i + 1] = [slice(None)] * (x.ndim - n_explicit)
-        if any(k is Ellipsis for k in keys):
-            return None
+    if _expand_ellipsis(keys, x.ndim) is None:
+        return None
     keys += [slice(None)] * (x.ndim - sum(_index_axis_span(k) for k in keys))
     if len(keys) != x.ndim:
         return None
@@ -1422,12 +1427,8 @@ def _getitem_paired_arrays(x: DNDarray, key) -> Optional[DNDarray]:
     keys = list(key) if isinstance(key, tuple) else [key]
     if any(k is None or isinstance(k, builtins.bool) for k in keys):
         return None
-    if any(k is Ellipsis for k in keys):
-        i = next(j for j, k in enumerate(keys) if k is Ellipsis)
-        n_explicit = sum(_index_axis_span(k) for k in keys if k is not Ellipsis)
-        keys[i:i + 1] = [slice(None)] * (x.ndim - n_explicit)
-        if any(k is Ellipsis for k in keys):
-            return None
+    if _expand_ellipsis(keys, x.ndim) is None:
+        return None
     keys += [slice(None)] * (x.ndim - sum(_index_axis_span(k) for k in keys))
     if len(keys) != x.ndim:
         return None
@@ -1548,6 +1549,90 @@ def _getitem_mixed(x: DNDarray, keys, arr_pos, kind, arr) -> Optional[DNDarray]:
                     x.comm)
 
 
+def _getitem_split_slice(x: DNDarray, key) -> Optional[DNDarray]:
+    """Basic keys whose split-axis element is a non-trivial slice (or int):
+    the selection is an AFFINE map ``src(go) = start + go*step``, so one
+    scheduled window fetch re-chunks it into canonical layout — the
+    reference's global slice translation (``dndarray.py:656-912``) without
+    materializing the logical array. Other axes apply shard-locally."""
+    if x.split is None or x.comm.size <= 1 or x.ndim == 0:
+        return None
+    keys = list(key) if isinstance(key, tuple) else [key]
+    for k in keys:
+        if k is Ellipsis or isinstance(k, slice):
+            continue
+        if isinstance(k, builtins.int) and not isinstance(k, builtins.bool):
+            continue
+        return None
+    if _expand_ellipsis(keys, x.ndim) is None:
+        return None
+    keys += [slice(None)] * (x.ndim - len(keys))
+    if len(keys) != x.ndim:
+        return None
+    ks = keys[x.split]
+    n = x.gshape[x.split]
+    if isinstance(ks, slice):
+        st, sp, stp = ks.indices(n)
+        if st == 0 and stp == 1 and sp >= n:
+            return None  # full span (any spelling): zero-comm fast path
+    if isinstance(ks, builtins.int):
+        kk = ks + n if ks < 0 else ks
+        if not 0 <= kk < n:
+            raise IndexError(
+                f"index {ks} is out of bounds for axis {x.split} with size {n}")
+        start, step, L, drop = kk, 1, 1, True
+    else:
+        start, stop, step = ks.indices(n)
+        L = _slice_len(ks, n)
+        drop = False
+    # bounds-check + normalize the other ints, then apply them shard-locally
+    pre = []
+    for i, k in enumerate(keys):
+        if i == x.split:
+            pre.append(slice(None))
+        elif isinstance(k, builtins.int):
+            ni = x.gshape[i]
+            kkk = k + ni if k < 0 else k
+            if not 0 <= kkk < ni:
+                raise IndexError(
+                    f"index {k} is out of bounds for axis {i} with size {ni}")
+            pre.append(kkk)
+        else:
+            pre.append(k)
+    sub_phys = x.larray[tuple(pre)]
+    gshape1, new_split, dim = [], None, 0
+    for i, k in enumerate(keys):
+        if i == x.split:
+            new_split = dim
+            gshape1.append(n)
+            dim += 1
+        elif isinstance(k, slice):
+            gshape1.append(_slice_len(k, x.gshape[i]))
+            dim += 1
+        # ints drop the dim
+    if L == 0:
+        gshape0 = tuple(0 if i == new_split else s
+                        for i, s in enumerate(gshape1))
+        return DNDarray.from_logical(
+            jnp.zeros(gshape0, x.larray.dtype), new_split, x.device, x.comm,
+            dtype=x.dtype)
+    from . import _manips
+
+    comm = x.comm
+    fn = _manips.ring_slice_fn(
+        sub_phys.shape, jnp.dtype(sub_phys.dtype), new_split, start, step, L,
+        comm.chunk_size(L), comm)
+    out_phys = fn(sub_phys)
+    gshape2 = tuple(L if i == new_split else s for i, s in enumerate(gshape1))
+    res = DNDarray(out_phys, gshape2, x.dtype, new_split, x.device, comm)
+    if drop:
+        # single split-axis element: the dim disappears, result replicated
+        return DNDarray.from_logical(
+            jnp.squeeze(res._logical(), axis=new_split), None, x.device,
+            comm, dtype=x.dtype)
+    return res
+
+
 def _mask_physical(x: DNDarray, mask_like):
     """A physical split-0 bool array aligned with ``x``'s split axis chunks
     (padding positions False)."""
@@ -1661,6 +1746,9 @@ def _getitem_impl(x: DNDarray, key):
     paired = _getitem_paired_arrays(x, key)
     if paired is not None:
         return paired
+    sliced = _getitem_split_slice(x, key)
+    if sliced is not None:
+        return sliced
     key = _normalize_key(x, key)
     if _basic_key_fast_path(x, key):
         sub = x.larray[key]

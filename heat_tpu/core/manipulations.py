@@ -647,10 +647,29 @@ def roll(x: DNDarray, shift, axis=None) -> DNDarray:
 
 
 def rot90(m: DNDarray, k: int = 1, axes: Sequence[int] = (0, 1)) -> DNDarray:
-    """Rotate in a plane (reference ``:2100``)."""
+    """Rotate in a plane (reference ``:2100``): composed from the
+    distributed flip (window fetch) and transpose (local split remap) —
+    numpy's own decomposition — so split arrays never materialize."""
     axes = tuple(sanitize_axis(m.shape, ax) for ax in axes)
     if len(axes) != 2 or axes[0] == axes[1]:
         raise ValueError("len(axes) must be 2 and they must differ")
+    k = k % 4
+    if m.split is not None and m.comm.size > 1 and m.size > 0:
+        from .linalg import transpose
+
+        if k == 0:
+            from . import memory
+
+            return memory.copy(m)
+        if k == 2:
+            # one flip call: the non-split axis flips shard-locally and the
+            # split axis does a single window pass
+            return flip(m, axes)
+        order = list(range(m.ndim))
+        order[axes[0]], order[axes[1]] = order[axes[1]], order[axes[0]]
+        if k == 1:
+            return transpose(flip(m, axes[1]), order)
+        return flip(transpose(m, order), axes[1])  # k == 3
     res = jnp.rot90(m._logical(), k=k, axes=axes)
     out_split = m.split
     if out_split in axes and k % 4 != 0:
@@ -766,19 +785,29 @@ def squeeze(x: DNDarray, axis=None) -> DNDarray:
 
 
 def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
-    """Join along a new axis (reference ``:2720``)."""
+    """Join along a new axis (reference ``:2720``): expand_dims (local) +
+    concatenate — the new axis is unsharded, so matching-split inputs join
+    shard-locally."""
     arrays = list(arrays)
     shapes = {a.shape for a in arrays}
     if len(shapes) != 1:
         raise ValueError(f"all input arrays must have the same shape, got {shapes}")
     axis = sanitize_axis(tuple([len(arrays)] + list(arrays[0].shape)), axis)
-    logicals = [a._logical() for a in arrays]
-    res = jnp.stack(logicals, axis=axis)
     base_split = arrays[0].split
-    out_split = None
-    if base_split is not None:
-        out_split = base_split + (1 if axis <= base_split else 0)
-    result = _wrap_logical(res, out_split, arrays[0])
+    if (
+        base_split is not None
+        and arrays[0].comm.size > 1
+        and all(a.split == base_split for a in arrays)
+        and arrays[0].size > 0
+    ):
+        result = concatenate([expand_dims(a, axis) for a in arrays], axis)
+    else:
+        logicals = [a._logical() for a in arrays]
+        res = jnp.stack(logicals, axis=axis)
+        out_split = None
+        if base_split is not None:
+            out_split = base_split + (1 if axis <= base_split else 0)
+        result = _wrap_logical(res, out_split, arrays[0])
     if out is not None:
         out.larray = result.resplit(out.split).larray
         return out

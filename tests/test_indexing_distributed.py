@@ -397,6 +397,61 @@ class TestPairedArrays:
             x[np.array([0, 5]), np.array([0, 1])]
 
 
+class TestSplitSliceWindow:
+    """Basic slicing ALONG the split axis re-chunks through one window
+    fetch — x[100:200] must never materialize the logical array."""
+
+    a = np.arange(29 * 4, dtype=np.float32).reshape(29, 4)
+
+    @pytest.mark.parametrize("sl", [
+        slice(3, 21), slice(None, None, 2), slice(25, 2, -3),
+        slice(-5, None), slice(None, None, -1), slice(7, 8),
+    ])
+    def test_slices_match_numpy(self, sl, monkeypatch):
+        x = ht.array(self.a, split=0)
+        _guard_materialize(monkeypatch, 1,
+                           "split-axis slice materialized the array")
+        out = x[sl]
+        monkeypatch.undo()
+        assert_array_equal(out, self.a[sl], rtol=0)
+        if _multi():
+            assert out.split == 0
+
+    def test_slice_with_other_keys(self, monkeypatch):
+        x = ht.array(self.a, split=0)
+        _guard_materialize(monkeypatch, 5,
+                           "split-axis slice materialized the array")
+        out = x[4:19, 2]
+        monkeypatch.undo()
+        assert_array_equal(out, self.a[4:19, 2], rtol=0)
+
+    def test_int_at_split(self, monkeypatch):
+        x = ht.array(self.a, split=0)
+        _guard_materialize(monkeypatch, 5,
+                           "int-at-split materialized the array")
+        out = x[17]
+        monkeypatch.undo()
+        np.testing.assert_allclose(np.asarray(out.numpy()), self.a[17])
+        assert out.split is None
+
+    def test_split1(self, monkeypatch):
+        b = self.a.T.copy()
+        x = ht.array(b, split=1)
+        _guard_materialize(monkeypatch, 1,
+                           "split-1 slice materialized the array")
+        out = x[:, 5:23:3]
+        monkeypatch.undo()
+        assert_array_equal(out, b[:, 5:23:3], rtol=0)
+
+    def test_empty_slice(self):
+        x = ht.array(self.a, split=0)
+        assert x[9:9].shape == (0, 4)
+
+    def test_scalar_all_ints(self):
+        x = ht.array(self.a, split=0)
+        assert float(np.asarray(x[13, 2])) == self.a[13, 2]
+
+
 class TestDistributedNonzero:
     """nonzero keeps the result split and never materializes the logical
     array (reference ``heat/core/indexing.py:16``; round-2 VERDICT #10)."""
