@@ -1549,12 +1549,11 @@ def _getitem_mixed(x: DNDarray, keys, arr_pos, kind, arr) -> Optional[DNDarray]:
                     x.comm)
 
 
-def _getitem_split_slice(x: DNDarray, key) -> Optional[DNDarray]:
-    """Basic keys whose split-axis element is a non-trivial slice (or int):
-    the selection is an AFFINE map ``src(go) = start + go*step``, so one
-    scheduled window fetch re-chunks it into canonical layout — the
-    reference's global slice translation (``dndarray.py:656-912``) without
-    materializing the logical array. Other axes apply shard-locally."""
+def _parse_split_slice_key(x: DNDarray, key):
+    """Shared matcher for the split-axis slice paths: basic int/slice keys
+    (Ellipsis ok) whose split-axis element is a non-full slice or an int.
+    Returns ``(keys, start, step, L, is_int)`` or None; out-of-range ints
+    raise IndexError (getitem and setitem must agree on all of this)."""
     if x.split is None or x.comm.size <= 1 or x.ndim == 0:
         return None
     keys = list(key) if isinstance(key, tuple) else [key]
@@ -1572,19 +1571,29 @@ def _getitem_split_slice(x: DNDarray, key) -> Optional[DNDarray]:
     ks = keys[x.split]
     n = x.gshape[x.split]
     if isinstance(ks, slice):
-        st, sp, stp = ks.indices(n)
-        if st == 0 and stp == 1 and sp >= n:
-            return None  # full span (any spelling): zero-comm fast path
-    if isinstance(ks, builtins.int):
-        kk = ks + n if ks < 0 else ks
-        if not 0 <= kk < n:
-            raise IndexError(
-                f"index {ks} is out of bounds for axis {x.split} with size {n}")
-        start, step, L, drop = kk, 1, 1, True
-    else:
         start, stop, step = ks.indices(n)
-        L = _slice_len(ks, n)
-        drop = False
+        if start == 0 and step == 1 and stop >= n:
+            return None  # full span (any spelling): zero-comm fast path
+        return keys, start, step, _slice_len(ks, n), False
+    kk = ks + n if ks < 0 else ks
+    if not 0 <= kk < n:
+        raise IndexError(
+            f"index {ks} is out of bounds for axis {x.split} with size {n}")
+    return keys, kk, 1, 1, True
+
+
+def _getitem_split_slice(x: DNDarray, key) -> Optional[DNDarray]:
+    """Basic keys whose split-axis element is a non-trivial slice (or int):
+    the selection is an AFFINE map ``src(go) = start + go*step``, so one
+    scheduled window fetch re-chunks it into canonical layout — the
+    reference's global slice translation (``dndarray.py:656-912``) without
+    materializing the logical array. Other axes apply shard-locally."""
+    parsed = _parse_split_slice_key(x, key)
+    if parsed is None:
+        return None
+    keys, start, step, L, drop = parsed
+    n = x.gshape[x.split]
+    ks = keys[x.split]
     # bounds-check + normalize the other ints, then apply them shard-locally
     pre = []
     for i, k in enumerate(keys):
@@ -1828,10 +1837,14 @@ def _setitem_split_axis_advanced(x: DNDarray, kind, arr, value) -> builtins.bool
         return True
     c_in = idx_phys.shape[0] // comm.size
     if val_dn is not None and axis == 0 and val_dn.split == 0 and \
+            val_dn.gshape == (m,) + row_shape and \
             val_dn.larray.shape == (c_in * comm.size,) + row_shape:
-        # split-0 value whose chunks already align with the index chunks:
-        # feed the physical shards straight into the ring (padding rows pair
-        # with idx -1 and drop)
+        # split-0 value whose LOGICAL shape matches one row per index and
+        # whose chunks align with the index chunks: feed the physical shards
+        # straight into the ring (padding rows pair with idx -1 and drop).
+        # The gshape check matters: a shorter/broadcast value can share the
+        # padded physical shape and would silently write its padding rows
+        # (review finding)
         val_phys = val_dn.larray.astype(jdt)
     else:
         if val_dn is not None:
@@ -1905,6 +1918,61 @@ def _setitem_mixed(x: DNDarray, keys, arr_pos, kind, arr, value) -> builtins.boo
     return True
 
 
+def _setitem_split_slice(x: DNDarray, key, value) -> builtins.bool:
+    """``x[a:b:c] = v`` (and ``x[i] = v``) along the split axis without
+    materializing: the selected positions are an affine index sequence, so
+    the write is an integer scatter ring; non-trivial other-axis keys go
+    read-modify-write through the window-fetch getitem first."""
+    parsed = _parse_split_slice_key(x, key)
+    if parsed is None:
+        return False
+    keys, start, step, L, is_int = parsed
+    ks = keys[x.split]
+    if L == 0:
+        # empty selection: still validate the value shape like NumPy
+        target = tuple(
+            0 if i == x.split else
+            (_slice_len(k, x.gshape[i]) if isinstance(k, slice) else None)
+            for i, k in enumerate(keys))
+        target = tuple(t for t in target if t is not None)
+        vshape = (value.gshape if isinstance(value, DNDarray)
+                  else np.shape(value))
+        try:
+            np.broadcast_shapes(vshape, target)
+        except ValueError:
+            raise ValueError(
+                f"could not broadcast value of shape {vshape} to indexing "
+                f"result of shape {target}")
+        return True
+    idx_np = np.arange(L, dtype=np.int64) * step + start
+    sub = [k for i, k in enumerate(keys) if i != x.split]
+    if any(not (isinstance(k, slice) and k == slice(None)) for k in sub):
+        # read-modify-write: window-gather the addressed rows, write the
+        # basic sub-key locally, scatter back (same scheme as mixed keys)
+        gather_ks = ks if isinstance(ks, slice) else slice(start, start + 1)
+        slice_key = tuple(gather_ks if i == x.split else slice(None)
+                          for i in range(x.ndim))
+        rows = _getitem_impl(x, slice_key)
+        rows_key = tuple(slice(None) if i == x.split else k
+                         for i, k in enumerate(keys))
+        _setitem_impl(rows, rows_key, value)
+        value = rows
+    elif is_int:
+        # NumPy's target for x[i] = v drops the split dim; broadcast there
+        # and re-insert the unit axis the axis-keeping scatter expects
+        if isinstance(value, DNDarray):
+            value = value._logical()
+        row_shape = tuple(s_ for i, s_ in enumerate(x.gshape)
+                          if i != x.split)
+        try:
+            vb = jnp.broadcast_to(
+                jnp.asarray(value, x.larray.dtype), row_shape)
+        except (ValueError, TypeError):
+            return False  # invalid shapes raise on the general path
+        value = jnp.expand_dims(vb, x.split)
+    return _setitem_split_axis_advanced(x, "int", idx_np, value)
+
+
 def _setitem_impl(x: DNDarray, key, value):
     """Global assignment (reference ``__setitem__``, ``dndarray.py:1363-1652``)."""
     adv = _match_split_axis_array_key(x, key)
@@ -1912,6 +1980,8 @@ def _setitem_impl(x: DNDarray, key, value):
         return
     mixed = _match_mixed_key(x, key)
     if mixed is not None and _setitem_mixed(x, *mixed, value):
+        return
+    if _setitem_split_slice(x, key, value):
         return
     key = _normalize_key(x, key)
     if isinstance(value, DNDarray):
