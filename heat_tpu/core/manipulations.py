@@ -92,7 +92,9 @@ def broadcast_arrays(*arrays: DNDarray) -> List[DNDarray]:
 
 
 def broadcast_to(x: DNDarray, shape) -> DNDarray:
-    """Broadcast to a new shape (reference ``:140``)."""
+    """Broadcast to a new shape (reference ``:140``): the split axis keeps
+    its extent (a size-1 split axis resplits first), so the broadcast is
+    shard-local on the physical array."""
     shape = sanitize_shape(shape)
     out_split = None
     if x.split is not None:
@@ -100,6 +102,12 @@ def broadcast_to(x: DNDarray, shape) -> DNDarray:
         if x.shape[x.split] == 1 and shape[out_split] != 1:
             x = x.resplit(None)
             out_split = None
+    if out_split is not None and x.comm.size > 1:
+        phys_target = tuple(
+            x.larray.shape[x.split] if i == out_split else s
+            for i, s in enumerate(shape))
+        res = jnp.broadcast_to(x.larray, phys_target)
+        return DNDarray(res, shape, x.dtype, out_split, x.device, x.comm)
     res = jnp.broadcast_to(x._logical(), shape)
     return _wrap_logical(res, out_split, x)
 
