@@ -103,6 +103,14 @@ def broadcast_to(x: DNDarray, shape) -> DNDarray:
             x = x.resplit(None)
             out_split = None
     if out_split is not None and x.comm.size > 1:
+        if shape[out_split] != x.shape[x.split]:
+            # the fast path substitutes the physical extent below, so it
+            # must enforce what jnp.broadcast_to would have (review finding:
+            # a mismatched split-axis target silently mislabeled the result)
+            raise ValueError(
+                f"cannot broadcast shape {x.shape} to {shape}: the split "
+                f"axis extent must match (got {shape[out_split]} vs "
+                f"{x.shape[x.split]})")
         phys_target = tuple(
             x.larray.shape[x.split] if i == out_split else s
             for i, s in enumerate(shape))
