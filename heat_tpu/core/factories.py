@@ -408,19 +408,64 @@ def meshgrid(*arrays, indexing: str = "xy"):
     if not arrays:
         return []
     splits = [a.split if isinstance(a, DNDarray) else None for a in arrays]
-    logicals = [a._logical() if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
-    outs = jnp.meshgrid(*logicals, indexing=indexing)
-    # determine output split: first split input determines it
+    # determine output split: first split input determines it (numpy's xy
+    # swap of the first two grid dims only exists for >= 2 inputs)
     out_split = None
     for i, s in enumerate(splits):
         if s is not None:
             dim = i
-            if indexing == "xy" and i < 2:
+            if indexing == "xy" and i < 2 and len(arrays) >= 2:
                 dim = 1 - i
             out_split = dim
             break
     device = next((a.device for a in arrays if isinstance(a, DNDarray)), None)
     comm = next((a.comm for a in arrays if isinstance(a, DNDarray)), None)
+    comm_s = sanitize_comm(comm)
+    nd = len(arrays)
+    if out_split is not None and comm_s.size > 1:
+        # gather-free construction: each output is its 1-D vector reshaped
+        # to a unit-broadcast view and expanded shard-locally into the
+        # sharded target (the outputs are O(prod of all axes) big — the old
+        # path materialized every one of them logically)
+        def vec(a):
+            if isinstance(a, DNDarray):
+                return a if a.ndim == 1 else a.reshape((a.size,))
+            return array(jnp.asarray(a).reshape(-1), comm=comm_s,
+                         device=device)
+
+        vecs = [vec(a) for a in arrays]
+        grid_of = list(range(nd))
+        if indexing == "xy" and nd >= 2:
+            grid_of[0], grid_of[1] = 1, 0
+        shape = [0] * nd
+        for i, v in enumerate(vecs):
+            shape[grid_of[i]] = v.shape[0]
+        shape = tuple(shape)
+        if all(shape):  # zero-size axes: XLA replicates empty outputs and
+            # rejects the sharding constraint — the logical path handles them
+            phys_shape = tuple(
+                comm_s.padded_size(shape[d]) if d == out_split else shape[d]
+                for d in range(nd))
+            fn = jax.jit(jnp.broadcast_to, static_argnums=(1,),
+                         out_shardings=comm_s.sharding(nd, out_split))
+            outs = []
+            for i, v in enumerate(vecs):
+                pos = grid_of[i]
+                if pos == out_split and v.split == 0:
+                    base = v.larray  # keeps its shards; padding replicates
+                else:
+                    if v.split is not None:
+                        v = v.resplit(None)
+                    base = v._logical()  # a coordinate vector: O(axis) tiny
+                reshaped = base.reshape(
+                    tuple(phys_shape[d] if d == pos else 1
+                          for d in range(nd)))
+                outs.append(DNDarray(
+                    fn(reshaped, phys_shape), shape, v.dtype, out_split,
+                    v.device, comm_s))
+            return outs
+    logicals = [a._logical() if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays]
+    outs = jnp.meshgrid(*logicals, indexing=indexing)
     return [DNDarray.from_logical(o, out_split, device, comm) for o in outs]
 
 
