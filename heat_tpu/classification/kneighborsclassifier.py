@@ -1,12 +1,18 @@
 """K-nearest-neighbors classifier (reference
 ``heat/classification/kneighborsclassifier.py:45-136``).
 
-cdist to the training set (ring or GEMM tiles) → top-k smallest → one-hot
-vote, all on-device.
+cdist to the training set → top-k smallest → vote, all on-device. For a
+**split** training set the reference streams it block-by-block through the
+systolic ring of ``_dist`` (``heat/spatial/distance.py:280-362``) and merges
+per-block results; re-derived here as one shard_map ring program that
+circulates (train block, train labels) with ``ppermute`` and carries an
+online k-smallest merge of (distance, label) per test row — O(shard) memory,
+the training set is never replicated.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -15,6 +21,75 @@ from ..core.base import BaseEstimator, ClassificationMixin
 from ..core.dndarray import DNDarray
 
 __all__ = ["KNeighborsClassifier"]
+
+# jitted ring kernels keyed by (shapes, dtypes, k, comm key)
+_RING_CACHE: dict = {}
+
+
+def _label_sentinel(ldt):
+    """Largest value of the label dtype — the tie-break filler."""
+    if jnp.issubdtype(ldt, jnp.floating):
+        return jnp.asarray(jnp.inf, ldt)
+    if jnp.dtype(ldt) == jnp.bool_:
+        return jnp.asarray(True, ldt)
+    return jnp.asarray(jnp.iinfo(ldt).max, ldt)
+
+
+def _vote(carry_l, k):
+    """Per-row majority vote among the k carried labels with the
+    smallest-label tie-break (== the reference's ``argmax`` over votes
+    indexed by ascending unique classes, ``kneighborsclassifier.py:117``)."""
+    eq = carry_l[:, :, None] == carry_l[:, None, :]
+    counts = jnp.sum(eq, axis=1)  # counts[r, j] = #slots equal to label j
+    maxc = jnp.max(counts, axis=1, keepdims=True)
+    cand = jnp.where(counts == maxc, carry_l, _label_sentinel(carry_l.dtype))
+    return jnp.min(cand, axis=1)
+
+
+def _ring_predict_fn(comm, k, n_train, c_train, jdt, ldt, shapes):
+    key = ("knn_ring", k, n_train, shapes, str(jdt), str(ldt), comm.cache_key)
+    fn = _RING_CACHE.get(key)
+    if fn is not None:
+        return fn
+    size, axis = comm.size, comm.axis_name
+    perm = [(j, (j + 1) % size) for j in range(size)]
+    spec2 = comm.spec(2, 0)
+    spec1 = comm.spec(1, 0)
+
+    def body(x_blk, y_blk, lab_blk):
+        x_blk = x_blk.astype(jdt)
+        y_cur = y_blk.astype(jdt)
+        lab_cur = lab_blk
+        me = jax.lax.axis_index(axis)
+        r = x_blk.shape[0]
+        carry_d = jnp.full((r, k), jnp.inf, jdt)
+        carry_l = jnp.zeros((r, k), ldt)
+        x2 = jnp.sum(x_blk * x_blk, axis=1, keepdims=True)
+        for step in range(size):
+            src = (me - step) % size
+            # |x-y|² GEMM tile (MXU), one block of the distance matrix
+            y2 = jnp.sum(y_cur * y_cur, axis=1, keepdims=True).T
+            tile = jnp.maximum(x2 + y2 - 2.0 * (x_blk @ y_cur.T), 0.0)
+            valid = (src * c_train + jnp.arange(c_train)) < n_train
+            tile = jnp.where(valid[None, :], tile, jnp.inf)
+            alld = jnp.concatenate([carry_d, tile], axis=1)
+            alll = jnp.concatenate(
+                [carry_l, jnp.broadcast_to(lab_cur[None, :], tile.shape).astype(ldt)],
+                axis=1)
+            negd, idx = jax.lax.top_k(-alld, k)
+            carry_d = -negd
+            carry_l = jnp.take_along_axis(alll, idx, axis=1)
+            if step != size - 1:
+                y_cur = jax.lax.ppermute(y_cur, axis, perm)
+                lab_cur = jax.lax.ppermute(lab_cur, axis, perm)
+        return _vote(carry_l, k)
+
+    sm = jax.shard_map(
+        body, mesh=comm.mesh, in_specs=(spec2, spec2, spec1),
+        out_specs=spec1, check_vma=False)
+    fn = jax.jit(sm)
+    _RING_CACHE[key] = fn
+    return fn
 
 
 class KNeighborsClassifier(ClassificationMixin, BaseEstimator):
@@ -36,20 +111,44 @@ class KNeighborsClassifier(ClassificationMixin, BaseEstimator):
     def predict(self, x: DNDarray) -> DNDarray:
         """Vote among the k nearest training points (reference ``:80-136``).
 
-        The distance matrix stays split over the test rows — the k-nearest
-        selection and the vote are per-row local against the replicated
-        training labels, so only the winning labels exist per shard."""
+        Split training sets stream through the ring (one circulating block
+        per device, O(shard) memory); replicated training sets take the
+        zero-communication local-tile path.
+        """
         if self.x is None:
             raise RuntimeError("fit needs to be called before predict")
         from ..core import types as _types
-        from ..spatial.distance import cdist
 
         if x.split not in (None, 0):
             x = x.resplit(0)
-        d = cdist(x, self.x.resplit(None), quadratic_expansion=True)
         k = self.n_neighbors
-        import jax
+        comm = x.comm
 
+        if self.x.split == 0 and comm.size > 1:
+            if k > self.x.shape[0]:
+                raise ValueError(
+                    f"n_neighbors={k} exceeds the {self.x.shape[0]} training "
+                    "points")
+            if x.split is None:
+                x = x.resplit(0)
+            xt = self.x
+            yt = self.y if self.y.split == 0 else self.y.resplit(0)
+            jdt = jnp.promote_types(x.larray.dtype, xt.larray.dtype)
+            if not jnp.issubdtype(jdt, jnp.floating):
+                jdt = jnp.dtype(jnp.float32)
+            ldt = yt.larray.dtype
+            c_train = xt.larray.shape[0] // comm.size
+            fn = _ring_predict_fn(
+                comm, k, xt.shape[0], c_train, jdt, ldt,
+                (x.larray.shape, xt.larray.shape))
+            winner = fn(x.larray, xt.larray, yt.larray.reshape(-1))
+            return DNDarray(
+                winner, (x.shape[0],), _types.canonical_heat_type(winner.dtype),
+                0, x.device, comm)
+
+        from ..spatial.distance import cdist
+
+        d = cdist(x, self.x.resplit(None), quadratic_expansion=True)
         # k smallest distances → indices; axis 1 is unsharded, so top_k is
         # shard-local on the physical rows (padding rows produce garbage
         # votes that stay in padding)

@@ -37,15 +37,178 @@ from ._sort import (
     _float_key_dtype,
     _float_sort_key,
     _index_dtype,
+    _merge_split_network,
     _network_sort,
     _role_tables,
     _sentinel,
     batcher_rounds,
 )
 
-__all__ = ["distributed_unique"]
+__all__ = ["distributed_unique", "distributed_unique_rows"]
 
 _UNIQUE_CACHE: dict = {}
+
+
+def _network_row_sort(key_rows, payloads, rounds, role_tables, c, axis_name):
+    """Merge-split network over blocks of ROWS, ordered lexicographically.
+
+    ``key_rows``: (c, K) integer sort-key columns, column 0 most significant
+    (callers fold the padding flag in as column 0 and encode float columns
+    with :func:`_float_sort_key`). ``payloads``: tuple of (c, ...) arrays
+    co-moved with the rows (``jnp.take`` on axis 0). The shared
+    :func:`_sort._merge_split_network` round loop with the scalar comparator
+    replaced by ``jnp.lexsort`` over the key columns.
+    """
+    K = key_rows.shape[1]
+
+    def _merge(kr, pls):
+        # lexsort: last key is primary → feed columns least-significant first
+        order = jnp.lexsort([kr[:, j] for j in range(K - 1, -1, -1)])
+        return (jnp.take(kr, order, axis=0),
+                tuple(jnp.take(pl, order, axis=0) for pl in pls))
+
+    return _merge_split_network(
+        key_rows, payloads, rounds, role_tables, c, axis_name, _merge,
+        block_axis=0)
+
+
+def _row_keys(rows, gpos, n):
+    """(c, 1+w) lexsort keys for a (c, w) row block: padding flag (most
+    significant, 0 = real row) then each column in a NaN-safe monotone
+    integer encoding, all in the encoding's dtype (the 0/1 flag fits any)."""
+    if jnp.issubdtype(rows.dtype, jnp.floating):
+        enc = _float_sort_key(rows)
+    elif rows.dtype == jnp.bool_:
+        enc = rows.astype(jnp.int8)
+    else:
+        enc = rows
+    flag = (gpos >= n).astype(enc.dtype)[:, None]
+    return jnp.concatenate([flag, enc], axis=1)
+
+
+def _rows_phase_a_fn(c, w, jdt, n, comm):
+    """rows -> (sorted rows, original positions, first-occurrence mask,
+    global unique-row count). Row analogue of :func:`_phase_a_fn`."""
+    key = ("uniqRA", c, w, str(jdt), n, comm.cache_key)
+    fn = _UNIQUE_CACHE.get(key)
+    if fn is not None:
+        return fn
+    p = comm.size
+    rounds = batcher_rounds(p)
+    roles = _role_tables(rounds, p)
+    idt = _index_dtype()
+    spec2 = comm.spec(2, 0)
+    spec1 = comm.spec(1, 0)
+
+    def body(x):
+        me = jax.lax.axis_index(comm.axis_name)
+        gpos = me * c + jnp.arange(c, dtype=idt)
+        keys = _row_keys(x, gpos, n)
+        _, (xl, gi) = _network_row_sort(
+            keys, (x, gpos), rounds, roles, c, comm.axis_name)
+        spos = me * c + jnp.arange(c, dtype=idt)
+        # left halo: previous device's last row (device 0's first row is
+        # forced "first" below). Compare the RAW rows, not the encoded keys:
+        # the key encoding canonicalizes NaNs for ordering, but uniqueness
+        # follows elementwise ``!=`` — NaN != NaN, so each NaN-containing
+        # row is its own unique (torch semantics, like the scalar pipeline)
+        prev_last = jax.lax.ppermute(
+            xl[-1:], comm.axis_name,
+            perm=[(i, i + 1) for i in range(p - 1)])
+        prev = jnp.concatenate([prev_last, xl[:-1]], axis=0)
+        differs = jnp.any(xl != prev, axis=1)
+        mask = (spos < n) & ((spos == 0) | differs)
+        total = jax.lax.psum(jnp.sum(mask.astype(idt)), comm.axis_name)
+        return xl, gi, mask, total
+
+    fn = jax.jit(
+        shard_map(body, mesh=comm.mesh, in_specs=spec2,
+                  out_specs=(spec2, spec1, spec1, comm.spec(0, None)),
+                  check_vma=False)
+    )
+    _UNIQUE_CACHE[key] = fn
+    return fn
+
+
+def _rows_phase_b_fn(c, w, jdt, n, n_unique, comm, with_counts):
+    """(sorted rows, mask) -> compacted unique rows (+counts), front-aligned
+    in the c-chunk layout. Row analogue of :func:`_phase_b_fn`."""
+    key = ("uniqRB", c, w, str(jdt), n, n_unique, with_counts, comm.cache_key)
+    fn = _UNIQUE_CACHE.get(key)
+    if fn is not None:
+        return fn
+    p = comm.size
+    rounds = batcher_rounds(p)
+    roles = _role_tables(rounds, p)
+    idt = _index_dtype()
+    kmax = jnp.iinfo(idt).max
+    spec2 = comm.spec(2, 0)
+    spec1 = comm.spec(1, 0)
+
+    def body(xl, mask):
+        me = jax.lax.axis_index(comm.axis_name)
+        cnt = jnp.sum(mask.astype(idt))
+        offs = comm.exscan(cnt)
+        out_pos = jnp.where(mask, offs + jnp.cumsum(mask.astype(idt)) - 1,
+                            kmax)
+        spos = me * c + jnp.arange(c, dtype=idt)
+        _, (vals_s, spos_s) = _network_row_sort(
+            out_pos[:, None], (xl, spos), rounds, roles, c, comm.axis_name)
+        if not with_counts:
+            return (vals_s,)
+        nxt_first = jax.lax.ppermute(
+            spos_s[:1], comm.axis_name,
+            perm=[(i + 1, i) for i in range(p - 1)])
+        nxt = jnp.concatenate([spos_s[1:], nxt_first])
+        gout = me * c + jnp.arange(c, dtype=idt)
+        counts = jnp.where(
+            gout < n_unique - 1, nxt - spos_s,
+            jnp.where(gout == n_unique - 1, n - spos_s, 0))
+        return vals_s, counts
+
+    n_out = 2 if with_counts else 1
+    fn = jax.jit(
+        shard_map(body, mesh=comm.mesh, in_specs=(spec2, spec1),
+                  out_specs=(spec2,) + (spec1,) * (n_out - 1),
+                  check_vma=False)
+    )
+    _UNIQUE_CACHE[key] = fn
+    return fn
+
+
+def distributed_unique_rows(a, return_inverse: bool, return_counts: bool):
+    """Distributed unique ROWS of a 2-D split=0 DNDarray (the engine behind
+    ``unique(axis=k)``, reference ``manipulations.py:3051``): network
+    lexicographic row sort → halo row compare → psum count → network
+    compaction. Returns ``(uniques[, inverse][, counts])``; uniques/counts
+    split at 0 in the canonical layout for the unique count ``U``, inverse
+    split like ``a``."""
+    from .dndarray import DNDarray
+    from . import types
+
+    comm = a.comm
+    n, w = a.shape
+    c = comm.chunk_size(n)
+    jdt = jnp.dtype(a.larray.dtype)
+
+    sorted_phys, gi, mask, total = _rows_phase_a_fn(c, w, jdt, n, comm)(
+        a.filled(0) if a.pad else a.larray)
+    n_unique = int(total)  # the one host sync — the result size is dynamic
+
+    fb = _rows_phase_b_fn(c, w, jdt, n, n_unique, comm, return_counts)
+    compacted = fb(sorted_phys, mask)
+    uniques = DNDarray.from_logical(
+        compacted[0][:n_unique], 0, a.device, comm, dtype=a.dtype)
+    out = [uniques]
+    if return_inverse:
+        rank_s = _phase_c_fn(c, comm)(gi, mask)
+        out.append(DNDarray(
+            rank_s, (n,), types.canonical_heat_type(rank_s.dtype), 0,
+            a.device, comm))
+    if return_counts:
+        out.append(DNDarray.from_logical(
+            compacted[1][:n_unique], 0, a.device, comm))
+    return tuple(out)
 
 
 def _phase_a_fn(c, jdt, n, comm):

@@ -115,9 +115,53 @@ def _index_dtype():
     return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
 
 
+def _merge_split_network(key_block, payload_blocks, rounds, role_tables, c,
+                         axis_name, merge, block_axis):
+    """Shared Batcher merge-split round loop, inside shard_map.
+
+    ``merge(key, payloads) -> (key, payloads)`` locally sorts one (possibly
+    doubled) block along ``block_axis`` (-1 for scalar-key sorts, 0 for row
+    sorts). Each round ppermutes blocks between comparator pairs, merges,
+    and keeps the low/high half by role. Both sides of a pair MUST merge the
+    identical sequence (low-index block first): under tied keys a stable
+    sort of [own, recv] and [recv, own] disagree, and the kept halves would
+    no longer be complementary — tied payloads would be duplicated/dropped.
+    """
+    def halves(x):
+        if block_axis == 0:
+            return x[:c], x[c:]
+        return x[..., :c], x[..., c:]
+
+    xl, pls = merge(key_block, tuple(payload_blocks))
+    me = jax.lax.axis_index(axis_name)
+    for pairs, role in zip(rounds, role_tables):
+        perm = [(a, b) for a, b in pairs] + [(b, a) for a, b in pairs]
+        rx = jax.lax.ppermute(xl, axis_name, perm=perm)
+        rpls = tuple(jax.lax.ppermute(pl, axis_name, perm=perm) for pl in pls)
+        myrole = jnp.asarray(role)[me]
+
+        def ordered_concat(own, recv):
+            first = jnp.where(myrole == 2, recv, own)
+            second = jnp.where(myrole == 2, own, recv)
+            return jnp.concatenate([first, second], axis=block_axis)
+
+        both_v, both_p = merge(
+            ordered_concat(xl, rx),
+            tuple(ordered_concat(pl, rpl) for pl, rpl in zip(pls, rpls)),
+        )
+
+        def pick(low, high, keep):
+            return jnp.where(myrole == 1, low,
+                             jnp.where(myrole == 2, high, keep))
+
+        xl = pick(*halves(both_v), xl)
+        pls = tuple(pick(*halves(bp), pl) for bp, pl in zip(both_p, pls))
+    return xl, pls
+
+
 def _network_sort(key_block, payload_blocks, rounds, role_tables, c, descending,
                   axis_name, tie_block=None):
-    """Run the merge-split network on per-device blocks, inside shard_map.
+    """Merge-split network sort on per-device blocks, inside shard_map.
 
     ``key_block``: (..., c) sort keys, last axis is the (local chunk of the)
     sort axis. ``payload_blocks``: tuple of same-shaped arrays co-sorted with
@@ -145,35 +189,9 @@ def _network_sort(key_block, payload_blocks, rounds, role_tables, c, descending,
         )
 
     payload_blocks = ((tie_block,) if has_tie else ()) + tuple(payload_blocks)
-    xl, pls = _merge(key_block, tuple(payload_blocks))
-    me = jax.lax.axis_index(axis_name)
-    for pairs, role in zip(rounds, role_tables):
-        perm = [(a, b) for a, b in pairs] + [(b, a) for a, b in pairs]
-        rx = jax.lax.ppermute(xl, axis_name, perm=perm)
-        rpls = tuple(jax.lax.ppermute(pl, axis_name, perm=perm) for pl in pls)
-        myrole = jnp.asarray(role)[me]
-
-        # Both sides of a pair MUST merge the identical sequence (low-index
-        # block first): under tied keys a stable argsort of [own, recv] and
-        # [recv, own] disagree, and the kept halves would no longer be
-        # complementary — tied payloads would be duplicated/dropped.
-        def ordered_concat(own, recv):
-            first = jnp.where(myrole == 2, recv, own)
-            second = jnp.where(myrole == 2, own, recv)
-            return jnp.concatenate([first, second], axis=-1)
-
-        both_v, both_p = _merge(
-            ordered_concat(xl, rx),
-            tuple(ordered_concat(pl, rpl) for pl, rpl in zip(pls, rpls)),
-        )
-
-        def pick(low, high, keep):
-            return jnp.where(myrole == 1, low,
-                             jnp.where(myrole == 2, high, keep))
-
-        xl = pick(both_v[..., :c], both_v[..., c:], xl)
-        pls = tuple(pick(bp[..., :c], bp[..., c:], pl)
-                    for bp, pl in zip(both_p, pls))
+    xl, pls = _merge_split_network(
+        key_block, payload_blocks, rounds, role_tables, c, axis_name, _merge,
+        block_axis=-1)
     return xl, (pls[1:] if has_tie else pls)
 
 

@@ -10,9 +10,15 @@ tall-skinny QR that maps directly onto the mesh (SURVEY.md §7, M5):
 2. the stacked small R factors are QR-factored once       (replicated)
 3. local Qs are combined with the merge Q's row blocks    (MXU)
 
-For ``split=1`` or replicated operands the factorization is a single XLA
-``qr`` on the logical array (the reference's column-block Bcast loop
-``__split1_qr_loop`` ``:866`` is XLA's internal blocking here).
+For ``split=1`` the reference runs a column-block Bcast loop
+(``__split1_qr_loop`` ``:866-1042``): the owner factors the current panel,
+broadcasts its Q, everyone updates their trailing columns. Re-derived here
+as one jitted shard_map program (``_split1_qr``): a ``fori_loop`` over the
+device-aligned column panels where each step (1) broadcasts the owner's
+block with a masked ``psum`` (O(n·c) traffic — never the logical array),
+(2) QR-factors the panel replicated on every device (MXU), and (3) applies
+the block-Gram-Schmidt update ``A_i -= Q_j (Q_jᵀ A_i)`` locally. Replicated
+operands use a single XLA ``qr``.
 """
 
 from __future__ import annotations
@@ -29,6 +35,10 @@ from .. import types
 __all__ = ["qr"]
 
 QR = collections.namedtuple("QR", "Q, R")
+
+# jitted factorization programs keyed by (path, shape, dtype, comm key) —
+# rebuilding the shard_map closure per call would defeat jax's jit cache
+_QR_CACHE: dict = {}
 
 
 def qr(a: DNDarray, tiles_per_proc: int = 1, calc_q: bool = True, overwrite_a: bool = False) -> QR:
@@ -58,6 +68,8 @@ def qr(a: DNDarray, tiles_per_proc: int = 1, calc_q: bool = True, overwrite_a: b
         if n >= m * a.comm.size:
             return _tsqr(a, calc_q)
         return _caqr(a, calc_q)
+    if a.split == 1 and a.comm.size > 1 and n > 0 and m > 0:
+        return _split1_qr(a, calc_q)
 
     logical = a._logical()
     q, r = jnp.linalg.qr(logical, mode="reduced")
@@ -129,11 +141,16 @@ def _caqr(a: DNDarray, calc_q: bool) -> QR:
         _, qb, r_acc = jax.lax.fori_loop(0, npan, step, (ab, qb, r_acc))
         return qb, r_acc
 
-    fn = jax.jit(
-        shard_map(
-            body, mesh=comm.mesh, in_specs=comm.spec(2, 0),
-            out_specs=(comm.spec(2, 0), comm.spec(2, None)), check_vma=False)
-    )
+    cache_key = ("caqr", physical.shape, str(jdt), n, m, comm.cache_key)
+    fn = _QR_CACHE.get(cache_key)
+    if fn is None:
+        fn = jax.jit(
+            shard_map(
+                body, mesh=comm.mesh, in_specs=comm.spec(2, 0),
+                out_specs=(comm.spec(2, 0), comm.spec(2, None)),
+                check_vma=False)
+        )
+        _QR_CACHE[cache_key] = fn
     q_phys, r_rep = fn(physical)
     q_d = None
     if calc_q:
@@ -144,6 +161,93 @@ def _caqr(a: DNDarray, calc_q: bool) -> QR:
             a.device, a.comm)
     r_log = jnp.triu(r_rep[:k, :m])
     r_d = DNDarray.from_logical(r_log, None, a.device, a.comm)
+    return QR(q_d, r_d)
+
+
+def _split1_qr(a: DNDarray, calc_q: bool) -> QR:
+    """Distributed split=1 QR: device-aligned column-panel block
+    Gram-Schmidt (reference ``__split1_qr_loop``, ``qr.py:866-1042``).
+
+    One jitted shard_map program. For each of the ``ceil(k/c)`` panels
+    (``c`` = canonical column chunk, ``k = min(n, m)``): the owner's block
+    is broadcast with a masked ``psum`` (O(n·c) per round — the logical
+    array is never materialized), every device QR-factors the panel
+    replicated, computes its R rows ``Q_jᵀ A_i`` and subtracts the rank-c
+    update from its own columns. Q lands split=1 in A's exact column
+    layout (``k == m``); for wide inputs (``k = n < m``) the panel-layout
+    Q is re-chunked to the canonical (n, k) layout through the round-3
+    distributed slicing machinery.
+    """
+    from jax import shard_map
+
+    comm = a.comm
+    p = comm.size
+    n, m = a.shape
+    k = min(n, m)
+    c = a.larray.shape[1] // p
+    physical = a.filled(0) if a.pad else a.larray
+    if not jnp.issubdtype(physical.dtype, jnp.inexact):
+        # integer input: the logical-path jnp.linalg.qr promotes to float;
+        # match it (the loop carry must be dtype-stable)
+        physical = physical.astype(jnp.float32)
+    jdt = physical.dtype
+    npan = -(-k // c)  # only panels that intersect the first k columns
+    axis = comm.axis_name
+
+    def body(ab):
+        me = jax.lax.axis_index(axis)
+        q_acc = jnp.zeros((n, c), jdt)
+        r_acc = jnp.zeros((npan * c, c), jdt)
+
+        def step(j, carry):
+            ab, q_acc, r_acc = carry
+            # broadcast the owner's current block: masked psum, O(n*c)
+            panel = jax.lax.psum(
+                jnp.where(jnp.equal(me, j), ab, jnp.zeros((), jdt)), axis)
+            qj, _ = jnp.linalg.qr(panel, mode="reduced")
+            if qj.shape[1] < c:  # wide corner n < c: reduced Q is (n, n)
+                qj = jnp.pad(qj, ((0, 0), (0, c - qj.shape[1])))
+            # Q columns beyond k (ragged last panel / padded columns) come
+            # from QR of zero columns — arbitrary orthonormal junk that
+            # would pollute the trailing update; zero them.
+            panvalid = (j * c + jnp.arange(c)) < k
+            qj = qj * panvalid[None, :].astype(jdt)
+            rji = qj.conj().T @ ab  # my R rows for panel j: (c, c_local)
+            # block-upper-triangular structure: panel j only contributes
+            # to blocks at or right of j; exactly triangular on-diagonal
+            rji = jnp.where(jnp.equal(me, j), jnp.triu(rji), rji)
+            rji = jnp.where(jnp.less_equal(j, me), rji, jnp.zeros((), jdt))
+            ab = ab - qj @ rji
+            q_acc = jnp.where(jnp.equal(me, j), qj, q_acc)
+            r_acc = jax.lax.dynamic_update_slice(r_acc, rji, (j * c, 0))
+            return ab, q_acc, r_acc
+
+        _, q_acc, r_acc = jax.lax.fori_loop(
+            0, npan, step, (ab, q_acc, r_acc))
+        return q_acc, r_acc[:k, :]
+
+    cache_key = ("split1", physical.shape, str(jdt), n, m, comm.cache_key)
+    fn = _QR_CACHE.get(cache_key)
+    if fn is None:
+        spec = comm.spec(2, 1)
+        fn = jax.jit(
+            shard_map(
+                body, mesh=comm.mesh, in_specs=spec,
+                out_specs=(spec, spec), check_vma=False)
+        )
+        _QR_CACHE[cache_key] = fn
+    q_phys, r_phys = fn(physical)
+    ht_dt = types.canonical_heat_type(jdt)
+    r_d = DNDarray(r_phys, (k, m), ht_dt, 1, a.device, comm)
+    q_d = None
+    if calc_q:
+        if k == m:
+            q_d = DNDarray(q_phys, (n, m), ht_dt, 1, a.device, comm)
+        else:
+            # wide input: Q's k columns sit in A's panel layout; re-chunk
+            # to the canonical (n, k) split=1 layout (distributed slice)
+            q_full = DNDarray(q_phys, (n, m), ht_dt, 1, a.device, comm)
+            q_d = q_full[:, :k]
     return QR(q_d, r_d)
 
 
@@ -174,14 +278,19 @@ def _tsqr(a: DNDarray, calc_q: bool) -> QR:
         q_final = q1 @ my_q2
         return q_final, r2
 
-    fn = shard_map(
-        body,
-        mesh=comm.mesh,
-        in_specs=spec_split0,
-        out_specs=(spec_split0, spec_rep),
-        check_vma=False,
-    )
-    q_phys, r_rep = jax.jit(fn)(physical)
+    cache_key = ("tsqr", physical.shape, str(physical.dtype), n, m,
+                 comm.cache_key)
+    fn = _QR_CACHE.get(cache_key)
+    if fn is None:
+        fn = jax.jit(shard_map(
+            body,
+            mesh=comm.mesh,
+            in_specs=spec_split0,
+            out_specs=(spec_split0, spec_rep),
+            check_vma=False,
+        ))
+        _QR_CACHE[cache_key] = fn
+    q_phys, r_rep = fn(physical)
     # r_rep is replicated per device then stacked by shard_map on axis 0 of
     # the *global* result; out_specs=P() replication gives global (m, m)
     q_d = None

