@@ -41,6 +41,63 @@ N_FULL = 1 << 23  # 8.4M points × 64 features ≈ 2.1 GB f32 (accelerator run)
 N_CPU = 1 << 20  # 1M-point fallback so a CPU run finishes inside the budget
 N_TORCH = 1 << 19  # torch baseline sample, extrapolated linearly
 
+# Published per-chip peaks, keyed by a ``device_kind`` prefix:
+# (bf16 matmul TFLOP/s, HBM GB/s). v5e: 197 bf16 TFLOP/s, 16 GB @ 819 GB/s.
+_HW_PEAKS = {
+    "TPU v5 lite": (197.0, 819.0),
+    "TPU v5e": (197.0, 819.0),
+    "TPU v5p": (459.0, 2765.0),
+    "TPU v4": (275.0, 1228.0),
+    "TPU v6": (918.0, 1640.0),
+}
+
+
+def _hw_peaks():
+    """(bf16 peak TFLOP/s, HBM peak GB/s) for device 0, or None on CPU or an
+    unrecognized accelerator (no published roofline to judge against)."""
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    for prefix, peaks in _HW_PEAKS.items():
+        if kind.startswith(prefix):
+            return peaks
+    return None
+
+
+def matmul_bf16_tflops(m: int = 8192) -> float:
+    """Sustained bf16 matmul TFLOP/s of the framework's GEMM path — the MXU
+    utilization probe that contextualizes every other figure. A chained
+    ``x = (x @ w) * s`` ``fori_loop`` (one compiled executable, data-dependent
+    so XLA cannot elide iterations) is timed at two trip counts and
+    differenced, exactly like the KMeans number. The elementwise rescale
+    fuses into the GEMM epilogue and keeps magnitudes in bf16 range."""
+    import jax
+    import jax.numpy as jnp
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (m, m), jnp.bfloat16)
+    w = jax.random.normal(jax.random.fold_in(key, 1), (m, m), jnp.bfloat16)
+    scale = jnp.bfloat16(1.0 / m)
+
+    @jax.jit
+    def run(x, w, iters):
+        return jax.lax.fori_loop(0, iters, lambda _, a: (a @ w) * scale, x)
+
+    def timed(iters: int) -> float:
+        t0 = time.perf_counter()
+        out = run(x, w, iters)
+        float(np.asarray(out[0, 0]))  # real-completion fetch
+        return time.perf_counter() - t0
+
+    timed(2)  # compile + warm
+    lo, hi = 8, 40  # ≥180 ms of MXU work between the trip counts at m=8192
+    t_lo = min(timed(lo) for _ in range(3))
+    t_hi = min(timed(hi) for _ in range(3))
+    per_iter = (t_hi - t_lo) / (hi - lo)
+    if per_iter <= 0:
+        per_iter = t_hi / hi
+    return 2.0 * m**3 / per_iter / 1e12
+
 
 def tpu_kmeans_iter_per_s(n: int, d: int = 64, k: int = 8) -> float:
     import heat_tpu as ht
@@ -168,6 +225,41 @@ def _measure_main(n: int) -> None:
         sys.stderr.write(f"bench: cdist figure failed: {exc}\n")
         cdist_gbps = None
 
+    # Roofline accounting (round-3 verdict: relate throughput to hardware
+    # peak, not just report it). The Lloyd iteration's FLOP model counts the
+    # two GEMMs (assignment x·cᵀ + update one-hotᵀ·x: 4·n·d·k); its traffic
+    # model is the min-HBM bound of two passes over x (the GEMMs live in
+    # separate fusions): 2·n·d·4 bytes f32. Arithmetic intensity is then
+    # 4dk/(8d) = k/2 FLOP/byte — far below the MXU ridge (~240 on v5e), so
+    # the iteration is bandwidth-bound and ``kmeans_hbm_util`` is the
+    # meaningful utilization figure; ``kmeans_mfu`` is capped at
+    # AI/ridge ≈ 1.7% by the workload, not the implementation.
+    d_feats, k_cl = 64, 8
+    kmeans_tflops = 4.0 * n * d_feats * k_cl * ips / 1e12
+    kmeans_hbm_gbps = 2.0 * n * d_feats * 4 * ips / 1e9
+    peaks = _hw_peaks()
+    roofline = {}
+    if peaks is not None:
+        peak_tf, peak_gb = peaks
+        ridge = peak_tf * 1e3 / peak_gb  # FLOP/byte at the roofline knee
+        try:
+            mm_tf = matmul_bf16_tflops()
+        except Exception as exc:
+            sys.stderr.write(f"bench: matmul MFU probe failed: {exc}\n")
+            mm_tf = None
+        roofline = {
+            "hw_peak_bf16_tflops": peak_tf,
+            "hw_peak_hbm_gbps": peak_gb,
+            "kmeans_tflops": round(kmeans_tflops, 3),
+            "kmeans_mfu": round(kmeans_tflops / peak_tf, 4),
+            "kmeans_mfu_roofline_cap": round(
+                (4.0 * d_feats * k_cl) / (2.0 * d_feats * 4) / ridge, 4),
+            "kmeans_hbm_gbps": round(kmeans_hbm_gbps, 1),
+            "kmeans_hbm_util": round(kmeans_hbm_gbps / peak_gb, 3),
+            "matmul_bf16_tflops": None if mm_tf is None else round(mm_tf, 1),
+            "matmul_mfu": None if mm_tf is None else round(mm_tf / peak_tf, 3),
+        }
+
     label = f"{n / 2 ** 20:.0f}M" if n >= 1 << 20 else str(n)
     print(
         json.dumps(
@@ -179,6 +271,7 @@ def _measure_main(n: int) -> None:
                 "backend": backend,
                 "cdist_gbps": cdist_gbps,
                 "cdist_n": n_cdist,
+                **roofline,
             }
         )
     )
@@ -235,6 +328,9 @@ def main() -> None:
             return
         tail = (out.stderr or out.stdout or "").strip().splitlines()[-4:]
         errors.append(f"{label}: rc={out.returncode} " + " | ".join(tail))
+        # surface the failed plan's diagnostics even when a later plan
+        # succeeds (a swallowed accelerator failure looks like a choice)
+        sys.stderr.write(f"bench: plan failed — {errors[-1]}\n")
 
     # even the CPU fallback failed — still emit one parseable line
     print(

@@ -123,12 +123,12 @@ class KNeighborsClassifier(ClassificationMixin, BaseEstimator):
             x = x.resplit(0)
         k = self.n_neighbors
         comm = x.comm
+        if k > self.x.shape[0]:
+            raise ValueError(
+                f"n_neighbors={k} exceeds the {self.x.shape[0]} training "
+                "points")
 
         if self.x.split == 0 and comm.size > 1:
-            if k > self.x.shape[0]:
-                raise ValueError(
-                    f"n_neighbors={k} exceeds the {self.x.shape[0]} training "
-                    "points")
             if x.split is None:
                 x = x.resplit(0)
             xt = self.x

@@ -187,9 +187,11 @@ def _split1_qr(a: DNDarray, calc_q: bool) -> QR:
     c = a.larray.shape[1] // p
     physical = a.filled(0) if a.pad else a.larray
     if not jnp.issubdtype(physical.dtype, jnp.inexact):
-        # integer input: the logical-path jnp.linalg.qr promotes to float;
-        # match it (the loop carry must be dtype-stable)
-        physical = physical.astype(jnp.float32)
+        # integer input: the logical-path jnp.linalg.qr promotes to the
+        # default inexact dtype (float64 under x64); match it so Q/R dtype
+        # does not depend on the split layout
+        physical = physical.astype(
+            jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
     jdt = physical.dtype
     npan = -(-k // c)  # only panels that intersect the first k columns
     axis = comm.axis_name

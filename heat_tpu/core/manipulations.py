@@ -609,7 +609,18 @@ def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
             return factories.empty(
                 gshape, dtype=a.dtype, split=a.split, device=a.device,
                 comm=a.comm)
-        src = np.repeat(np.arange(a.shape[axis]), reps)
+        # source map computed ON DEVICE, split over the mesh (O(total/p)
+        # per device): output position i reads source row
+        # searchsorted(cumsum(reps), i, 'right'). Only the axis-length
+        # counts ever live host-side; a host np.repeat here would
+        # materialize the full output-length index.
+        pos = factories.arange(total, split=0, device=a.device, comm=a.comm)
+        cum = jnp.cumsum(jnp.asarray(reps, pos.larray.dtype))
+        src_phys = jnp.searchsorted(cum, pos.larray, side="right").astype(
+            pos.larray.dtype)
+        src = DNDarray(src_phys, (total,),
+                       types.canonical_heat_type(src_phys.dtype), 0,
+                       a.device, a.comm)
         key = (slice(None),) * axis + (src,)
         return a[key]
     if isinstance(repeats, DNDarray):

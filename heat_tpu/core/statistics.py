@@ -185,6 +185,13 @@ def bincount(x: DNDarray, weights=None, minlength: int = 0) -> DNDarray:
     output length — a dynamic shape) syncs to host."""
     if not types.heat_type_is_exact(x.dtype):
         raise TypeError("bincount requires an integer array")
+    if isinstance(weights, DNDarray):
+        if weights.gshape != x.gshape:
+            raise ValueError("weights and x don't have the same shape")
+        if weights.split != x.split:
+            # one reshard program onto x's layout keeps the shard-local
+            # count + psum path; the alternative is materializing both
+            weights = weights.resplit(x.split)
     if x.split is not None and x.comm.size > 1 and x.ndim == 1 and x.size > 0:
         comm = x.comm
         lo = int(jnp.min(x.filled(0)))

@@ -175,6 +175,12 @@ class TestKNNAndLaplacian:
             big_k.fit(ht.array(train, split=0), ht.array(labels, split=0))
             with pytest.raises(ValueError):
                 big_k.predict(ht.array(test, split=0))
+        # the guard must also fire on the replicated-train path (not just
+        # the ring path) — same misuse, same clear error
+        big_rep = ht.classification.KNeighborsClassifier(n_neighbors=31)
+        big_rep.fit(ht.array(train), ht.array(labels))
+        with pytest.raises(ValueError):
+            big_rep.predict(ht.array(test, split=0))
 
     @pytest.mark.parametrize("definition", ["simple", "norm_sym"])
     def test_laplacian_split_matches_replicated(self, definition):

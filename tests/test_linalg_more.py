@@ -297,6 +297,15 @@ class TestQRExtendedSweep:
         np.testing.assert_allclose(np.abs(qr.R.numpy()), np.abs(r_ref),
                                    rtol=1e-3, atol=1e-3)
 
+    def test_split1_qr_int_dtype_matches_replicated(self):
+        # integer input must promote to the same dtype regardless of split
+        a_np = np.arange(48, dtype=np.int64).reshape(8, 6) % 7
+        q_rep = ht.linalg.qr(ht.array(a_np)).Q
+        q_s1 = ht.linalg.qr(ht.array(a_np, split=1)).Q
+        assert q_s1.dtype == q_rep.dtype
+        np.testing.assert_allclose(np.abs(q_s1.numpy()), np.abs(q_rep.numpy()),
+                                   rtol=1e-4, atol=1e-4)
+
     def test_qr_error_paths(self):
         a = ht.array(np.zeros((8, 4), np.float32))
         with pytest.raises(TypeError):

@@ -41,6 +41,28 @@ class TestBincount:
             np.asarray(ht.bincount(x, weights=wd).numpy()),
             np.bincount(a, weights=w), rtol=1e-5)
 
+    def test_mismatched_split_weights_no_gather(self, monkeypatch):
+        # weights on a different split re-chunk through one reshard program
+        # instead of dropping to the materializing fallback
+        a = rng.integers(0, 5, 19).astype(np.int32)
+        w = rng.random(19).astype(np.float32)
+        x = ht.array(a, split=0)
+        wd = ht.array(w, split=None)
+        if ht.get_comm().size > 1:
+            def boom(self):  # pragma: no cover
+                raise AssertionError("bincount materialized the logical array")
+
+            monkeypatch.setattr(ht.DNDarray, "_logical", boom)
+        out = ht.bincount(x, weights=wd)
+        monkeypatch.undo()
+        np.testing.assert_allclose(
+            np.asarray(out.numpy()), np.bincount(a, weights=w), rtol=1e-5)
+
+    def test_weight_shape_mismatch_raises(self):
+        x = ht.array(np.array([0, 1, 2], np.int32), split=0)
+        with pytest.raises(ValueError):
+            ht.bincount(x, weights=ht.ones(5, split=0))
+
     def test_negative_raises(self):
         if ht.get_comm().size == 1:
             pytest.skip("the 1-device jnp fallback clips instead of raising")
