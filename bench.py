@@ -40,6 +40,8 @@ import numpy as np
 N_FULL = 1 << 23  # 8.4M points × 64 features ≈ 2.1 GB f32 (accelerator run)
 N_CPU = 1 << 20  # 1M-point fallback so a CPU run finishes inside the budget
 N_TORCH = 1 << 19  # torch baseline sample, extrapolated linearly
+D_FEATS = 64  # KMeans workload shape (reference benchmarks/kmeans: k=8, 64 feats)
+K_CLUSTERS = 8
 
 # Published per-chip peaks, keyed by a ``device_kind`` prefix:
 # (bf16 matmul TFLOP/s, HBM GB/s). v5e: 197 bf16 TFLOP/s, 16 GB @ 819 GB/s.
@@ -99,7 +101,7 @@ def matmul_bf16_tflops(m: int = 8192) -> float:
     return 2.0 * m**3 / per_iter / 1e12
 
 
-def tpu_kmeans_iter_per_s(n: int, d: int = 64, k: int = 8) -> float:
+def tpu_kmeans_iter_per_s(n: int, d: int = D_FEATS, k: int = K_CLUSTERS) -> float:
     import heat_tpu as ht
     from heat_tpu.cluster.kmeans import _lloyd_fori_fn
 
@@ -159,7 +161,8 @@ def tpu_cdist_gbps(n: int, d: int = 18) -> float:
     return out_bytes / per_call / 1e9
 
 
-def torch_kmeans_time_per_iter(n: int, d: int = 64, k: int = 8, iters: int = 3) -> float:
+def torch_kmeans_time_per_iter(n: int, d: int = D_FEATS, k: int = K_CLUSTERS,
+                               iters: int = 3) -> float:
     """Reference-equivalent local Lloyd iteration in PyTorch (CPU)."""
     import torch
 
@@ -234,7 +237,7 @@ def _measure_main(n: int) -> None:
     # the iteration is bandwidth-bound and ``kmeans_hbm_util`` is the
     # meaningful utilization figure; ``kmeans_mfu`` is capped at
     # AI/ridge ≈ 1.7% by the workload, not the implementation.
-    d_feats, k_cl = 64, 8
+    d_feats, k_cl = D_FEATS, K_CLUSTERS
     kmeans_tflops = 4.0 * n * d_feats * k_cl * ips / 1e12
     kmeans_hbm_gbps = 2.0 * n * d_feats * 4 * ips / 1e9
     peaks = _hw_peaks()

@@ -12,9 +12,9 @@ three-phase pipeline (:mod:`._setops`, vs Allgatherv ``:3051``), and
 ``topk`` the tournament reduction (vs ``mpi_topk`` ``:3971``).
 Array-valued ``repeat`` builds a source map from the cumulative counts and
 rides the distributed fancy-indexing rings; ``unique(axis=k)`` runs the
-lexicographic row pipeline (:mod:`._setops`). Only ``return_inverse`` for
-flattened ndim>1 inputs still falls back to the logical view (its shape
-convention is backend-specific).
+lexicographic row pipeline (:mod:`._setops`); ``return_inverse`` for
+flattened ndim>1 inputs rides the 1-D pipeline with a distributed reshape
+of the inverse back to the input shape.
 """
 
 from __future__ import annotations
@@ -1013,24 +1013,32 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False,
     """Unique elements (reference ``:3051``; ``return_counts`` exceeds the
     reference's signature, matching numpy's).
 
-    1-D split arrays run the fully distributed pipeline
+    Split arrays run the fully distributed pipelines
     (:mod:`heat_tpu.core._setops`: network sort → ppermute halo compare →
-    psum'd unique count → network compaction), never gathering the array;
-    the result is split and always sorted. Other cases (``axis=`` uniques,
-    multi-dim flatten) fall back to the gathered logical array — the
-    dynamic-shape semantic of SURVEY.md §7 hard part 4.
+    psum'd unique count → network compaction; row-lexicographic variant for
+    ``axis=``; ndim>1 flattens through the distributed reshape), never
+    gathering the array; results are split and always sorted. Complex
+    dtypes with ``axis=`` keep the logical path.
     """
     if (axis is None and a.split is not None and a.comm.size > 1
             and a.ndim == 1 and a.shape[0] > 0):
         from ._setops import distributed_unique
 
         return distributed_unique(a, return_inverse, return_counts)
-    if (axis is None and not return_inverse and a.split is not None
+    if (axis is None and a.split is not None
             and a.comm.size > 1 and a.ndim > 1 and a.size > 0):
         # numpy flattens for axis=None: the distributed flatten (ring
-        # reshape) feeds the 1-D distributed pipeline. Inverse indices keep
-        # the logical path (their shape convention is backend-specific).
-        return unique(flatten(a), sorted=sorted, return_counts=return_counts)
+        # reshape) feeds the 1-D distributed pipeline; inverse indices ride
+        # the same pipeline and reshape back to the input's shape (the
+        # package's convention, matching the logical path below).
+        from ._setops import distributed_unique
+
+        res = distributed_unique(flatten(a), return_inverse, return_counts)
+        if not return_inverse:
+            return res
+        out = list(res) if isinstance(res, tuple) else [res]
+        out[1] = reshape(out[1], a.shape)
+        return tuple(out)
     if (axis is not None and a.split is not None and a.comm.size > 1
             and a.size > 0
             and not jnp.issubdtype(a.larray.dtype, jnp.complexfloating)):

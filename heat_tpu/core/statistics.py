@@ -159,11 +159,14 @@ _COUNT_CACHE: dict = {}
 
 def _aligned_weight_phys(x: DNDarray, weights):
     """Weights as a physical array aligned with ``x``'s shards (same split,
-    same chunks), or None when the alignment needs a fallback."""
+    same chunks — same-shape weights on a different layout re-chunk through
+    one reshard program), or None when the alignment needs a fallback."""
     if weights is None:
         return jnp.ones(x.larray.shape, jnp.float64 if jax.config.jax_enable_x64
                         else jnp.float32)
     if isinstance(weights, DNDarray):
+        if weights.gshape == x.gshape and weights.split != x.split:
+            weights = weights.resplit(x.split)
         if weights.split == x.split and weights.larray.shape == x.larray.shape:
             return weights.larray
         return None
@@ -185,13 +188,8 @@ def bincount(x: DNDarray, weights=None, minlength: int = 0) -> DNDarray:
     output length — a dynamic shape) syncs to host."""
     if not types.heat_type_is_exact(x.dtype):
         raise TypeError("bincount requires an integer array")
-    if isinstance(weights, DNDarray):
-        if weights.gshape != x.gshape:
-            raise ValueError("weights and x don't have the same shape")
-        if weights.split != x.split:
-            # one reshard program onto x's layout keeps the shard-local
-            # count + psum path; the alternative is materializing both
-            weights = weights.resplit(x.split)
+    if isinstance(weights, DNDarray) and weights.gshape != x.gshape:
+        raise ValueError("weights and x don't have the same shape")
     if x.split is not None and x.comm.size > 1 and x.ndim == 1 and x.size > 0:
         comm = x.comm
         lo = int(jnp.min(x.filled(0)))

@@ -35,13 +35,18 @@ if jax.default_backend() != "cpu":
 # device count (1, 2, 4, 8, …): tests read the size from the communicator
 # rather than assuming 8.
 
-# Persistent XLA compilation cache: the suite's wall-clock is dominated by
-# compiles (hundreds of distinct shard_map programs), so repeat runs — the
-# CI ladder in particular — reuse compiled executables across processes
-# (round-3 VERDICT weak #7; the reference's 15-min CI envelope,
-# Jenkinsfile:19-33). Override the location with HEAT_TPU_JIT_CACHE;
-# set it empty to disable.
-_cache_dir = os.environ.get("HEAT_TPU_JIT_CACHE", "/tmp/heat_tpu_jit_cache")
+# Persistent XLA compilation cache — OPT-IN via HEAT_TPU_JIT_CACHE=<dir>.
+# It was default-on for one round, but reloading XLA:CPU AOT executables on
+# this host is unsound: the loader logs machine-feature mismatches
+# ("+prefer-no-scatter … could lead to execution errors such as SIGILL")
+# and warm-cache runs reproducibly die with "Fatal Python error: Aborted"
+# inside a deserialized executable (test_transformer remat, 2026-08-01 —
+# twice, while cold runs pass). On a multi-core CI host, wall-clock comes
+# from pytest-xdist file-level parallelism instead
+# (``-n auto --dist loadfile``; loadfile keeps each module's shared-rng
+# draw order intact) — this 1-core container runs the suite serially,
+# compile-dominated, in ~30 min.
+_cache_dir = os.environ.get("HEAT_TPU_JIT_CACHE", "")
 if _cache_dir:
     try:
         jax.config.update("jax_compilation_cache_dir", _cache_dir)

@@ -124,6 +124,31 @@ class TestTriSolve:
             np.testing.assert_allclose(np.asarray(L.numpy()) @ np.asarray(L.numpy()).T, spd,
                                        rtol=1e-6, atol=1e-8)
 
+    def test_cholesky_distributed_no_materialization(self, monkeypatch):
+        # blocked panel cholesky (uneven n exercises the padded identity
+        # rows); the logical array must never materialize. Private rng:
+        # the module stream feeds later tests' data.
+        myrng = np.random.default_rng(404)
+        for n in (17, 24):
+            a = myrng.normal(size=(n, n)).astype(np.float64)
+            spd = a @ a.T + n * np.eye(n)
+            for split in (0, 1):
+                x = ht.array(spd, split=split)
+                if ht.get_comm().size > 1:
+                    def boom(self):  # pragma: no cover
+                        raise AssertionError(
+                            "cholesky materialized the logical array")
+
+                    monkeypatch.setattr(ht.DNDarray, "_logical", boom)
+                L = ht.linalg.cholesky(x)
+                monkeypatch.undo()
+                if ht.get_comm().size > 1:
+                    assert L.split == 0
+                ln = np.asarray(L.numpy())
+                np.testing.assert_allclose(ln, np.tril(ln), atol=0)
+                np.testing.assert_allclose(
+                    ln @ ln.T, spd, rtol=1e-8, atol=1e-8)
+
     def test_eigh_symmetric(self):
         a = rng.normal(size=(7, 7)).astype(np.float64)
         sym = (a + a.T) / 2

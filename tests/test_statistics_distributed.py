@@ -126,6 +126,23 @@ class TestHistogram:
                                density=True)
         np.testing.assert_allclose(np.asarray(hist.numpy()), want, rtol=1e-4)
 
+    def test_histogram_mismatched_split_weights_no_gather(self, monkeypatch):
+        # replicated weights against a split input align through one
+        # reshard program, not the materializing fallback
+        a = rng.random(23).astype(np.float32)
+        w = rng.random(23).astype(np.float32)
+        x = ht.array(a, split=0)
+        wd = ht.array(w, split=None)
+        if ht.get_comm().size > 1:
+            def boom(self):  # pragma: no cover
+                raise AssertionError("histogram materialized the logical array")
+
+            monkeypatch.setattr(ht.DNDarray, "_logical", boom)
+        hist, _ = ht.histogram(x, bins=5, range=(0.0, 1.0), weights=wd)
+        monkeypatch.undo()
+        want, _ = np.histogram(a, bins=5, range=(0.0, 1.0), weights=w)
+        np.testing.assert_allclose(np.asarray(hist.numpy()), want, rtol=1e-4)
+
     def test_histc_all_equal_degenerate_range(self):
         # review regression: distributed histc must expand a lo==hi range
         # exactly like jnp.histogram does

@@ -40,6 +40,27 @@ def test_unique_inverse_counts_random():
     np.testing.assert_array_equal(nu[np.asarray(inv.numpy())], data)
 
 
+def test_unique_ndim2_flatten_inverse_no_gather(monkeypatch):
+    # ndim>1 + return_inverse rides the 1-D pipeline with a distributed
+    # reshape of the inverse back to the input's shape (closed round 4)
+    data = rng.integers(0, 9, (13, 6)).astype(np.int32)
+    x = ht.array(data, split=0)
+    nu = np.unique(data)
+    if ht.get_comm().size > 1:
+        def boom(self):  # pragma: no cover
+            raise AssertionError("unique materialized the logical array")
+
+        monkeypatch.setattr(ht.DNDarray, "_logical", boom)
+    u, inv, cnt = ht.unique(x, return_inverse=True, return_counts=True)
+    monkeypatch.undo()
+    np.testing.assert_array_equal(np.asarray(u.numpy()), nu)
+    assert inv.shape == data.shape
+    np.testing.assert_array_equal(nu[np.asarray(inv.numpy())], data)
+    np.testing.assert_array_equal(
+        np.asarray(cnt.numpy()),
+        np.unique(data, return_counts=True)[1])
+
+
 def test_unique_all_same_and_all_distinct():
     same = np.full(31, 5, dtype=np.int32)
     x = ht.array(same, split=0)
