@@ -161,6 +161,62 @@ def tpu_cdist_gbps(n: int, d: int = 18) -> float:
     return out_bytes / per_call / 1e9
 
 
+def transformer_train_metrics(B: int = 8, S: int = 1024, d_model: int = 1024,
+                              n_layers: int = 8, n_heads: int = 16,
+                              vocab: int = 32768) -> dict:
+    """Flagship-model figure: full TransformerLM train step (fwd + bwd +
+    adam, bf16 compute, ring attention, donated buffers) on one chip —
+    tokens/second and the standard approximate train MFU
+    (``(6·N_params + 12·L·S·d)·tokens`` FLOPs per step, PaLM-appendix
+    accounting). Same two-trip-count differenced timing as every figure;
+    the donated params/opt_state roll forward between timed calls."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import heat_tpu as ht
+    from heat_tpu.nn.transformer import TransformerLM, TransformerLMConfig
+
+    grid = ht.MeshGrid((1, 1, 1, 1), ("dp", "pp", "tp", "sp"),
+                       devices=jax.devices()[:1])
+    cfg = TransformerLMConfig(vocab=vocab, d_model=d_model, n_heads=n_heads,
+                              n_layers=n_layers, compute_dtype=jnp.bfloat16)
+    model = TransformerLM(grid, cfg)
+    state = {"p": model.init(0)}
+    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(state["p"]))
+    tx = optax.adam(1e-3)
+    state["o"] = tx.init(state["p"])
+    step = model.make_train_step(tx)
+    toks = model.shard_batch(
+        np.random.default_rng(0).integers(0, vocab, (B, S)).astype(np.int32))
+
+    def timed(steps: int) -> float:
+        p, o = state["p"], state["o"]
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            p, o, loss = step(p, o, toks)
+        float(np.asarray(loss))  # real-completion fetch
+        dt = time.perf_counter() - t0
+        state["p"], state["o"] = p, o  # donated originals are gone
+        return dt
+
+    timed(1)  # compile + warm
+    lo, hi = 2, 10
+    t_lo = min(timed(lo) for _ in range(2))
+    t_hi = min(timed(hi) for _ in range(2))
+    per_step = (t_hi - t_lo) / (hi - lo)
+    if per_step <= 0:
+        per_step = t_hi / hi
+    tokens = float(B) * S
+    flops_per_step = (6.0 * n_params + 12.0 * n_layers * S * d_model) * tokens
+    return {
+        "transformer_tokens_per_s": round(tokens / per_step, 1),
+        "transformer_tflops": round(flops_per_step / per_step / 1e12, 2),
+        "transformer_n_params": n_params,
+        "transformer_shape": f"L{n_layers}_d{d_model}_h{n_heads}_B{B}_S{S}",
+    }
+
+
 def torch_kmeans_time_per_iter(n: int, d: int = D_FEATS, k: int = K_CLUSTERS,
                                iters: int = 3) -> float:
     """Reference-equivalent local Lloyd iteration in PyTorch (CPU)."""
@@ -199,7 +255,17 @@ def _measure_main(n: int) -> None:
     # parent falls back to the CPU plan.
     import threading
 
+    printed = threading.Event()  # a base JSON line is already on stdout
+
     def _deadline():
+        if printed.is_set():
+            # the headline figures are out — exit clean so the parent
+            # uses them; only the optional enriched line is lost
+            sys.stderr.write(
+                "bench: optional stage exceeded the 1800s budget after the "
+                "base line printed — keeping the base measurement.\n")
+            sys.stdout.flush()
+            os._exit(0)
         sys.stderr.write(
             "bench: measurement exceeded 1800s — the accelerator runtime hung "
             "after initialization (mid-compile or mid-execute). Aborting "
@@ -264,20 +330,31 @@ def _measure_main(n: int) -> None:
         }
 
     label = f"{n / 2 ** 20:.0f}M" if n >= 1 << 20 else str(n)
-    print(
-        json.dumps(
-            {
-                "metric": f"kmeans_lloyd_iterations_per_second_{label}_x64_k8_f32",
-                "value": round(ips, 3),
-                "unit": "iter/s",
-                "vs_baseline": round(ips / baseline_ips, 3),
-                "backend": backend,
-                "cdist_gbps": cdist_gbps,
-                "cdist_n": n_cdist,
-                **roofline,
-            }
-        )
-    )
+    record = {
+        "metric": f"kmeans_lloyd_iterations_per_second_{label}_x64_k8_f32",
+        "value": round(ips, 3),
+        "unit": "iter/s",
+        "vs_baseline": round(ips / baseline_ips, 3),
+        "backend": backend,
+        "cdist_gbps": cdist_gbps,
+        "cdist_n": n_cdist,
+        **roofline,
+    }
+    print(json.dumps(record), flush=True)
+    printed.set()
+
+    # optional flagship figure — the parent takes the LAST JSON line, so a
+    # success replaces the base record with a superset and any failure
+    # (including the downgraded watchdog) keeps the base record
+    if backend != "cpu":
+        try:
+            tr = transformer_train_metrics()
+            if peaks is not None:
+                tr["transformer_mfu"] = round(
+                    tr["transformer_tflops"] / peaks[0], 3)
+            print(json.dumps({**record, **tr}), flush=True)
+        except Exception as exc:
+            sys.stderr.write(f"bench: transformer figure failed: {exc}\n")
 
 
 def _probe_default_backend(timeout_s: float):
