@@ -101,7 +101,11 @@ def matmul_bf16_tflops(m: int = 8192) -> float:
     return 2.0 * m**3 / per_iter / 1e12
 
 
-def tpu_kmeans_iter_per_s(n: int, d: int = D_FEATS, k: int = K_CLUSTERS) -> float:
+def tpu_kmeans_iter_per_s(n: int, d: int = D_FEATS, k: int = K_CLUSTERS,
+                          dtype: str = None) -> float:
+    """``dtype="bfloat16"`` measures the half-precision-storage variant
+    (mixed-precision Lloyd step: bf16 HBM reads + MXU inputs, f32
+    accumulation — half the traffic of the bandwidth-bound iteration)."""
     import heat_tpu as ht
     from heat_tpu.cluster.kmeans import _lloyd_fori_fn
 
@@ -110,9 +114,9 @@ def tpu_kmeans_iter_per_s(n: int, d: int = D_FEATS, k: int = K_CLUSTERS) -> floa
     ht.random.seed(0)
     x = ht.random.rand(n, d, dtype=ht.float32, split=0)
     comm = x.comm
-    xp = x.larray
+    xp = x.larray if dtype is None else x.larray.astype(jnp.dtype(dtype))
     centroids = jnp.asarray(np.random.default_rng(0).random((k, d), dtype=np.float32))
-    run = _lloyd_fori_fn(xp.shape, jnp.dtype(jnp.float32), k, n, comm)
+    run = _lloyd_fori_fn(xp.shape, jnp.dtype(xp.dtype), k, n, comm)
 
     def timed(iters: int) -> float:
         t0 = time.perf_counter()
@@ -343,10 +347,21 @@ def _measure_main(n: int) -> None:
     print(json.dumps(record), flush=True)
     printed.set()
 
-    # optional flagship figure — the parent takes the LAST JSON line, so a
-    # success replaces the base record with a superset and any failure
-    # (including the downgraded watchdog) keeps the base record
+    # optional stages AFTER the base record is out — the parent takes the
+    # LAST JSON line, so each success replaces the record with a superset
+    # and any failure or hang (downgraded watchdog) keeps what's printed
     if backend != "cpu":
+        # half-precision-storage companion figure: same workload, bf16 HBM
+        # traffic (the honest ~2x lever on a bandwidth-bound step)
+        try:
+            ips16 = tpu_kmeans_iter_per_s(n, dtype="bfloat16")
+            record["kmeans_bf16_iter_per_s"] = round(ips16, 3)
+            if peaks is not None:
+                record["kmeans_bf16_hbm_util"] = round(
+                    2.0 * n * D_FEATS * 2 * ips16 / 1e9 / peaks[1], 3)
+            print(json.dumps(record), flush=True)
+        except Exception as exc:
+            sys.stderr.write(f"bench: bf16 kmeans figure failed: {exc}\n")
         try:
             tr = transformer_train_metrics()
             if peaks is not None:

@@ -40,12 +40,27 @@ __all__ = ["KMeans"]
 _STEP_CACHE: dict = {}
 
 
+def _acc_dtype(jdt):
+    """Accumulation dtype: half-precision inputs (native bf16 storage —
+    half the HBM traffic of the bandwidth-bound Lloyd step, native MXU
+    rate) accumulate distances/sums/inertia in float32; everything else
+    accumulates in its own dtype."""
+    jdt = jnp.dtype(jdt)
+    if jdt in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
+        return jnp.dtype(jnp.float32)
+    return jdt
+
+
 def _finish_update(sums, counts, centroids):
-    """Centroid division + empty-cluster keep + shift (replicated inputs)."""
+    """Centroid division + empty-cluster keep + shift (replicated inputs).
+    Runs in the accumulation dtype; the returned centroids match the
+    carried-in centroid dtype so iteration carries stay dtype-stable."""
+    acc = sums.dtype
+    cacc = centroids.astype(acc)
     new_centroids = sums / jnp.maximum(counts, 1.0)[:, None]
-    new_centroids = jnp.where((counts > 0)[:, None], new_centroids, centroids)
-    shift = jnp.sum((new_centroids - centroids) ** 2)
-    return new_centroids, shift
+    new_centroids = jnp.where((counts > 0)[:, None], new_centroids, cacc)
+    shift = jnp.sum((new_centroids - cacc) ** 2)
+    return new_centroids.astype(centroids.dtype), shift
 
 
 def _make_step_body(phys_shape, jdt, k, n_valid, comm, sums_mode):
@@ -78,27 +93,50 @@ def _make_step_body(phys_shape, jdt, k, n_valid, comm, sums_mode):
             out_specs=(P(), P(), P()),
             check_vma=False)
 
+    acc = _acc_dtype(jdt)
+
     def _step(xp, centroids):
         # valid-row mask for canonical padding
         row = jax.lax.broadcasted_iota(jnp.int32, (phys_shape[0], 1), 0)
         valid = row < n_valid
-        x2 = jnp.sum(xp * xp, axis=1, keepdims=True)
-        c2 = jnp.sum(centroids * centroids, axis=1, keepdims=True).T
-        d2 = x2 + c2 - 2.0 * (xp @ centroids.T)  # (N_pad, k) GEMM tile
+        # elementwise consumers cast in-register (HBM reads stay bf16 for
+        # half-precision storage); GEMMs take the narrow inputs at MXU
+        # rate and accumulate in ``acc`` via preferred_element_type
+        xf = xp.astype(acc)
+        x2 = jnp.sum(xf * xf, axis=1, keepdims=True)
+        cacc = centroids.astype(acc)
+        c2 = jnp.sum(cacc * cacc, axis=1, keepdims=True).T
+        xc = jax.lax.dot_general(
+            xp, centroids.astype(jdt),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=acc)
+        d2 = x2 + c2 - 2.0 * xc  # (N_pad, k) distances in acc
         labels = jnp.argmin(d2, axis=1)
         onehot = (labels[:, None] == jnp.arange(k)[None, :]) & valid
-        onehot_f = onehot.astype(xp.dtype)
-        counts = jnp.sum(onehot_f, axis=0)  # (k,)  — psum by GSPMD
-        sums = onehot_f.T @ xp  # (k, d) GEMM — psum by GSPMD
-        inertia = jnp.sum(jnp.where(valid[:, 0], jnp.min(d2, axis=1), 0.0))
+        counts = jnp.sum(onehot.astype(acc), axis=0)  # (k,) — psum by GSPMD
+        sums = jax.lax.dot_general(  # (k, d) GEMM — psum by GSPMD
+            onehot.astype(jdt), xp,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=acc)
+        inertia = jnp.sum(jnp.where(valid[:, 0], jnp.min(d2, axis=1),
+                                    jnp.zeros((), acc)))
         new_centroids, shift = _finish_update(sums, counts, centroids)
         return new_centroids, inertia, shift
 
     return _step
 
 
+def _use_pallas_step(jdt) -> bool:
+    """The fused kernel returns sums/counts/inertia in the INPUT dtype
+    (``pallas_kernels._kmeans_step_tile``); half-precision inputs would
+    round cluster counts >256 before the psum, so they stay on the XLA
+    mixed-precision path (bf16 reads, f32 accumulation)."""
+    return (kmeans_pallas_enabled()
+            and _acc_dtype(jdt) == jnp.dtype(jdt))
+
+
 def _lloyd_step_fn(phys_shape, jdt, k, n_valid, comm):
-    sums_mode = kmeans_pallas_enabled() and _kmeans_sums_mode()
+    sums_mode = _use_pallas_step(jdt) and _kmeans_sums_mode()
     key = (phys_shape, str(jdt), k, n_valid, comm.cache_key, sums_mode)
     fn = _STEP_CACHE.get(key)
     if fn is None:
@@ -117,15 +155,24 @@ def _assign_fn(phys_shape, jdt, k, n_valid, comm):
     fn = _STEP_CACHE.get(key)
     if fn is None:
 
+        acc = _acc_dtype(jdt)
+
         def _assign(xp, centroids):
             row = jax.lax.broadcasted_iota(jnp.int32, (phys_shape[0],), 0)
             valid = row < n_valid
-            c2 = jnp.sum(centroids * centroids, axis=1)[None, :]
-            scores = c2 - 2.0 * (xp @ centroids.T)
+            cacc = centroids.astype(acc)
+            c2 = jnp.sum(cacc * cacc, axis=1)[None, :]
+            xc = jax.lax.dot_general(
+                xp, centroids.astype(jdt),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=acc)
+            scores = c2 - 2.0 * xc
             labels = jnp.argmin(scores, axis=1)
-            x2 = jnp.sum(xp * xp, axis=1)
+            xf = xp.astype(acc)
+            x2 = jnp.sum(xf * xf, axis=1)
             inertia = jnp.sum(
-                jnp.where(valid, x2 + jnp.min(scores, axis=1), 0.0))
+                jnp.where(valid, x2 + jnp.min(scores, axis=1),
+                          jnp.zeros((), acc)))
             return labels, inertia
 
         fn = jax.jit(_assign)
@@ -141,7 +188,7 @@ def _lloyd_fori_fn(phys_shape, jdt, k, n_valid, comm):
     hard part 5). Used by the benchmark driver, which times two different
     trip counts with the same executable and differences them to cancel
     constant dispatch/transfer overhead."""
-    sums_mode = kmeans_pallas_enabled() and _kmeans_sums_mode()
+    sums_mode = _use_pallas_step(jdt) and _kmeans_sums_mode()
     key = ("fori", phys_shape, str(jdt), k, n_valid, comm.cache_key,
            sums_mode)
     fn = _STEP_CACHE.get(key)
@@ -185,7 +232,7 @@ def _lloyd_fori_fn(phys_shape, jdt, k, n_valid, comm):
                     c, _, _ = carry
                     return single(xp, c)
 
-                z = jnp.zeros((), jdt)
+                z = jnp.zeros((), _acc_dtype(jdt))
                 c, inertia, shift = jax.lax.fori_loop(
                     0, iters, body, (centroids, z, z))
                 return c, inertia, shift

@@ -75,6 +75,23 @@ class TestKMeans:
         # predict is consistent with labels
         np.testing.assert_array_equal(km.predict(x).numpy(), km.labels_.numpy())
 
+    def test_bf16_storage_f32_accumulate(self):
+        # half-precision storage runs the mixed-precision step (bf16 HBM
+        # reads + MXU inputs, float32 distances/sums/inertia) and still
+        # separates clean blobs like the f32 path
+        data, _ = _blobs(160, 4, k=3, seed=11)
+        x16 = ht.array(data, split=0).astype(ht.bfloat16)
+        km = ht.cluster.KMeans(n_clusters=3, init="kmeans++", max_iter=40,
+                               random_state=2)
+        km.fit(x16)
+        km32 = ht.cluster.KMeans(n_clusters=3, init="kmeans++", max_iter=40,
+                                 random_state=2)
+        km32.fit(ht.array(data, split=0))
+        c16 = np.sort(np.asarray(km.cluster_centers_.numpy()), axis=0)
+        c32 = np.sort(np.asarray(km32.cluster_centers_.numpy()), axis=0)
+        np.testing.assert_allclose(c16, c32, rtol=0.05, atol=0.05)
+        assert float(km.inertia_) < 1.2 * float(km32.inertia_) + 1e-3
+
     def test_given_centroids(self):
         data, _ = _blobs(50, 2, k=2, seed=5)
         init = ht.array(data[:2].copy())
