@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Single-chip large-n KMeans probe toward BASELINE.json's 100M x 64 config.
+
+With round-4's half-precision storage (bf16 HBM reads, f32 accumulation)
+100M x 64 is 12.8 GB — inside one v5e's 16 GB HBM, where the f32 path
+(25.6 GB) never fit. Stages up through n = 2^26 (67M) before attempting
+the full 100M so an OOM at the target size still leaves a recorded figure.
+Run on the real chip from the repo root:
+
+    python scripts/kmeans_100m_probe.py
+
+Prints one JSON line per stage ({n, dtype, kmeans_iter_per_s} or an error).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.cluster.kmeans import _lloyd_fori_fn
+from heat_tpu.core.communication import get_comm
+
+
+def measure(n: int, d: int = 64, k: int = 8) -> float:
+    comm = get_comm()
+    pad = (-n) % comm.size
+    gen = jax.jit(
+        lambda key: jax.random.uniform(key, (n + pad, d), jnp.bfloat16),
+        out_shardings=comm.sharding(2, 0))
+    xp = gen(jax.random.PRNGKey(0))
+    jax.block_until_ready(xp)
+    cents = jnp.asarray(
+        np.random.default_rng(0).random((k, d), dtype=np.float32))
+    run = _lloyd_fori_fn(xp.shape, jnp.dtype(xp.dtype), k, n, comm)
+
+    def timed(iters: int) -> float:
+        t0 = time.perf_counter()
+        _, inertia, _ = run(xp, cents, iters)
+        float(np.asarray(inertia))
+        return time.perf_counter() - t0
+
+    timed(1)
+    lo, hi = 2, 12
+    t_lo = min(timed(lo) for _ in range(3))
+    t_hi = min(timed(hi) for _ in range(3))
+    per = (t_hi - t_lo) / (hi - lo)
+    if per <= 0:
+        per = t_hi / hi
+    return 1.0 / per
+
+
+def main() -> None:
+    for n in (1 << 24, 1 << 26, 100_000_000):
+        try:
+            ips = measure(n)
+            print(json.dumps({"n": n, "dtype": "bfloat16",
+                              "kmeans_iter_per_s": round(ips, 3)}),
+                  flush=True)
+        except Exception as exc:  # keep earlier stage results on OOM
+            print(json.dumps({"n": n, "dtype": "bfloat16",
+                              "error": str(exc)[:200]}), flush=True)
+            break
+
+
+if __name__ == "__main__":
+    main()

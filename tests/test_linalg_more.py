@@ -182,8 +182,9 @@ class TestTriSolve:
         # but the last column (whose residual is data-dependent), plus
         # orthonormality of the built basis
         resid = spd @ Vn - Vn @ Tn
-        # single-pass reorthogonalization at any device count keeps the
-        # relation to ~1e-5 (entries are O(10), so this is 6 digits)
+        # single-pass reorthogonalization: residual/orthogonality error is
+        # ~1e-5 and varies with device count (reduction order), so the
+        # enforced bound is 1e-4
         np.testing.assert_allclose(resid[:, :-1], 0.0, atol=1e-4)
         np.testing.assert_allclose(Vn.T @ Vn, np.eye(n), atol=1e-4)
 
