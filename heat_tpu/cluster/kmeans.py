@@ -40,15 +40,7 @@ __all__ = ["KMeans"]
 _STEP_CACHE: dict = {}
 
 
-def _acc_dtype(jdt):
-    """Accumulation dtype: half-precision inputs (native bf16 storage —
-    half the HBM traffic of the bandwidth-bound Lloyd step, native MXU
-    rate) accumulate distances/sums/inertia in float32; everything else
-    accumulates in its own dtype."""
-    jdt = jnp.dtype(jdt)
-    if jdt in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
-        return jnp.dtype(jnp.float32)
-    return jdt
+_acc_dtype = types.accumulation_dtype
 
 
 def _finish_update(sums, counts, centroids):

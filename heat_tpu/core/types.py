@@ -391,6 +391,18 @@ def promote_types(type1, type2) -> type:
     return float64
 
 
+def accumulation_dtype(jdt):
+    """jnp accumulation dtype for a storage dtype: half-precision inputs
+    (bf16/f16 — MXU-native, half the HBM traffic) accumulate reductions
+    and GEMMs in float32 via ``preferred_element_type``; everything else
+    accumulates in its own dtype. Shared by the KMeans Lloyd step and the
+    distance tiles so the mixed-precision policy cannot drift."""
+    jdt = jnp.dtype(jdt)
+    if jdt in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
+        return jnp.dtype(jnp.float32)
+    return jdt
+
+
 def _kind_rank(t) -> builtins.int:
     if issubclass(t, complexfloating):
         return 3

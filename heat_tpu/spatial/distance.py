@@ -33,21 +33,31 @@ __all__ = ["cdist", "manhattan", "rbf"]
 _RING_CACHE: dict = {}
 
 
-def _l2_tile(x, y, expand: bool, sqrt: bool):
+def _l2_tile(x, y, expand: bool, sqrt: bool, keep_acc: bool = False):
     """One (tile_x, tile_y) block of pairwise L2 distances (squared when
-    ``sqrt=False`` — the KMeans/rbf form that skips the root)."""
+    ``sqrt=False`` — the KMeans/rbf form that skips the root). Half
+    precision keeps bf16 HBM/MXU inputs but accumulates in f32
+    (``types.accumulation_dtype``); the result casts back to the input
+    dtype unless ``keep_acc`` (rbf applies exp before narrowing)."""
+    acc = types.accumulation_dtype(x.dtype)
+    out_dt = acc if keep_acc else x.dtype
     if expand:
         if pallas_enabled():
-            # fused Pallas tile: norms + MXU GEMM (+ sqrt) in one VMEM pass
-            return cdist_tile(x, y, sqrt=sqrt)
+            # fused Pallas tile: norms + MXU GEMM (+ sqrt) in one VMEM
+            # pass (accumulates f32 internally)
+            return cdist_tile(x, y, sqrt=sqrt).astype(out_dt)
         # |x-y|² = |x|² + |y|² - 2·x·yᵀ — the GEMM form (MXU)
-        x2 = jnp.sum(x * x, axis=1, keepdims=True)
-        y2 = jnp.sum(y * y, axis=1, keepdims=True).T
-        d2 = jnp.maximum(x2 + y2 - 2.0 * (x @ y.T), 0.0)
-        return jnp.sqrt(d2) if sqrt else d2
-    diff = x[:, None, :] - y[None, :, :]
+        xf, yf = x.astype(acc), y.astype(acc)
+        x2 = jnp.sum(xf * xf, axis=1, keepdims=True)
+        y2 = jnp.sum(yf * yf, axis=1, keepdims=True).T
+        xy = jax.lax.dot_general(
+            x, y, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=acc)
+        d2 = jnp.maximum(x2 + y2 - 2.0 * xy, 0.0)
+        return (jnp.sqrt(d2) if sqrt else d2).astype(out_dt)
+    diff = x.astype(acc)[:, None, :] - y.astype(acc)[None, :, :]
     d2 = jnp.sum(diff * diff, axis=-1)
-    return jnp.sqrt(d2) if sqrt else d2
+    return (jnp.sqrt(d2) if sqrt else d2).astype(out_dt)
 
 
 def _euclidean_tile(x, y, expand: bool):
@@ -59,14 +69,17 @@ def _euclidean_sq_tile(x, y, expand: bool):
 
 
 def _manhattan_tile(x, y, expand: bool):
-    diff = jnp.abs(x[:, None, :] - y[None, :, :])
-    return jnp.sum(diff, axis=-1)
+    acc = types.accumulation_dtype(x.dtype)
+    diff = jnp.abs(x.astype(acc)[:, None, :] - y.astype(acc)[None, :, :])
+    return jnp.sum(diff, axis=-1).astype(x.dtype)
 
 
 def _gaussian_tile(sigma: float):
     def tile(x, y, expand: bool):
-        d2 = _euclidean_sq_tile(x, y, expand)
-        return jnp.exp(-d2 / (2.0 * sigma * sigma))
+        # exp runs on the f32-accumulated d2 — rounding d2 to bf16 first
+        # would put ~20% error on the kernel value at large exponents
+        d2 = _l2_tile(x, y, expand, sqrt=False, keep_acc=True)
+        return jnp.exp(-d2 / (2.0 * sigma * sigma)).astype(x.dtype)
 
     return tile
 

@@ -24,7 +24,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
-import heat_tpu as ht
 from heat_tpu.cluster.kmeans import _lloyd_fori_fn
 from heat_tpu.core.communication import get_comm
 

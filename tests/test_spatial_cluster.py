@@ -51,6 +51,22 @@ class TestCdist:
         d = ht.spatial.cdist(x)
         np.testing.assert_allclose(d.numpy(), _np_cdist(a, a), rtol=1e-3, atol=1e-3)
 
+    def test_bf16_accumulates_f32(self):
+        # bf16 inputs keep their output dtype but accumulate distances in
+        # f32 — the result must match the f32 path to bf16 rounding, not
+        # drift with the feature count
+        a, _ = _blobs(30, 24)
+        b, _ = _blobs(17, 24, seed=2)
+        want = _np_cdist(a, b)
+        x16 = ht.array(a, split=0).astype(ht.bfloat16)
+        y16 = ht.array(b).astype(ht.bfloat16)
+        for quad in (False, True):
+            d = ht.spatial.cdist(x16, y16, quadratic_expansion=quad)
+            assert d.dtype == ht.bfloat16
+            np.testing.assert_allclose(
+                np.asarray(d.numpy()).astype(np.float64), want,
+                rtol=0.05, atol=0.05)
+
     def test_manhattan_and_rbf(self):
         a, _ = _blobs(10, 3)
         b, _ = _blobs(7, 3, seed=2)
