@@ -543,6 +543,10 @@ def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
     ``repeats`` produce data-dependent shapes and use the logical path."""
     scalar_rep = isinstance(repeats, (int, np.integer)) and not isinstance(
         repeats, bool)
+    if scalar_rep and repeats < 0:
+        # one early numpy-parity check for every path (jnp.repeat would
+        # accept the negative and garble the shape)
+        raise ValueError("repeats must be non-negative")
     if scalar_rep and repeats > 0 and a.split is not None \
             and a.comm.size > 1 and a.size > 0:
         if axis is None:
@@ -625,6 +629,18 @@ def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
         return a[key]
     if isinstance(repeats, DNDarray):
         repeats = repeats._logical()
+    if not isinstance(repeats, int):
+        # numpy-parity validation jnp.repeat skips (it would silently
+        # clip/garble): non-negative counts, length matching the axis
+        r = np.asarray(repeats)
+        if (r < 0).any():
+            raise ValueError("repeats must be non-negative")
+        if r.ndim == 1 and r.size > 1:
+            target = (a.size if axis is None
+                      else a.shape[sanitize_axis(a.shape, axis)])
+            if r.size != target:
+                raise ValueError(
+                    f"repeats has {r.size} entries, expected 1 or {target}")
     res = jnp.repeat(a._logical(), repeats, axis=axis)
     if axis is None:
         out_split = 0 if a.split is not None else None

@@ -42,10 +42,12 @@ def _l2_tile(x, y, expand: bool, sqrt: bool, keep_acc: bool = False):
     acc = types.accumulation_dtype(x.dtype)
     out_dt = acc if keep_acc else x.dtype
     if expand:
-        if pallas_enabled():
+        if pallas_enabled() and out_dt == jnp.dtype(x.dtype):
             # fused Pallas tile: norms + MXU GEMM (+ sqrt) in one VMEM
-            # pass (accumulates f32 internally)
-            return cdist_tile(x, y, sqrt=sqrt).astype(out_dt)
+            # pass. Skipped when the caller needs the f32 accumulation
+            # kept (rbf): the kernel writes its output in the input
+            # dtype, which would round d2 before the exp.
+            return cdist_tile(x, y, sqrt=sqrt)
         # |x-y|² = |x|² + |y|² - 2·x·yᵀ — the GEMM form (MXU)
         xf, yf = x.astype(acc), y.astype(acc)
         x2 = jnp.sum(xf * xf, axis=1, keepdims=True)
@@ -62,10 +64,6 @@ def _l2_tile(x, y, expand: bool, sqrt: bool, keep_acc: bool = False):
 
 def _euclidean_tile(x, y, expand: bool):
     return _l2_tile(x, y, expand, sqrt=True)
-
-
-def _euclidean_sq_tile(x, y, expand: bool):
-    return _l2_tile(x, y, expand, sqrt=False)
 
 
 def _manhattan_tile(x, y, expand: bool):

@@ -160,6 +160,27 @@ class TestTriSolve:
             np.testing.assert_allclose(vn @ np.diag(np.asarray(w.numpy())) @ vn.T, sym,
                                        rtol=1e-6, atol=1e-8)
 
+    def test_eigh_distributed_larger(self):
+        # split inputs run the shift+SVD path (CAQR-backed): eigenvectors
+        # come back SPLIT, indefinite spectra and uneven n covered
+        myrng = np.random.default_rng(77)
+        for n in (19, 26):
+            a = myrng.normal(size=(n, n))
+            sym = ((a + a.T) / 2).astype(np.float64)  # indefinite
+            w_want = np.linalg.eigvalsh(sym)
+            for split in (0, 1):
+                w, v = ht.linalg.eigh(ht.array(sym, split=split))
+                if ht.get_comm().size > 1:
+                    assert v.split == 0
+                wn, vn = np.asarray(w.numpy()), np.asarray(v.numpy())
+                # eigvalsh is ascending — comparing UNSORTED checks the
+                # documented ascending-order contract
+                np.testing.assert_allclose(wn, w_want,
+                                           rtol=1e-8, atol=1e-8)
+                np.testing.assert_allclose(vn @ np.diag(wn) @ vn.T, sym,
+                                           rtol=1e-8, atol=1e-8)
+                np.testing.assert_allclose(vn.T @ vn, np.eye(n), atol=1e-9)
+
     def test_lstsq_tall(self):
         a = rng.normal(size=(64, 5)).astype(np.float64)
         b = rng.normal(size=64).astype(np.float64)
