@@ -290,9 +290,14 @@ def eigh(A: DNDarray):
         if not jnp.issubdtype(x.larray.dtype, jnp.inexact):
             x = x.astype(types.canonical_heat_type(
                 jnp.float64 if jax.config.jax_enable_x64 else jnp.float32))
-        # symmetrize (cheap next to the SVD) + Gershgorin shift to SPD
+        # symmetrize (cheap next to the SVD) + Gershgorin shift to SPD.
+        # The shift is RELATIVE (1.1x the row-sum bound on the spectral
+        # radius) so the ~eps*c absolute error it costs scales with the
+        # matrix norm — a small-norm matrix keeps full relative accuracy
         x = arithmetics.div(arithmetics.add(x, transpose(x)), 2.0)
-        c = float(x.abs().sum(axis=1).max()) + 1.0
+        c = 1.1 * float(x.abs().sum(axis=1).max())
+        if c == 0.0:  # zero matrix: w = 0, v = I via the SVD below
+            c = 1.0
         shifted = arithmetics.add(
             x, arithmetics.mul(factories.eye(
                 x.shape[0], dtype=x.dtype, split=x.split, device=x.device,
@@ -317,11 +322,12 @@ def eigh(A: DNDarray):
 def lstsq(A: DNDarray, b: DNDarray) -> DNDarray:
     """Least-squares solution of an (overdetermined) system ``A x ≈ b``.
 
-    Distributed path: for a tall ``split=0`` matrix this is TSQR —
+    Distributed paths: a tall ``split=0`` matrix runs TSQR —
     ``x = R^{-1} (Q^T b)`` where Q/R come from the blockwise QR
     (:func:`heat_tpu.core.linalg.qr.qr`), so the tall dimension never
-    gathers; ``Q^T b`` is a distributed GEMM. Replicated/other splits use
-    XLA's lstsq on the logical arrays.
+    gathers; a wide split matrix takes the min-norm solution through the
+    gather-free SVD (small-side factors replicated, one distributed GEMM
+    with the split V — round 4). Replicated inputs use XLA's lstsq.
     """
     if A.ndim != 2:
         raise ValueError(f"'A' must be 2-D, got {A.ndim}-D")
@@ -339,5 +345,25 @@ def lstsq(A: DNDarray, b: DNDarray) -> DNDarray:
         if b.ndim == 1:
             x = x[:, 0]
         return DNDarray.from_logical(x, None, A.device, A.comm)
+    if (A.split is not None and A.comm.size > 1 and m < n and A.size > 0
+            and not jnp.issubdtype(A.larray.dtype, jnp.complexfloating)):
+        # wide system, min-norm solution through the gather-free SVD
+        # (round 4): the long axis n stays split end to end — U and S are
+        # (m x m)/(m,) small-side factors, x = V diag(S)^+ U^T b is one
+        # distributed GEMM with the split V
+        from .svd import svd
+
+        res = svd(A)  # svd itself reshards wide split-0 onto columns
+        s = res.S._logical()
+        u_l = res.U._logical()  # (m, m) small side, replicated by design
+        cutoff = jnp.finfo(s.dtype).eps * max(m, n) * (
+            s[0] if s.size else jnp.asarray(0, s.dtype))
+        b_l = b._logical()
+        ub = u_l.T @ (b_l if b.ndim == 2 else b_l[:, None])
+        w = ub * jnp.where(s > cutoff, 1.0 / s, 0.0)[:, None]
+        x = matmul(res.V, DNDarray.from_logical(w, None, A.device, A.comm))
+        from .. import manipulations
+
+        return manipulations.reshape(x, (n,)) if b.ndim == 1 else x
     x, *_ = jnp.linalg.lstsq(A._logical(), b._logical())
     return DNDarray.from_logical(x, None, A.device, A.comm)

@@ -541,11 +541,27 @@ def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
     (:mod:`heat_tpu.core._manips`); along other axes the repeat is
     shard-local; ``axis=None`` flattens first (ring reshape). Array-valued
     ``repeats`` produce data-dependent shapes and use the logical path."""
-    scalar_rep = isinstance(repeats, (int, np.integer)) and not isinstance(
-        repeats, bool)
+    # normalize + validate repeats ONCE for every path below (numpy-parity
+    # checks jnp.repeat skips: non-negativity, 1-D counts, length matching
+    # the repeat target; size-1 arrays broadcast like scalars)
+    if isinstance(repeats, DNDarray):
+        repeats = np.asarray(repeats._logical())
+    if not isinstance(repeats, (int, np.integer)) or isinstance(repeats, bool):
+        arr = np.asarray(repeats)
+        if arr.ndim == 0 or (arr.ndim == 1 and arr.size == 1):
+            repeats = int(arr.reshape(-1)[0]) if arr.size else arr
+        else:
+            if arr.size and (arr < 0).any():
+                raise ValueError("repeats must be non-negative")
+            target = (a.size if axis is None
+                      else a.shape[sanitize_axis(a.shape, axis)])
+            if arr.ndim != 1 or arr.size != target:
+                raise ValueError(
+                    f"repeats shape {arr.shape} does not match the repeat "
+                    f"target length {target}")
+            repeats = arr
+    scalar_rep = isinstance(repeats, (int, np.integer))
     if scalar_rep and repeats < 0:
-        # one early numpy-parity check for every path (jnp.repeat would
-        # accept the negative and garble the shape)
         raise ValueError("repeats must be non-negative")
     if scalar_rep and repeats > 0 and a.split is not None \
             and a.comm.size > 1 and a.size > 0:
@@ -573,32 +589,16 @@ def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
     if not scalar_rep and a.split is not None and a.comm.size > 1 \
             and a.size > 0:
         # array-valued repeats: the counts are axis-length METADATA (the
-        # reference keeps them host-side too, ``:1770``); the data itself
-        # stays distributed. Along the split axis the output is a gather-free
-        # fancy index by the cumulative-count source map; other axes are
-        # shard-local with a static total length.
+        # reference keeps them host-side too, ``:1770``), already
+        # validated above; the data itself stays distributed. Along the
+        # split axis the output is a gather-free fancy index by the
+        # cumulative-count source map; other axes are shard-local with a
+        # static total length.
         reps = repeats
-        if isinstance(reps, DNDarray):
-            reps = reps._logical()
-        reps = np.asarray(reps)
-        if reps.ndim == 0:
-            return repeat(a, int(reps), axis)
-        if reps.ndim == 1 and reps.size == 1 and axis is not None:
-            return repeat(a, int(reps[0]), axis)
-        if (reps < 0).any():
-            raise ValueError("repeats must be non-negative")
         if axis is None:
             flat = a if a.ndim == 1 and a.split == 0 else flatten(a)
-            if reps.size not in (1, flat.shape[0]):
-                raise ValueError(
-                    f"repeats has {reps.size} entries, expected 1 or "
-                    f"{flat.shape[0]}")
             return repeat(flat, reps, 0)
         axis = sanitize_axis(a.shape, axis)
-        if reps.ndim != 1 or reps.size != a.shape[axis]:
-            raise ValueError(
-                f"repeats shape {reps.shape} does not match axis length "
-                f"{a.shape[axis]}")
         total = int(reps.sum())
         if axis != a.split:
             res = jnp.repeat(
@@ -627,20 +627,6 @@ def repeat(a: DNDarray, repeats, axis: Optional[int] = None) -> DNDarray:
                        a.device, a.comm)
         key = (slice(None),) * axis + (src,)
         return a[key]
-    if isinstance(repeats, DNDarray):
-        repeats = repeats._logical()
-    if not isinstance(repeats, int):
-        # numpy-parity validation jnp.repeat skips (it would silently
-        # clip/garble): non-negative counts, length matching the axis
-        r = np.asarray(repeats)
-        if (r < 0).any():
-            raise ValueError("repeats must be non-negative")
-        if r.ndim == 1 and r.size > 1:
-            target = (a.size if axis is None
-                      else a.shape[sanitize_axis(a.shape, axis)])
-            if r.size != target:
-                raise ValueError(
-                    f"repeats has {r.size} entries, expected 1 or {target}")
     res = jnp.repeat(a._logical(), repeats, axis=axis)
     if axis is None:
         out_split = 0 if a.split is not None else None

@@ -184,19 +184,24 @@ def _cdist_kernel(x_ref, y_ref, o_ref, *, sqrt: bool, acc_dtype):
     o_ref[...] = (jnp.sqrt(d2) if sqrt else d2).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("sqrt", "block_m", "block_n"))
-def cdist_tile(x, y, sqrt: bool = True, block_m: int = 256, block_n: int = 256):
+@functools.partial(jax.jit,
+                   static_argnames=("sqrt", "block_m", "block_n", "out_dtype"))
+def cdist_tile(x, y, sqrt: bool = True, block_m: int = 256,
+               block_n: int = 256, out_dtype=None):
     """Fused pairwise L2 distance block ``(m, d) × (n, d) → (m, n)``.
 
     One Pallas grid pass: each ``(block_m, block_n)`` output tile computes
     its norm terms and MXU GEMM entirely in VMEM. ``sqrt=False`` returns
-    squared distances (the KMeans assignment form).
-    """
+    squared distances (the KMeans assignment form). ``out_dtype`` overrides
+    the output dtype (the kernel accumulates in f32/f64 regardless — rbf
+    passes f32 here so the exp sees unrounded distances)."""
     m, d = x.shape
     n = y.shape[0]
-    # preserve the callers' (promoted) floating dtype — a bf16 input must
-    # yield a bf16 distance block, not silently upcast to f32
-    out_dtype = jnp.promote_types(x.dtype, y.dtype)
+    if out_dtype is None:
+        # preserve the callers' (promoted) floating dtype — a bf16 input
+        # must yield a bf16 distance block, not silently upcast to f32
+        out_dtype = jnp.promote_types(x.dtype, y.dtype)
+    out_dtype = jnp.dtype(out_dtype)
     if not jnp.issubdtype(out_dtype, jnp.floating):
         out_dtype = jnp.dtype(jnp.float32)
     acc_dtype = jnp.float64 if out_dtype == jnp.float64 else jnp.float32

@@ -42,12 +42,11 @@ def _l2_tile(x, y, expand: bool, sqrt: bool, keep_acc: bool = False):
     acc = types.accumulation_dtype(x.dtype)
     out_dt = acc if keep_acc else x.dtype
     if expand:
-        if pallas_enabled() and out_dt == jnp.dtype(x.dtype):
+        if pallas_enabled():
             # fused Pallas tile: norms + MXU GEMM (+ sqrt) in one VMEM
-            # pass. Skipped when the caller needs the f32 accumulation
-            # kept (rbf): the kernel writes its output in the input
-            # dtype, which would round d2 before the exp.
-            return cdist_tile(x, y, sqrt=sqrt)
+            # pass, accumulated in f32; rbf (keep_acc) gets the f32
+            # output so the exp sees unrounded distances
+            return cdist_tile(x, y, sqrt=sqrt, out_dtype=str(out_dt))
         # |x-y|² = |x|² + |y|² - 2·x·yᵀ — the GEMM form (MXU)
         xf, yf = x.astype(acc), y.astype(acc)
         x2 = jnp.sum(xf * xf, axis=1, keepdims=True)

@@ -181,6 +181,40 @@ class TestTriSolve:
                                            rtol=1e-8, atol=1e-8)
                 np.testing.assert_allclose(vn.T @ vn, np.eye(n), atol=1e-9)
 
+    def test_eigh_distributed_scale_invariant(self):
+        # the Gershgorin shift is relative, so a tiny-norm matrix keeps
+        # full RELATIVE eigenvalue accuracy (reviewed round 4)
+        myrng = np.random.default_rng(88)
+        a = myrng.normal(size=(12, 12))
+        sym = (((a + a.T) / 2) * 1e-8).astype(np.float64)
+        w, v = ht.linalg.eigh(ht.array(sym, split=0))
+        wn = np.asarray(w.numpy())
+        np.testing.assert_allclose(wn, np.linalg.eigvalsh(sym), rtol=1e-7)
+        vn = np.asarray(v.numpy())
+        np.testing.assert_allclose(vn @ np.diag(wn) @ vn.T, sym,
+                                   rtol=1e-7, atol=1e-22)
+
+    def test_lstsq_wide_min_norm(self):
+        # wide split systems ride the distributed SVD: min-norm solution,
+        # split result, rank deficiency included
+        myrng = np.random.default_rng(55)
+        m, n = 5, 29
+        A = myrng.normal(size=(m, n)).astype(np.float64)
+        b = myrng.normal(size=m).astype(np.float64)
+        want = np.linalg.lstsq(A, b, rcond=None)[0]
+        for split in (0, 1):
+            x = ht.linalg.lstsq(ht.array(A, split=split), ht.array(b))
+            if ht.get_comm().size > 1:
+                assert x.split == 0
+            np.testing.assert_allclose(np.asarray(x.numpy()), want,
+                                       rtol=1e-8, atol=1e-10)
+        Ad = np.vstack([A[:2], A[:2], A[:1]])  # rank 3 of 5 rows
+        bd = np.concatenate([b[:2], b[:2], b[:1]])
+        want_d = np.linalg.lstsq(Ad, bd, rcond=None)[0]
+        xd = ht.linalg.lstsq(ht.array(Ad, split=1), ht.array(bd))
+        np.testing.assert_allclose(np.asarray(xd.numpy()), want_d,
+                                   rtol=1e-6, atol=1e-8)
+
     def test_lstsq_tall(self):
         a = rng.normal(size=(64, 5)).astype(np.float64)
         b = rng.normal(size=64).astype(np.float64)
