@@ -353,11 +353,12 @@ def lstsq(A: DNDarray, b: DNDarray) -> DNDarray:
         # distributed GEMM with the split V
         from .svd import svd
 
+        from .svd import _sv_cutoff
+
         res = svd(A)  # svd itself reshards wide split-0 onto columns
         s = res.S._logical()
         u_l = res.U._logical()  # (m, m) small side, replicated by design
-        cutoff = jnp.finfo(s.dtype).eps * max(m, n) * (
-            s[0] if s.size else jnp.asarray(0, s.dtype))
+        cutoff = _sv_cutoff(s, m, n)
         b_l = b._logical()
         ub = u_l.T @ (b_l if b.ndim == 2 else b_l[:, None])
         w = ub * jnp.where(s > cutoff, 1.0 / s, 0.0)[:, None]

@@ -19,9 +19,53 @@ import jax.numpy as jnp
 
 from ..dndarray import DNDarray
 
-__all__ = ["svd"]
+__all__ = ["svd", "pinv", "matrix_rank"]
 
 SVD = collections.namedtuple("SVD", "U, S, V")
+
+
+def _sv_cutoff(s, m: int, n: int, rcond=None):
+    """numpy's default singular-value cutoff: ``rcond * s_max`` with
+    ``rcond = max(m, n) * eps`` when unspecified."""
+    if rcond is None:
+        rcond = max(m, n) * jnp.finfo(s.dtype).eps
+    smax = s[0] if s.size else jnp.asarray(0, s.dtype)
+    return rcond * smax
+
+
+def pinv(a: DNDarray, rcond=None) -> DNDarray:
+    """Moore–Penrose pseudo-inverse via the (gather-free) SVD: ``V diag(S⁺)
+    Uᵀ`` with numpy's default cutoff (beyond the reference's linalg set).
+    The long axis stays split end to end — the small-side factors are
+    replicated by the SVD's design, and the one large GEMM runs
+    distributed, so the result comes back split for split inputs. Complex
+    inputs use XLA's pinv on the logical array (the distributed factor
+    algebra here is real-valued; conjugation is not applied)."""
+    from .basics import matmul, transpose
+
+    if jnp.issubdtype(a.larray.dtype, jnp.complexfloating):
+        res = jnp.linalg.pinv(
+            a._logical(), rtol=None if rcond is None else rcond)
+        return DNDarray.from_logical(res, None, a.device, a.comm)
+    res = svd(a)
+    s = res.S._logical()
+    cutoff = _sv_cutoff(s, *a.shape, rcond=rcond)
+    sinv = jnp.where(s > cutoff, 1.0 / s, 0.0)
+    # (n, k) * (k,) — scale V's columns shard-locally, then one GEMM
+    v_scaled = res.V * DNDarray.from_logical(
+        sinv[None, :], None, a.device, a.comm)
+    return matmul(v_scaled, transpose(res.U))
+
+
+def matrix_rank(a: DNDarray, rcond=None) -> int:
+    """Rank by counting singular values above numpy's default cutoff
+    (beyond the reference's linalg set; the SVD never gathers the long
+    axis)."""
+    if jnp.issubdtype(a.larray.dtype, jnp.complexfloating):
+        return int(jnp.linalg.matrix_rank(a._logical()))
+    s_d = svd(a, compute_uv=False)
+    s = s_d._logical()
+    return int(jnp.sum(s > _sv_cutoff(s, *a.shape, rcond=rcond)))
 
 
 def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):

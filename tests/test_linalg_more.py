@@ -194,6 +194,26 @@ class TestTriSolve:
         np.testing.assert_allclose(vn @ np.diag(wn) @ vn.T, sym,
                                    rtol=1e-7, atol=1e-22)
 
+    def test_pinv_matrix_rank(self):
+        # SVD-backed pseudo-inverse and rank (beyond-reference): every
+        # shape class, both splits, rank deficiency, numpy cutoffs
+        myrng = np.random.default_rng(66)
+        for shape in ((22, 5), (5, 22), (13, 13)):
+            A = myrng.normal(size=shape).astype(np.float64)
+            want = np.linalg.pinv(A)
+            for split in (0, 1):
+                P = ht.linalg.pinv(ht.array(A, split=split))
+                np.testing.assert_allclose(np.asarray(P.numpy()), want,
+                                           rtol=1e-8, atol=1e-10)
+            assert (ht.linalg.matrix_rank(ht.array(A, split=0))
+                    == np.linalg.matrix_rank(A))
+        Ad = np.vstack([A[:4], A[:4]])
+        assert (ht.linalg.matrix_rank(ht.array(Ad, split=0))
+                == np.linalg.matrix_rank(Ad))
+        np.testing.assert_allclose(
+            np.asarray(ht.linalg.pinv(ht.array(Ad, split=0)).numpy()),
+            np.linalg.pinv(Ad), rtol=1e-6, atol=1e-8)
+
     def test_lstsq_wide_min_norm(self):
         # wide split systems ride the distributed SVD: min-norm solution,
         # split result, rank deficiency included
