@@ -253,22 +253,40 @@ def matrix_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DND
         from .. import exponential
 
         return exponential.sqrt(s)
+    def _abs_sum_then(statfn, sum_ax, red_ax):
+        # sum |x| over one of the matrix axes, then max/min over the other
+        # — only the two matrix axes reduce (batch dims survive for
+        # ndim>2) and keepdims yields numpy's (…, 1, 1) shape
+        sums = arithmetics.sum(a.abs(), axis=sum_ax, keepdims=keepdims)
+        if not keepdims and red_ax > sum_ax:
+            red_ax -= 1
+        return statfn(sums, axis=red_ax, keepdims=keepdims)
+
     if ord == 1:
-        absd = a.abs()
-        col_sums = arithmetics.sum(absd, axis=row_axis, keepdims=keepdims)
-        return statistics.max(col_sums, axis=None if keepdims else None)
+        return _abs_sum_then(statistics.max, row_axis, col_axis)
     if ord == np.inf:
-        absd = a.abs()
-        row_sums = arithmetics.sum(absd, axis=col_axis, keepdims=keepdims)
-        return statistics.max(row_sums)
+        return _abs_sum_then(statistics.max, col_axis, row_axis)
     if ord == -1:
-        absd = a.abs()
-        col_sums = arithmetics.sum(absd, axis=row_axis, keepdims=keepdims)
-        return statistics.min(col_sums)
+        return _abs_sum_then(statistics.min, row_axis, col_axis)
     if ord == -np.inf:
-        absd = a.abs()
-        row_sums = arithmetics.sum(absd, axis=col_axis, keepdims=keepdims)
-        return statistics.min(row_sums)
+        return _abs_sum_then(statistics.min, col_axis, row_axis)
+    if ord in (2, -2, "nuc"):
+        # singular-value norms — the reference raises NotImplementedError
+        # for all three (``basics.py:1193-1218``); the gather-free SVD
+        # makes them one reduction over the replicated spectrum
+        if a.ndim != 2:
+            raise ValueError("singular-value norms require a 2-D matrix")
+        from .svd import svd
+
+        s = svd(a, compute_uv=False)._logical()  # descending
+        if ord == "nuc":
+            val = jnp.sum(s)
+        else:
+            val = s[0] if ord == 2 else s[-1]
+        if keepdims:
+            val = val.reshape((1, 1))
+        return DNDarray.from_logical(jnp.asarray(val), None, a.device,
+                                     a.comm)
     raise ValueError(f"unsupported matrix norm order {ord}")
 
 

@@ -351,9 +351,7 @@ def lstsq(A: DNDarray, b: DNDarray) -> DNDarray:
         # (round 4): the long axis n stays split end to end — U and S are
         # (m x m)/(m,) small-side factors, x = V diag(S)^+ U^T b is one
         # distributed GEMM with the split V
-        from .svd import svd
-
-        from .svd import _sv_cutoff
+        from .svd import _sv_cutoff, svd
 
         res = svd(A)  # svd itself reshards wide split-0 onto columns
         s = res.S._logical()

@@ -62,7 +62,8 @@ def matrix_rank(a: DNDarray, rcond=None) -> int:
     (beyond the reference's linalg set; the SVD never gathers the long
     axis)."""
     if jnp.issubdtype(a.larray.dtype, jnp.complexfloating):
-        return int(jnp.linalg.matrix_rank(a._logical()))
+        return int(jnp.linalg.matrix_rank(
+            a._logical(), rtol=None if rcond is None else rcond))
     s_d = svd(a, compute_uv=False)
     s = s_d._logical()
     return int(jnp.sum(s > _sv_cutoff(s, *a.shape, rcond=rcond)))

@@ -194,6 +194,33 @@ class TestTriSolve:
         np.testing.assert_allclose(vn @ np.diag(wn) @ vn.T, sym,
                                    rtol=1e-7, atol=1e-22)
 
+    def test_singular_value_norms(self):
+        # ord=2/-2/'nuc' via the SVD — the reference raises
+        # NotImplementedError for all three (basics.py:1193-1218)
+        myrng = np.random.default_rng(99)
+        A = myrng.normal(size=(18, 7)).astype(np.float64)
+        for split in (None, 0, 1):
+            x = ht.array(A, split=split)
+            for o in (2, -2, "nuc"):
+                got = float(np.asarray(ht.linalg.matrix_norm(x, ord=o).numpy()))
+                np.testing.assert_allclose(got, np.linalg.norm(A, o),
+                                           rtol=1e-10)
+        assert ht.linalg.matrix_norm(
+            ht.array(A, split=0), ord=2, keepdims=True).shape == (1, 1)
+        # keepdims shapes for the abs-sum norms (review regression) and
+        # batch dims for ndim>2 with explicit axis
+        for o in (1, -1, np.inf, -np.inf):
+            got = np.asarray(ht.linalg.matrix_norm(
+                ht.array(A, split=0), ord=o, keepdims=True).numpy())
+            want = np.linalg.norm(A, ord=o, keepdims=True)
+            assert got.shape == want.shape
+            np.testing.assert_allclose(got, want, rtol=1e-12)
+        B = myrng.normal(size=(3, 8, 5))
+        got = np.asarray(ht.linalg.matrix_norm(
+            ht.array(B, split=0), axis=(1, 2), ord=1).numpy())
+        np.testing.assert_allclose(
+            got, np.linalg.norm(B, ord=1, axis=(1, 2)), rtol=1e-12)
+
     def test_pinv_matrix_rank(self):
         # SVD-backed pseudo-inverse and rank (beyond-reference): every
         # shape class, both splits, rank deficiency, numpy cutoffs
