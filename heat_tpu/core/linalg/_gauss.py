@@ -53,7 +53,7 @@ def gauss_jordan_fn(phys_shape, jdt, n: int, comm):
         mat = jnp.concatenate([ab, eye], axis=1)  # (c, 2n)
 
         def step(k, carry):
-            mat, det, sign = carry
+            mat, det, sign, logabs, sgn, singular = carry
             col = jax.lax.dynamic_slice_in_dim(mat, k, 1, axis=1)[:, 0]
             valid = (gpos >= k) & (gpos < n)
             cand = jnp.where(valid, jnp.abs(col).astype(rdt),
@@ -78,22 +78,43 @@ def gauss_jordan_fn(phys_shape, jdt, n: int, comm):
             piv = prow[k]
             det = det * piv
             sign = jnp.where(piv_g != k, -sign, sign)
+            # stable log-determinant accumulators (slogdet): log|piv| sums
+            # where the raw product would over/underflow; unit-modulus
+            # pivot signs multiply (complex-safe). A zero pivot means the
+            # matrix is singular — latch the flag and stop accumulating,
+            # because the elimination continues into inf/NaN territory
+            # (the documented IEEE outcome for inv) which would otherwise
+            # poison the log-space figures numpy defines as (0, -inf)
+            apiv = jnp.abs(piv).astype(rdt)
+            singular = singular | ~(apiv > 0)  # catches 0 AND NaN pivots
+            logabs = jnp.where(singular, logabs,
+                               logabs + jnp.log(apiv))
+            sgn = jnp.where(singular, sgn,
+                            sgn * piv / jnp.where(
+                                apiv > 0, apiv, jnp.ones((), rdt)
+                            ).astype(jdt))
             prow_n = prow / piv
             colk = jax.lax.dynamic_slice_in_dim(mat, k, 1, axis=1)[:, 0]
             is_k = (gpos == k)[:, None]
             mat = jnp.where(is_k, prow_n[None, :],
                             mat - colk[:, None] * prow_n[None, :])
-            return mat, det, sign
+            return mat, det, sign, logabs, sgn, singular
 
-        mat, det, sign = jax.lax.fori_loop(
+        mat, det, sign, logabs, sgn, singular = jax.lax.fori_loop(
             0, n, step,
-            (mat, jnp.ones((), jdt), jnp.ones((), jdt)))
-        return mat[:, n:], det * sign
+            (mat, jnp.ones((), jdt), jnp.ones((), jdt),
+             jnp.zeros((), rdt), jnp.ones((), jdt),
+             jnp.zeros((), jnp.bool_)))
+        det_out = jnp.where(singular, jnp.zeros((), jdt), det * sign)
+        logabs_out = jnp.where(singular, jnp.asarray(-jnp.inf, rdt), logabs)
+        sgn_out = jnp.where(singular, jnp.zeros((), jdt), sgn * sign)
+        return mat[:, n:], det_out, logabs_out, sgn_out
 
     spec = comm.spec(2, 0)
     fn = jax.jit(
         shard_map(body, mesh=comm.mesh, in_specs=spec,
-                  out_specs=(spec, comm.spec(0, None)), check_vma=False)
+                  out_specs=(spec, comm.spec(0, None), comm.spec(0, None),
+                             comm.spec(0, None)), check_vma=False)
     )
     _GJ_CACHE[key] = fn
     return fn

@@ -26,6 +26,7 @@ from ..stride_tricks import sanitize_axis
 __all__ = [
     "cross",
     "det",
+    "slogdet",
     "dot",
     "inv",
     "matmul",
@@ -191,7 +192,7 @@ def det(a: DNDarray) -> DNDarray:
     gj = _gauss_jordan_path(a)
     if gj is not None:
         fn, src = gj
-        _, d = fn(src.larray)
+        _, d, _, _ = fn(src.larray)
         return DNDarray.from_logical(d, None, a.device, a.comm, dtype=a.dtype)
     res = jnp.linalg.det(a._logical())
     return DNDarray.from_logical(res, None, a.device, a.comm)
@@ -200,6 +201,23 @@ def det(a: DNDarray) -> DNDarray:
 def _square_check(a):
     if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
         raise ValueError(f"expected square matrix, got {a.shape}")
+
+
+def slogdet(a: DNDarray):
+    """``(sign, logabsdet)`` — the overflow-stable determinant (beyond the
+    reference's linalg set; numpy-parity semantics). Split matrices reuse
+    the distributed Gauss-Jordan loop, which accumulates ``log|pivot|``
+    sums and unit-modulus pivot signs alongside the raw product."""
+    _square_check(a)
+    gj = _gauss_jordan_path(a)
+    if gj is not None:
+        fn, src = gj
+        _, _, logabs, sgn = fn(src.larray)
+        return (DNDarray.from_logical(sgn, None, a.device, a.comm),
+                DNDarray.from_logical(logabs, None, a.device, a.comm))
+    sign, logabs = jnp.linalg.slogdet(a._logical())
+    return (DNDarray.from_logical(sign, None, a.device, a.comm),
+            DNDarray.from_logical(logabs, None, a.device, a.comm))
 
 
 def dot(a: DNDarray, b: DNDarray, out=None) -> DNDarray:
@@ -229,7 +247,7 @@ def inv(a: DNDarray) -> DNDarray:
     gj = _gauss_jordan_path(a)
     if gj is not None:
         fn, src = gj
-        invp, _ = fn(src.larray)
+        invp, _, _, _ = fn(src.larray)
         out = DNDarray(invp, src.gshape, src.dtype, 0, a.device, a.comm)
         return transpose(out) if a.split == 1 else out
     res = jnp.linalg.inv(a._logical())
@@ -248,7 +266,8 @@ def matrix_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DND
             raise ValueError("axis must be given for >2-D arrays")
     row_axis, col_axis = (sanitize_axis(a.shape, ax) for ax in axis)
     if ord is None or ord == "fro":
-        sq = arithmetics.mul(a, a)
+        absd = a.abs()  # |x|^2, not x^2 — complex parity
+        sq = arithmetics.mul(absd, absd)
         s = arithmetics.sum(sq, axis=(row_axis, col_axis), keepdims=keepdims)
         from .. import exponential
 
@@ -297,7 +316,8 @@ def norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
         return vector_norm(a, axis=None, keepdims=keepdims, ord=ord)
     if axis is None and ord is None:
         # frobenius over all axes
-        sq = arithmetics.mul(a, a)
+        absd = a.abs()  # |x|^2, not x^2 — complex parity
+        sq = arithmetics.mul(absd, absd)
         from .. import exponential
 
         return exponential.sqrt(arithmetics.sum(sq))
@@ -312,7 +332,8 @@ def vector_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DND
     from .. import exponential, logical
 
     if ord is None or ord == 2:
-        sq = arithmetics.mul(a, a)
+        absd = a.abs()  # |x|^2, not x^2 — complex parity
+        sq = arithmetics.mul(absd, absd)
         return exponential.sqrt(arithmetics.sum(sq, axis=axis, keepdims=keepdims))
     if ord == np.inf:
         return statistics.max(a.abs(), axis=axis, keepdims=keepdims)

@@ -194,6 +194,41 @@ class TestTriSolve:
         np.testing.assert_allclose(vn @ np.diag(wn) @ vn.T, sym,
                                    rtol=1e-7, atol=1e-22)
 
+    def test_singular_det_slogdet_and_complex_fro(self):
+        # singular split matrices: numpy parity (0 / (0, -inf)) instead of
+        # NaN from the poisoned elimination tail (review regression)
+        S = np.ones((6, 6))
+        assert float(np.asarray(ht.det(ht.array(S, split=0)).numpy())) == 0.0
+        sg, la = ht.linalg.slogdet(ht.array(S, split=0))
+        assert float(np.asarray(sg.numpy())) == 0.0
+        assert float(np.asarray(la.numpy())) == -np.inf
+        # frobenius over complex entries sums |x|^2, not x^2
+        C = np.array([[1j, 0.0], [0.0, 2j]])
+        np.testing.assert_allclose(
+            complex(np.asarray(ht.linalg.matrix_norm(
+                ht.array(C), ord="fro").numpy())),
+            np.linalg.norm(C, "fro"), rtol=1e-12)
+        np.testing.assert_allclose(
+            complex(np.asarray(ht.linalg.vector_norm(
+                ht.array(np.array([3j, 4.0]))).numpy())), 5.0, rtol=1e-12)
+
+    def test_slogdet(self):
+        # overflow-stable determinant off the same distributed GJ loop
+        myrng = np.random.default_rng(44)
+        A = myrng.normal(size=(14, 14)).astype(np.float64) * 2.0
+        s_want, l_want = np.linalg.slogdet(A)
+        for split in (None, 0, 1):
+            sg, la = ht.linalg.slogdet(ht.array(A, split=split))
+            np.testing.assert_allclose(float(np.asarray(sg.numpy())), s_want,
+                                       rtol=1e-10)
+            np.testing.assert_allclose(float(np.asarray(la.numpy())), l_want,
+                                       rtol=1e-8)
+        # a determinant that overflows f64 stays finite in log space
+        sg, la = ht.linalg.slogdet(ht.array(np.eye(40) * 1e12, split=0))
+        np.testing.assert_allclose(float(np.asarray(la.numpy())),
+                                   40 * np.log(1e12), rtol=1e-12)
+        assert float(np.asarray(sg.numpy())) == 1.0
+
     def test_singular_value_norms(self):
         # ord=2/-2/'nuc' via the SVD — the reference raises
         # NotImplementedError for all three (basics.py:1193-1218)
