@@ -31,10 +31,13 @@ _GJ_CACHE: dict = {}
 
 
 def gauss_jordan_fn(phys_shape, jdt, n: int, comm):
-    """Jitted ``A_physical(split=0) -> (inv_physical(split=0), det)``.
+    """Jitted ``A_physical(split=0) -> (inv_physical(split=0), det,
+    logabsdet, sign)`` — the last two are the slogdet pair.
 
-    Singular inputs produce inf/nan (the IEEE outcome of a zero pivot),
-    mirroring ``jnp.linalg.inv``'s non-raising semantics under jit.
+    Singular inputs: the INVERSE carries inf/nan (the IEEE outcome of a
+    zero pivot, mirroring ``jnp.linalg.inv``'s non-raising semantics under
+    jit), while det/logabsdet/sign latch to numpy's ``0 / -inf / 0`` at
+    the first zero pivot instead of riding the poisoned elimination tail.
     """
     key = ("gj", tuple(phys_shape), str(jdt), n, comm.cache_key)
     fn = _GJ_CACHE.get(key)

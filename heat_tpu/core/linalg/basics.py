@@ -25,8 +25,11 @@ from ..stride_tricks import sanitize_axis
 
 __all__ = [
     "cross",
+    "cond",
     "det",
     "slogdet",
+    "kron",
+    "tensordot",
     "dot",
     "inv",
     "matmul",
@@ -455,6 +458,89 @@ def vecdot(x1: DNDarray, x2: DNDarray, axis=None, keepdims: bool = False, keepdi
     if axis is None:
         axis = m.ndim - 1
     return arithmetics.sum(m, axis=axis, keepdims=keepdims)
+
+
+_LETTERS = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ"
+
+
+def tensordot(a: DNDarray, b: DNDarray, axes=2) -> DNDarray:
+    """Tensor contraction over the given axes (beyond the reference's op
+    surface): builds the einsum expression and rides the distributed
+    :func:`einsum`, so sharded operands stay sharded and contracted split
+    axes psum."""
+    if not isinstance(a, DNDarray) or not isinstance(b, DNDarray):
+        raise TypeError("both operands must be DNDarrays")
+    if isinstance(axes, (int, np.integer)):
+        k = int(axes)
+        ax_a = list(range(a.ndim - k, a.ndim))
+        ax_b = list(range(k))
+    else:
+        ax_a, ax_b = axes
+        ax_a = [ax_a] if isinstance(ax_a, (int, np.integer)) else list(ax_a)
+        ax_b = [ax_b] if isinstance(ax_b, (int, np.integer)) else list(ax_b)
+    ax_a = [sanitize_axis(a.shape, ax) for ax in ax_a]
+    ax_b = [sanitize_axis(b.shape, ax) for ax in ax_b]
+    if len(ax_a) != len(ax_b):
+        raise ValueError("axes lists must have matching lengths")
+    if len(set(ax_a)) != len(ax_a) or len(set(ax_b)) != len(ax_b):
+        raise ValueError("duplicate contracted axes")  # numpy raises too
+    if a.ndim + b.ndim - len(ax_a) > len(_LETTERS):
+        raise ValueError("too many dimensions for tensordot")
+    it = iter(_LETTERS)
+    sa = [""] * a.ndim
+    sb = [""] * b.ndim
+    for i, j in zip(ax_a, ax_b):
+        sa[i] = sb[j] = next(it)
+    for i in range(a.ndim):
+        if not sa[i]:
+            sa[i] = next(it)
+    for j in range(b.ndim):
+        if not sb[j]:
+            sb[j] = next(it)
+    out_sub = "".join(sa[i] for i in range(a.ndim) if i not in ax_a) + \
+        "".join(sb[j] for j in range(b.ndim) if j not in ax_b)
+    return einsum(f"{''.join(sa)},{''.join(sb)}->{out_sub}", a, b)
+
+
+def kron(a: DNDarray, b: DNDarray) -> DNDarray:
+    """Kronecker product for 1-D/2-D operands (beyond the reference's op
+    surface): the block structure is one distributed einsum plus one
+    distributed reshape, so split operands never materialize."""
+    if not isinstance(a, DNDarray) or not isinstance(b, DNDarray):
+        raise TypeError("both operands must be DNDarrays")
+    if a.ndim > 2 or b.ndim > 2 or a.ndim == 0 or b.ndim == 0:
+        res = jnp.kron(a._logical(), b._logical())
+        return DNDarray.from_logical(res, None, a.device, a.comm)
+    from .. import manipulations
+
+    if a.ndim == 1 and b.ndim == 1:
+        prod = einsum("i,j->ij", a, b)
+        return manipulations.reshape(prod, (a.shape[0] * b.shape[0],))
+    # numpy pads the smaller operand's shape with leading 1s
+    a2 = a if a.ndim == 2 else a.reshape((1, a.shape[0]))
+    b2 = b if b.ndim == 2 else b.reshape((1, b.shape[0]))
+    prod = einsum("ij,kl->ikjl", a2, b2)
+    return manipulations.reshape(
+        prod, (a2.shape[0] * b2.shape[0], a2.shape[1] * b2.shape[1]))
+
+
+def cond(x: DNDarray, p=None) -> DNDarray:
+    """Condition number (beyond the reference's linalg set). ``p`` of
+    None/2/-2 reads the (gather-free) SVD spectrum; other orders compose
+    ``norm(x, p) * norm(inv(x), p)`` from the distributed norm and
+    Gauss-Jordan inverse."""
+    if x.ndim != 2:
+        raise ValueError("cond requires a 2-D matrix")
+    if p in (None, 2, -2):
+        from .svd import svd
+
+        s = svd(x, compute_uv=False)._logical()
+        val = s[-1] / s[0] if p == -2 else s[0] / s[-1]
+        return DNDarray.from_logical(val, None, x.device, x.comm)
+    _square_check(x)
+    n1 = matrix_norm(x, ord=p)
+    n2 = matrix_norm(inv(x), ord=p)
+    return arithmetics.mul(n1, n2)
 
 
 def einsum(subscripts: str, *operands: DNDarray, out=None) -> DNDarray:

@@ -194,6 +194,38 @@ class TestTriSolve:
         np.testing.assert_allclose(vn @ np.diag(wn) @ vn.T, sym,
                                    rtol=1e-7, atol=1e-22)
 
+    def test_tensordot_kron_cond(self):
+        # einsum-backed tensordot/kron and SVD/norm-backed cond (all
+        # beyond the reference's op surface)
+        myrng = np.random.default_rng(33)
+        A = myrng.normal(size=(6, 4, 5)).astype(np.float64)
+        B = myrng.normal(size=(4, 5, 7)).astype(np.float64)
+        got = ht.linalg.tensordot(ht.array(A, split=0), ht.array(B), axes=2)
+        np.testing.assert_allclose(np.asarray(got.numpy()),
+                                   np.tensordot(A, B, 2), rtol=1e-10)
+        got = ht.linalg.tensordot(ht.array(A, split=2), ht.array(B, split=1),
+                                  axes=([1, 2], [0, 1]))
+        np.testing.assert_allclose(np.asarray(got.numpy()),
+                                   np.tensordot(A, B, ([1, 2], [0, 1])),
+                                   rtol=1e-10)
+        M = myrng.normal(size=(9, 4))
+        N = myrng.normal(size=(3, 5))
+        np.testing.assert_allclose(
+            np.asarray(ht.linalg.kron(ht.array(M, split=0),
+                                      ht.array(N)).numpy()),
+            np.kron(M, N), rtol=1e-12)
+        v = myrng.normal(size=7)
+        np.testing.assert_allclose(
+            np.asarray(ht.linalg.kron(ht.array(v, split=0),
+                                      ht.array(N, split=0)).numpy()),
+            np.kron(v, N), rtol=1e-12)
+        S = M.T @ M + 4 * np.eye(4)
+        for p in (None, 2, -2, 1, np.inf, "fro"):
+            got = float(np.asarray(
+                ht.linalg.cond(ht.array(S, split=0), p=p).numpy()))
+            np.testing.assert_allclose(got, np.linalg.cond(S, p=p),
+                                       rtol=1e-8)
+
     def test_singular_det_slogdet_and_complex_fro(self):
         # singular split matrices: numpy parity (0 / (0, -inf)) instead of
         # NaN from the poisoned elimination tail (review regression)
