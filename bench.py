@@ -442,11 +442,15 @@ def _persist_best_tpu(record_line: str) -> None:
         rec["captured_at_epoch"] = int(time.time())
         # serialize read-compare-write across concurrent bench runs (the
         # recovery queue and the driver can overlap mid-round); a crashed
-        # holder's stale lock is broken after 60s
+        # holder's stale lock is broken after 60s. Best-effort: if the lock
+        # can't be acquired, proceed unserialized but never delete a lock
+        # we don't hold.
+        acquired = False
         for _ in range(20):
             try:
                 fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
                 os.close(fd)
+                acquired = True
                 break
             except FileExistsError:
                 try:
@@ -470,10 +474,11 @@ def _persist_best_tpu(record_line: str) -> None:
                 json.dump(rec, f, indent=1)
             os.replace(tmp, _BEST_TPU_PATH)  # atomic: a kill can't truncate
         finally:
-            try:
-                os.unlink(lock)
-            except OSError:
-                pass
+            if acquired:
+                try:
+                    os.unlink(lock)
+                except OSError:
+                    pass
     except Exception as exc:  # persistence must never break the bench line
         sys.stderr.write(f"bench: could not persist TPU record: {exc}\n")
 

@@ -1,0 +1,266 @@
+#!/usr/bin/env python
+"""Static collective audit of the compiled programs across device counts.
+
+Round-4 verdict #4: timing a virtual CPU mesh on a 1-core host cannot
+evidence scaling behavior (all devices share the silicon, noise swamps
+signal). What CAN be evidenced without a pod is the *communication
+structure* of the compiled programs: for each device count d, lower +
+compile the hot programs on a d-device virtual CPU mesh and count the
+collective instructions and their per-device payload bytes in the
+optimized HLO. The programs' scaling claims are then checked analytically:
+
+- KMeans Lloyd step: O(1) all-reduce instructions whose payload is
+  O(k*feats) — independent of both n and d (the only cross-device traffic
+  is the centroid sums/counts). No all-gather, no collective-permute.
+- Ring manipulations (roll / reshape): O(1) collective-permute rounds
+  (scheduled window fetch, NOT a p-step rotation ring), payload O(n/p).
+- cdist systolic ring: exactly d-1 collective-permute steps by design
+  (every device must see every Y tile), payload O(m/p * feats) per step.
+- Ring attention: 2*(d-1) collective-permutes (K and V circulate),
+  payload O(S/p * heads * head_dim) per step.
+
+Bytes are read from the HLO result shapes of the collective instructions,
+so the numbers are the partitioned per-device payloads XLA actually
+emits, not a model. Instructions inside a `while` body appear once
+statically (the Lloyd loop executes its all-reduce once per iteration —
+the audit counts program structure, which is what scales with d).
+
+Usage (writes one JSON line per (program, d) plus a summary):
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python scripts/collective_audit.py --devices 1,4,16,64,256
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+    "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8, "u64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+                "collective-permute")
+
+# matches the result portion of a collective instruction, e.g.
+# ``%all-reduce.9 = (f32[8,64]{1,0}, f32[8]{0}, f32[]) all-reduce(`` —
+# XLA fuses independent psums into ONE tuple-shaped all-reduce, so the
+# result may be a tuple of shapes; the payload is their sum.
+_INSTR_RE = re.compile(
+    r"= ([^=]*?)\s(" + "|".join(_COLLECTIVES) + r")\(")
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def hlo_collective_stats(hlo: str) -> dict:
+    """{kind: {"count": int, "bytes": int}} over an optimized-HLO dump.
+    ``bytes`` sums each instruction's result-shape payload once (all
+    elements of a tuple-shaped result)."""
+    stats = {k: {"count": 0, "bytes": 0} for k in _COLLECTIVES}
+    for m in _INSTR_RE.finditer(hlo):
+        result, kind = m.groups()
+        total = 0
+        for dt, dims in _SHAPE_RE.findall(result):
+            n = 1
+            for piece in dims.split(","):
+                if piece:
+                    n *= int(piece)
+            total += n * _DTYPE_BYTES.get(dt, 4)
+        stats[kind]["count"] += 1
+        stats[kind]["bytes"] += total
+    return {k: v for k, v in stats.items() if v["count"]}
+
+
+def _audit_one(ndev: int, programs: list) -> list:
+    """Child process: build each requested program on an ndev-device mesh,
+    compile, and emit its collective stats."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, _REPO)
+    import heat_tpu as ht
+    from heat_tpu.core.communication import TPUCommunication
+
+    comm = TPUCommunication(jax.devices()[:ndev])
+    out = []
+
+    def emit(name, fn, args, expect):
+        try:
+            hlo = fn.lower(*args).compile().as_text()
+        except Exception as exc:
+            out.append({"program": name, "devices": ndev,
+                        "error": str(exc)[-200:]})
+            return
+        out.append({"program": name, "devices": ndev,
+                    "stats": hlo_collective_stats(hlo), "expect": expect})
+
+    n_per = 128  # rows per device: payloads scale as O(n/p) by construction
+    feats, k = 64, 8
+
+    if "kmeans" in programs:
+        from heat_tpu.cluster.kmeans import _lloyd_fori_fn
+
+        n = n_per * ndev
+        x = ht.random.rand(n, feats, dtype=ht.float32, split=0, comm=comm)
+        cents = jnp.asarray(
+            np.random.default_rng(0).random((k, feats), dtype=np.float32))
+        fn = _lloyd_fori_fn(x.larray.shape, jnp.dtype(jnp.float32), k, n, comm)
+        emit("kmeans_lloyd_step", fn,
+             (x.larray, cents, jnp.int32(2)),
+             "O(1) all-reduce instrs, payload O(k*feats) indep of n and d; "
+             "no all-gather / collective-permute")
+
+    if "roll" in programs and ndev > 1:
+        from heat_tpu.core import _manips
+
+        n = n_per * ndev
+        x = ht.random.rand(n, dtype=ht.float32, split=0, comm=comm)
+        fn = _manips.ring_roll_fn(x.larray.shape, jnp.dtype(jnp.float32),
+                                  0, n, 5, comm)
+        emit("ring_roll", fn, (x.larray,),
+             "O(1) collective-permute rounds (window fetch), payload O(n/p)")
+
+    if "reshape" in programs and ndev > 1:
+        from heat_tpu.core import _manips
+
+        n = n_per * ndev
+        x = ht.random.rand(n, dtype=ht.float32, split=0, comm=comm)
+        fn = _manips.ring_reshape_fn(x.larray.shape, jnp.dtype(jnp.float32),
+                                     (n // 2, 2), comm.chunk_size(n // 2),
+                                     comm)
+        emit("ring_reshape", fn, (x.larray,),
+             "O(1) collective-permute rounds, payload O(n/p)")
+
+    if "cdist" in programs and ndev > 1:
+        n = n_per * ndev
+        x = ht.random.rand(n, 18, dtype=ht.float32, split=0, comm=comm)
+        from heat_tpu.spatial import distance as _dist_mod
+
+        fn = _dist_mod._ring_kernel(
+            x, x, _dist_mod._euclidean_tile, False, jnp.dtype(jnp.float32),
+            comm, ("euclidean",))
+        emit("cdist_ring", fn, (x.larray, x.larray),
+             "exactly d-1 collective-permutes (systolic ring), payload "
+             "O(m/p * feats) each")
+
+    if "attention" in programs and ndev > 1:
+        from heat_tpu.nn.attention import ring_attention
+
+        S_per, H, D = 8, 2, 4
+        q = ht.random.rand(1, S_per * ndev, H, D, dtype=ht.float32, split=1,
+                           comm=comm)
+        o = ring_attention(q, q, q)  # builds + caches the jitted shard_map
+        from heat_tpu.nn.attention import _ATTN_CACHE
+
+        fn = next(iter(_ATTN_CACHE.values()))
+        emit("ring_attention", fn, (q.larray, q.larray, q.larray),
+             "2*(d-1) collective-permutes (K and V circulate), payload "
+             "O(S/p * H * D) each")
+
+    print(json.dumps(out))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", default="1,4,16,64,256")
+    ap.add_argument("--programs",
+                    default="kmeans,roll,reshape,cdist,attention")
+    ap.add_argument("--timeout", type=float, default=1800.0,
+                    help="per-device-count compile budget (s)")
+    ap.add_argument("--out", default=None, help="also write summary JSON here")
+    ap.add_argument("--measure-devices", type=int, default=0,
+                    help="(internal) run the audit in THIS process")
+    args = ap.parse_args()
+
+    programs = args.programs.split(",")
+    if args.measure_devices:
+        _audit_one(args.measure_devices, programs)
+        return
+
+    # unrolled rings make compile time itself O(d) for cdist/attention;
+    # cap those at 64 devices and say so rather than time out silently
+    ring_cap = 64
+    all_results = []
+    for d in (int(x) for x in args.devices.split(",")):
+        progs = [p for p in programs
+                 if d <= ring_cap or p not in ("cdist", "attention")]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={d}")
+        env["XLA_FLAGS"] = " ".join(flags).strip()
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--measure-devices", str(d), "--programs", ",".join(progs)],
+                env=env, capture_output=True, text=True,
+                timeout=args.timeout, cwd=_REPO)
+        except subprocess.TimeoutExpired:
+            rec = [{"devices": d, "error": f"compile audit exceeded "
+                                           f"{args.timeout:.0f}s"}]
+            all_results.extend(rec)
+            print(json.dumps(rec))
+            continue
+        line = next((l for l in reversed(out.stdout.splitlines())
+                     if l.startswith("[")), None)
+        if line is None:
+            rec = [{"devices": d,
+                    "error": (out.stderr or "no output").strip()[-300:]}]
+            all_results.extend(rec)
+            print(json.dumps(rec))
+            continue
+        recs = json.loads(line)
+        all_results.extend(recs)
+        for r in recs:
+            print(json.dumps(r))
+
+    verdicts = audit_verdicts(all_results)
+    print(json.dumps({"summary": verdicts}))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"results": all_results, "verdict": verdicts}, f,
+                      indent=1)
+
+
+def audit_verdicts(results: list) -> dict:
+    """Check each program's measured collective structure against its
+    analytic claim, across the device ladder."""
+    by_prog = {}
+    for r in results:
+        if "stats" in r:
+            by_prog.setdefault(r["program"], []).append(r)
+    v = {}
+    for prog, recs in sorted(by_prog.items()):
+        recs.sort(key=lambda r: r["devices"])
+        checks = []
+        for r in recs:
+            d, st = r["devices"], r["stats"]
+            cp = st.get("collective-permute", {"count": 0, "bytes": 0})
+            ar = st.get("all-reduce", {"count": 0, "bytes": 0})
+            ag = st.get("all-gather", {"count": 0})
+            if prog == "kmeans_lloyd_step":
+                ok = (ag["count"] == 0 and cp["count"] == 0
+                      and ar["count"] <= 4)
+            elif prog in ("ring_roll", "ring_reshape"):
+                ok = ag["count"] == 0 and cp["count"] <= 4
+            elif prog == "cdist_ring":
+                ok = ag["count"] == 0 and cp["count"] == d - 1
+            elif prog == "ring_attention":
+                ok = ag["count"] == 0 and cp["count"] == 2 * (d - 1)
+            else:
+                ok = True
+            checks.append({"devices": d, "ok": ok, **st})
+        v[prog] = {"all_ok": all(c["ok"] for c in checks), "ladder": checks}
+    return v
+
+
+if __name__ == "__main__":
+    main()

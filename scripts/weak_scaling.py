@@ -33,7 +33,8 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def measure(n_points: int, d_feats: int, k: int, ndev: int) -> dict:
+def measure(n_points: int, d_feats: int, k: int, ndev: int,
+            reps: int = 3) -> dict:
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -64,13 +65,23 @@ def measure(n_points: int, d_feats: int, k: int, ndev: int) -> dict:
 
     timed(1)
     lo, hi = 2, 12
-    t_lo = min(timed(lo) for _ in range(3))
-    t_hi = min(timed(hi) for _ in range(3))
-    per = (t_hi - t_lo) / (hi - lo)
-    if per <= 0:
-        per = t_hi / hi
+    # >=3 independent repetitions of the full differenced measurement
+    # (round-4 verdict #4: single-run ladder numbers on a shared-core host
+    # carry no variance information and cannot support scaling claims)
+    rates = []
+    for _ in range(max(1, reps)):
+        t_lo = min(timed(lo) for _ in range(3))
+        t_hi = min(timed(hi) for _ in range(3))
+        per = (t_hi - t_lo) / (hi - lo)
+        if per <= 0:
+            per = t_hi / hi
+        rates.append(1.0 / per)
+    mean = sum(rates) / len(rates)
+    var = sum((r - mean) ** 2 for r in rates) / max(1, len(rates) - 1)
     return {"devices": comm.size, "n": n_points,
-            "kmeans_iter_per_s": round(1.0 / per, 3)}
+            "kmeans_iter_per_s": round(mean, 3),
+            "kmeans_iter_per_s_reps": [round(r, 3) for r in rates],
+            "kmeans_iter_per_s_std": round(var ** 0.5, 3)}
 
 
 def main():
@@ -81,6 +92,8 @@ def main():
                     help="points per device (weak scaling)")
     ap.add_argument("--feats", type=int, default=64)
     ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=3,
+                    help="independent measurement repetitions per step")
     ap.add_argument("--measure", type=int, default=0,
                     help="(internal) run one measurement at this point count")
     ap.add_argument("--measure-devices", type=int, default=0,
@@ -89,7 +102,7 @@ def main():
 
     if args.measure:
         print(json.dumps(measure(args.measure, args.feats, args.k,
-                                 args.measure_devices)))
+                                 args.measure_devices, args.reps)))
         return
 
     ladder = [int(d) for d in args.devices.split(",")]
@@ -109,7 +122,8 @@ def main():
                 [sys.executable, os.path.abspath(__file__),
                  "--measure", str(args.base_n * d),
                  "--measure-devices", str(d),
-                 "--feats", str(args.feats), "--k", str(args.k)],
+                 "--feats", str(args.feats), "--k", str(args.k),
+                 "--reps", str(args.reps)],
                 env=env, capture_output=True, text=True, timeout=1800,
                 cwd=_REPO)
         except subprocess.TimeoutExpired:
@@ -135,9 +149,15 @@ def main():
                     round(r["kmeans_iter_per_s"] / base, 3)
                 for r in results
             },
+            "efficiency_std": {
+                str(r["devices"]):
+                    round(r.get("kmeans_iter_per_s_std", 0.0) / base, 3)
+                for r in results
+            },
             "note": "perfect weak scaling keeps iter/s constant as devices "
                     "and points grow together; efficiency = iter/s(d) / "
-                    "iter/s(1)",
+                    "iter/s(1); efficiency_std propagates each step's "
+                    "repetition std against the 1-device mean",
         }))
 
 
