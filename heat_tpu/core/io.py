@@ -48,9 +48,17 @@ except ImportError:
 try:
     import netCDF4 as nc  # noqa: F401
 
-    __NETCDF = True
+    __NETCDF = "netCDF4"
 except ImportError:
-    __NETCDF = False
+    # classic NetCDF-3 fallback: scipy ships a pure-python reader/writer,
+    # so NetCDF I/O works (for classic-format files) even without the
+    # optional netCDF4 package the reference gates on
+    try:
+        from scipy.io import netcdf_file as _scipy_nc  # noqa: F401
+
+        __NETCDF = "scipy"
+    except ImportError:
+        __NETCDF = None
 
 
 def supports_hdf5() -> bool:
@@ -59,8 +67,9 @@ def supports_hdf5() -> bool:
 
 
 def supports_netcdf() -> bool:
-    """True if NetCDF I/O is available (reference ``io.py:47``)."""
-    return __NETCDF
+    """True if NetCDF I/O is available (reference ``io.py:47``; here also
+    true with only scipy's classic NetCDF-3 backend)."""
+    return __NETCDF is not None
 
 
 def _shard_and_wrap(load_chunk, gshape, jdtype, split, device, comm) -> DNDarray:
@@ -224,11 +233,20 @@ def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, devic
     comm = sanitize_comm(comm)
     device = devices.sanitize_device(device)
     dtype = types.canonical_heat_type(dtype)
-    with nc.Dataset(path, "r") as handle:
+    if __NETCDF == "netCDF4":
+        with nc.Dataset(path, "r") as handle:
+            data = handle.variables[variable]
+            gshape = tuple(data.shape)
+            return _shard_and_wrap(
+                lambda slices: data[slices], gshape, dtype.jax_type(), split,
+                device, comm
+            )
+    with _scipy_nc(path, "r", mmap=False) as handle:
         data = handle.variables[variable]
         gshape = tuple(data.shape)
         return _shard_and_wrap(
-            lambda slices: data[slices], gshape, dtype.jax_type(), split, device, comm
+            lambda slices: np.asarray(data[slices]), gshape, dtype.jax_type(),
+            split, device, comm
         )
 
 
@@ -240,13 +258,58 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwa
         raise RuntimeError("netcdf is required for NetCDF operations, but netCDF4 is not available")
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, not {type(data)}")
-    with nc.Dataset(path, mode) as handle:
-        for i, s in enumerate(data.gshape):
-            handle.createDimension(f"dim_{i}", s)
+    def _dim_names(handle, dims_sizes):
+        """Positional ``dim_{i}`` names, creating missing dimensions; an
+        existing same-position dimension of a DIFFERENT size gets a
+        size-suffixed name instead of silently binding the wrong extent."""
+        names = []
+        for i, s in enumerate(dims_sizes):
+            name = f"dim_{i}"
+            existing = handle.dimensions.get(name) if hasattr(
+                handle.dimensions, "get") else (
+                handle.dimensions[name] if name in handle.dimensions else None)
+            size_of = (lambda d: len(d) if hasattr(d, "__len__") else d)
+            if existing is None:
+                handle.createDimension(name, s)
+            elif size_of(existing) != s:
+                name = f"dim_{i}_{s}"
+                if name not in handle.dimensions:
+                    handle.createDimension(name, s)
+            names.append(name)
+        return tuple(names)
+
+    if __NETCDF == "netCDF4":
+        with nc.Dataset(path, mode) as handle:
+            var = handle.createVariable(
+                variable, _np_save_dtype(data),
+                _dim_names(handle, data.gshape),
+            )
+            for slices, block in _iter_shard_blocks(data):
+                if data.ndim == 0:
+                    var[()] = block
+                else:
+                    var[slices] = block
+        return
+    # scipy classic NetCDF-3 writer: same shard-streamed writes into a
+    # pre-created variable; "a"/"r+" append like netCDF4
+    if mode in ("a", "r+"):
+        scipy_mode = "a"
+    elif mode == "w":
+        scipy_mode = "w"
+    else:
+        raise ValueError(
+            f"mode {mode!r} is not supported by the classic NetCDF-3 "
+            "(scipy) backend; use 'w', 'a' or 'r+'")
+    np_dt = np.dtype(_np_save_dtype(data))
+    if np_dt not in (np.dtype(t) for t in
+                     ("int8", "int16", "int32", "float32", "float64")):
+        raise ValueError(
+            f"dtype {np_dt} cannot be stored in a classic NetCDF-3 file "
+            "(scipy backend; NetCDF-3 has no 8-byte or unsigned integers) "
+            "— cast the array first, e.g. to int32 or float64")
+    with _scipy_nc(path, scipy_mode) as handle:
         var = handle.createVariable(
-            variable, _np_save_dtype(data),
-            tuple(f"dim_{i}" for i in range(data.ndim)),
-        )
+            variable, np_dt, _dim_names(handle, data.gshape))
         for slices, block in _iter_shard_blocks(data):
             if data.ndim == 0:
                 var[()] = block

@@ -49,6 +49,15 @@ def main() -> None:
         f.create_dataset("data", data=x)
     np.savetxt(os.path.join(here, "iris.csv"), x, delimiter=";", fmt="%.4f")
     np.savetxt(os.path.join(here, "iris_labels.csv"), y[:, None], delimiter=";", fmt="%d")
+    # NetCDF copy (reference ships iris.nc) — written directly as classic
+    # NetCDF-3 so every backend (netCDF4 or the scipy fallback) reads it
+    from scipy.io import netcdf_file
+
+    with netcdf_file(os.path.join(here, "iris.nc"), "w") as f:
+        f.createDimension("dim_0", x.shape[0])
+        f.createDimension("dim_1", x.shape[1])
+        var = f.createVariable("data", x.dtype, ("dim_0", "dim_1"))
+        var[:] = x
 
     xd, yd = make_diabetes(rng)
     with h5py.File(os.path.join(here, "diabetes.h5"), "w") as f:

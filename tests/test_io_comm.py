@@ -130,6 +130,24 @@ class TestParallelSave:
         y = ht.load_netcdf(path, "v")
         np.testing.assert_allclose(y.numpy(), data, rtol=1e-6)
 
+    def test_netcdf_append_and_bundled_iris(self, tmp_path):
+        if not ht.io.supports_netcdf():
+            pytest.skip("no NetCDF backend (netCDF4 or scipy) available")
+        # append mode creates a second variable in the same file
+        data = np.arange(12, dtype=np.float32).reshape(6, 2)
+        path = str(tmp_path / "a.nc")
+        ht.save_netcdf(ht.array(data, split=0), path, "x")
+        ht.save_netcdf(ht.array(data[:, 0].copy(), split=0), path, "y",
+                       mode="a")
+        np.testing.assert_allclose(ht.load_netcdf(path, "x").numpy(), data)
+        np.testing.assert_allclose(ht.load_netcdf(path, "y").numpy(),
+                                   data[:, 0])
+        # the bundled NetCDF dataset loads split (reference ships iris.nc)
+        from heat_tpu import datasets
+
+        iris = ht.load_netcdf(datasets.path("iris.nc"), "data", split=0)
+        assert iris.shape == (150, 4)
+
     def test_save_replicated(self, tmp_path):
         data = np.arange(20, dtype=np.float32).reshape(4, 5)
         path = str(tmp_path / "r.h5")
