@@ -229,7 +229,9 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
 def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
     """Load a NetCDF variable (reference ``io.py:265``)."""
     if not supports_netcdf():
-        raise RuntimeError("netcdf is required for NetCDF operations, but netCDF4 is not available")
+        raise RuntimeError(
+            "netcdf is required for NetCDF operations — install netCDF4, "
+            "or scipy for classic NetCDF-3 files")
     comm = sanitize_comm(comm)
     device = devices.sanitize_device(device)
     dtype = types.canonical_heat_type(dtype)
@@ -241,12 +243,21 @@ def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, devic
                 lambda slices: data[slices], gshape, dtype.jax_type(), split,
                 device, comm
             )
-    with _scipy_nc(path, "r", mmap=False) as handle:
+    # maskandscale matches netCDF4's default semantics (CF scale_factor /
+    # add_offset applied, missing values masked) so both backends return
+    # the same physical values for packed variables
+    with _scipy_nc(path, "r", mmap=False, maskandscale=True) as handle:
         data = handle.variables[variable]
         gshape = tuple(data.shape)
+
+        def read_chunk(slices):
+            block = data[slices]
+            if isinstance(block, np.ma.MaskedArray):
+                block = block.filled(np.nan)
+            return np.asarray(block)
+
         return _shard_and_wrap(
-            lambda slices: np.asarray(data[slices]), gshape, dtype.jax_type(),
-            split, device, comm
+            read_chunk, gshape, dtype.jax_type(), split, device, comm
         )
 
 
@@ -255,43 +266,53 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwa
     ``io.py:348,487``): the variable is created at the global shape and each
     device shard's valid slice streams in — O(shard) host memory."""
     if not supports_netcdf():
-        raise RuntimeError("netcdf is required for NetCDF operations, but netCDF4 is not available")
+        raise RuntimeError(
+            "netcdf is required for NetCDF operations — install netCDF4, "
+            "or scipy for classic NetCDF-3 files")
     if not isinstance(data, DNDarray):
         raise TypeError(f"data must be a DNDarray, not {type(data)}")
+
     def _dim_names(handle, dims_sizes):
-        """Positional ``dim_{i}`` names, creating missing dimensions; an
-        existing same-position dimension of a DIFFERENT size gets a
-        size-suffixed name instead of silently binding the wrong extent."""
+        """Positional ``dim_{i}`` names, creating missing dimensions. An
+        existing same-position dimension of a different size — or the
+        unlimited/record dimension (size ``None`` in scipy, unbounded in
+        netCDF4), which must never be rebound — gets a size-suffixed name
+        instead of silently binding the wrong extent."""
         names = []
         for i, s in enumerate(dims_sizes):
             name = f"dim_{i}"
-            existing = handle.dimensions.get(name) if hasattr(
-                handle.dimensions, "get") else (
-                handle.dimensions[name] if name in handle.dimensions else None)
-            size_of = (lambda d: len(d) if hasattr(d, "__len__") else d)
-            if existing is None:
+            if name not in handle.dimensions:
                 handle.createDimension(name, s)
-            elif size_of(existing) != s:
-                name = f"dim_{i}_{s}"
-                if name not in handle.dimensions:
-                    handle.createDimension(name, s)
+            else:
+                d = handle.dimensions[name]
+                # scipy: name -> size (None = unlimited); netCDF4:
+                # name -> Dimension (len(); isunlimited())
+                size = len(d) if hasattr(d, "__len__") else d
+                unlimited = (size is None
+                             or (hasattr(d, "isunlimited") and d.isunlimited()))
+                if unlimited or size != s:
+                    name = f"dim_{i}_{s}"
+                    if name not in handle.dimensions:
+                        handle.createDimension(name, s)
             names.append(name)
         return tuple(names)
 
+    def _stream_shards(var):
+        """Write each device shard's valid slice into the variable —
+        O(shard) host memory, no global gather."""
+        for slices, block in _iter_shard_blocks(data):
+            if data.ndim == 0:
+                var[()] = block
+            else:
+                var[slices] = block
+
     if __NETCDF == "netCDF4":
         with nc.Dataset(path, mode) as handle:
-            var = handle.createVariable(
+            _stream_shards(handle.createVariable(
                 variable, _np_save_dtype(data),
-                _dim_names(handle, data.gshape),
-            )
-            for slices, block in _iter_shard_blocks(data):
-                if data.ndim == 0:
-                    var[()] = block
-                else:
-                    var[slices] = block
+                _dim_names(handle, data.gshape)))
         return
-    # scipy classic NetCDF-3 writer: same shard-streamed writes into a
-    # pre-created variable; "a"/"r+" append like netCDF4
+    # scipy classic NetCDF-3 writer; "a"/"r+" append like netCDF4
     if mode in ("a", "r+"):
         scipy_mode = "a"
     elif mode == "w":
@@ -308,13 +329,8 @@ def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwa
             "(scipy backend; NetCDF-3 has no 8-byte or unsigned integers) "
             "— cast the array first, e.g. to int32 or float64")
     with _scipy_nc(path, scipy_mode) as handle:
-        var = handle.createVariable(
-            variable, np_dt, _dim_names(handle, data.gshape))
-        for slices, block in _iter_shard_blocks(data):
-            if data.ndim == 0:
-                var[()] = block
-            else:
-                var[slices] = block
+        _stream_shards(handle.createVariable(
+            variable, np_dt, _dim_names(handle, data.gshape)))
 
 
 def load_csv(
