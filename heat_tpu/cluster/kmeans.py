@@ -31,7 +31,7 @@ from jax.sharding import PartitionSpec as P
 from ..core.dndarray import DNDarray
 from ..core import types
 from ..core.pallas_kernels import (kmeans_step_tile, kmeans_pallas_enabled,
-                                   _kmeans_sums_mode)
+                                   _kmeans_sums_mode, _kmeans_block_rows)
 from ._kcluster import _KCluster
 
 __all__ = ["KMeans"]
@@ -55,7 +55,8 @@ def _finish_update(sums, counts, centroids):
     return new_centroids.astype(centroids.dtype), shift
 
 
-def _make_step_body(phys_shape, jdt, k, n_valid, comm, sums_mode):
+def _make_step_body(phys_shape, jdt, k, n_valid, comm, sums_mode,
+                    block_rows=None):
     """(xp, centroids) -> (new_centroids, inertia, shift); one Lloyd step.
 
     ``sums_mode`` is resolved by the CALLER and passed down explicitly so the
@@ -71,8 +72,9 @@ def _make_step_body(phys_shape, jdt, k, n_valid, comm, sums_mode):
             row = rank * chunk + jax.lax.broadcasted_iota(
                 jnp.int32, (chunk, 1), 0)
             mask = (row < n_valid).astype(xp_blk.dtype)
-            sums, counts, inertia = kmeans_step_tile(xp_blk, centroids, mask,
-                                                     sums_mode=sums_mode)
+            sums, counts, inertia = kmeans_step_tile(
+                xp_blk, centroids, mask, block_rows=block_rows,
+                sums_mode=sums_mode)
             sums = jax.lax.psum(sums, axis)
             counts = jax.lax.psum(counts, axis)
             inertia = jax.lax.psum(inertia, axis)
@@ -129,11 +131,13 @@ def _use_pallas_step(jdt) -> bool:
 
 def _lloyd_step_fn(phys_shape, jdt, k, n_valid, comm):
     sums_mode = _use_pallas_step(jdt) and _kmeans_sums_mode()
-    key = (phys_shape, str(jdt), k, n_valid, comm.cache_key, sums_mode)
+    block_rows = _kmeans_block_rows() if sums_mode else None
+    key = (phys_shape, str(jdt), k, n_valid, comm.cache_key, sums_mode,
+           block_rows)
     fn = _STEP_CACHE.get(key)
     if fn is None:
         fn = jax.jit(_make_step_body(phys_shape, jdt, k, n_valid, comm,
-                                     sums_mode))
+                                     sums_mode, block_rows))
         _STEP_CACHE[key] = fn
     return fn
 
@@ -181,8 +185,9 @@ def _lloyd_fori_fn(phys_shape, jdt, k, n_valid, comm):
     trip counts with the same executable and differences them to cancel
     constant dispatch/transfer overhead."""
     sums_mode = _use_pallas_step(jdt) and _kmeans_sums_mode()
+    block_rows = _kmeans_block_rows() if sums_mode else None
     key = ("fori", phys_shape, str(jdt), k, n_valid, comm.cache_key,
-           sums_mode)
+           sums_mode, block_rows)
     fn = _STEP_CACHE.get(key)
     if fn is None:
         if sums_mode:
@@ -200,7 +205,8 @@ def _lloyd_fori_fn(phys_shape, jdt, k, n_valid, comm):
                 def body(_, carry):
                     c, _, _ = carry
                     sums, counts, inertia = kmeans_step_tile(
-                        xp_blk, c, mask, sums_mode=sums_mode)
+                        xp_blk, c, mask, block_rows=block_rows,
+                        sums_mode=sums_mode)
                     sums = jax.lax.psum(sums, axis)
                     counts = jax.lax.psum(counts, axis)
                     inertia = jax.lax.psum(inertia, axis)

@@ -235,12 +235,23 @@ def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, devic
     comm = sanitize_comm(comm)
     device = devices.sanitize_device(device)
     dtype = types.canonical_heat_type(dtype)
+    def _read_chunk(data):
+        # masked (missing/_FillValue) cells become NaN on BOTH backends —
+        # np.asarray on a MaskedArray would silently expose raw fill values
+        def read(slices):
+            block = data[slices]
+            if isinstance(block, np.ma.MaskedArray):
+                block = block.filled(np.nan)
+            return np.asarray(block)
+
+        return read
+
     if __NETCDF == "netCDF4":
         with nc.Dataset(path, "r") as handle:
             data = handle.variables[variable]
             gshape = tuple(data.shape)
             return _shard_and_wrap(
-                lambda slices: data[slices], gshape, dtype.jax_type(), split,
+                _read_chunk(data), gshape, dtype.jax_type(), split,
                 device, comm
             )
     # maskandscale matches netCDF4's default semantics (CF scale_factor /
@@ -249,15 +260,8 @@ def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, devic
     with _scipy_nc(path, "r", mmap=False, maskandscale=True) as handle:
         data = handle.variables[variable]
         gshape = tuple(data.shape)
-
-        def read_chunk(slices):
-            block = data[slices]
-            if isinstance(block, np.ma.MaskedArray):
-                block = block.filled(np.nan)
-            return np.asarray(block)
-
         return _shard_and_wrap(
-            read_chunk, gshape, dtype.jax_type(), split, device, comm
+            _read_chunk(data), gshape, dtype.jax_type(), split, device, comm
         )
 
 

@@ -813,6 +813,24 @@ def _kmeans_kernel(x_ref, c_ref, mask_ref, sums_ref, counts_ref, stats_ref,
             acc_inertia[...], stats_ref.shape).astype(stats_ref.dtype)
 
 
+def _kmeans_block_rows() -> int:
+    """X-tile rows for the KMeans kernel; A/B on real TPU via
+    ``HEAT_TPU_KMEANS_BLOCK_ROWS`` (default 1024 — the scoped-VMEM lever:
+    every per-step temporary scales with the tile). Resolved by the CALLER
+    like :func:`_kmeans_sums_mode`, so step-cache keys and traced kernels
+    can never disagree."""
+    raw = os.environ.get("HEAT_TPU_KMEANS_BLOCK_ROWS", "1024")
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"HEAT_TPU_KMEANS_BLOCK_ROWS={raw!r}: expected a positive int")
+    if val < 1:
+        raise ValueError(
+            f"HEAT_TPU_KMEANS_BLOCK_ROWS={val}: expected a positive int")
+    return val
+
+
 def _kmeans_sums_mode() -> str:
     """Centroid-sum formulation inside the KMeans kernel; A/B on real TPU via
     ``HEAT_TPU_KMEANS_SUMS=dot_rev|dot_t|loop`` (default: transposed GEMM —
@@ -824,7 +842,7 @@ def _kmeans_sums_mode() -> str:
     return mode
 
 
-def kmeans_step_tile(x, centroids, valid_mask, block_rows: int = 1024,
+def kmeans_step_tile(x, centroids, valid_mask, block_rows: Optional[int] = None,
                      sums_mode: Optional[str] = None):
     """Fused Lloyd iteration over a local X shard: ONE HBM pass.
 
@@ -837,11 +855,13 @@ def kmeans_step_tile(x, centroids, valid_mask, block_rows: int = 1024,
     ``sums_mode`` (default ``HEAT_TPU_KMEANS_SUMS``) picks the centroid-sum
     formulation, see :func:`_kmeans_kernel`.
     """
-    # resolve the env-selected mode OUTSIDE the jit so it is part of the
+    # resolve the env-selected knobs OUTSIDE the jit so they are part of the
     # cache key (a None default baked in at trace time would go stale if the
     # env var changes between calls)
     if sums_mode is None:
         sums_mode = _kmeans_sums_mode()
+    if block_rows is None:
+        block_rows = _kmeans_block_rows()
     return _kmeans_step_tile(x, centroids, valid_mask, block_rows, sums_mode)
 
 

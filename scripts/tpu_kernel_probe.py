@@ -119,10 +119,14 @@ def ab(n=1 << 23, d=64, k=8, iters=50):
     x = ht.random.rand(n, d, dtype=ht.float32, split=0)
     xp = x.larray
 
-    def run(pallas, sums_mode=None):
+    def run(pallas, sums_mode=None, block_rows=None):
         pk.set_pallas(pallas)
         # always set explicitly so no mode leaks from a previous variant
         os.environ["HEAT_TPU_KMEANS_SUMS"] = sums_mode or "dot_t"
+        if block_rows is None:
+            os.environ.pop("HEAT_TPU_KMEANS_BLOCK_ROWS", None)
+        else:
+            os.environ["HEAT_TPU_KMEANS_BLOCK_ROWS"] = str(block_rows)
         fn = _lloyd_fori_fn(xp.shape, xp.dtype, k, n, x.comm)
         c0 = xp[:k]
         fn(xp, c0, 2)[1].item()
@@ -133,14 +137,18 @@ def ab(n=1 << 23, d=64, k=8, iters=50):
         t2 = time.perf_counter()
         return iters / ((t2 - t1) - (t1 - t0))
 
-    # XLA baseline first; then each kernel sums-mode candidate (NEXT.md #1),
-    # then XLA again to bracket drift
-    variants = [(False, None), (True, "dot_t"), (True, "loop"),
-                (True, "dot_rev"), (False, None)]
-    for pallas, mode in variants:
-        tag = f"pallas={pallas}" + (f" sums={mode}" if mode else "")
+    # XLA baseline first; then each kernel sums-mode candidate (NEXT.md #1);
+    # then smaller X tiles (the scoped-VMEM lever: every per-step temporary
+    # scales with block_rows); then XLA again to bracket drift
+    variants = [(False, None, None), (True, "dot_t", None),
+                (True, "loop", None), (True, "dot_rev", None),
+                (True, "dot_t", 512), (True, "dot_t", 256),
+                (True, "loop", 256), (False, None, None)]
+    for pallas, mode, bm in variants:
+        tag = (f"pallas={pallas}" + (f" sums={mode}" if mode else "")
+               + (f" bm={bm}" if bm else ""))
         try:
-            print(tag, "iter/s:", round(run(pallas, mode), 1), flush=True)
+            print(tag, "iter/s:", round(run(pallas, mode, bm), 1), flush=True)
         except Exception as e:  # noqa: BLE001
             print(tag, "FAILED:", str(e)[:160].replace("\n", " "), flush=True)
 

@@ -247,12 +247,40 @@ class TestKMeansStepTile:
         np.testing.assert_allclose(
             float(inertia), (d2.min(1) * mask[:, 0]).sum(), rtol=1e-5)
 
+    @pytest.mark.parametrize("block_rows", [256, 512])
+    def test_block_rows_invariant(self, block_rows, monkeypatch):
+        """Numerics are identical at every X-tile size — the lever for the
+        Mosaic scoped-VMEM A/B (HEAT_TPU_KMEANS_BLOCK_ROWS)."""
+        rng = np.random.default_rng(3)
+        n, d, k = 1024 + 31, 32, 8
+        x = rng.standard_normal((n, d)).astype(np.float32)
+        c = rng.standard_normal((k, d)).astype(np.float32)
+        mask = np.ones((n, 1), np.float32)
+        base = pk.kmeans_step_tile(jnp.asarray(x), jnp.asarray(c),
+                                   jnp.asarray(mask), block_rows=1024)
+        monkeypatch.setenv("HEAT_TPU_KMEANS_BLOCK_ROWS", str(block_rows))
+        via_env = pk.kmeans_step_tile(jnp.asarray(x), jnp.asarray(c),
+                                      jnp.asarray(mask))
+        for a, b in zip(base, via_env):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-3)
+
     def test_sums_mode_env_knob(self, monkeypatch):
         monkeypatch.setenv("HEAT_TPU_KMEANS_SUMS", "bogus")
         with pytest.raises(ValueError, match="HEAT_TPU_KMEANS_SUMS"):
             pk._kmeans_sums_mode()
         monkeypatch.setenv("HEAT_TPU_KMEANS_SUMS", "loop")
         assert pk._kmeans_sums_mode() == "loop"
+
+    def test_block_rows_env_knob(self, monkeypatch):
+        monkeypatch.setenv("HEAT_TPU_KMEANS_BLOCK_ROWS", "2k")
+        with pytest.raises(ValueError, match="HEAT_TPU_KMEANS_BLOCK_ROWS"):
+            pk._kmeans_block_rows()
+        monkeypatch.setenv("HEAT_TPU_KMEANS_BLOCK_ROWS", "0")
+        with pytest.raises(ValueError, match="HEAT_TPU_KMEANS_BLOCK_ROWS"):
+            pk._kmeans_block_rows()
+        monkeypatch.setenv("HEAT_TPU_KMEANS_BLOCK_ROWS", "512")
+        assert pk._kmeans_block_rows() == 512
 
     def test_kmeans_pallas_path_matches_xla(self, force_pallas):
         """Full KMeans fit through the fused kernel (interpret mode on the
