@@ -236,12 +236,16 @@ def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, devic
     device = devices.sanitize_device(device)
     dtype = types.canonical_heat_type(dtype)
     def _read_chunk(data):
-        # masked (missing/_FillValue) cells become NaN on BOTH backends —
-        # np.asarray on a MaskedArray would silently expose raw fill values
+        # masked (missing/_FillValue) cells are NaN for float data on BOTH
+        # backends (np.asarray on a MaskedArray would silently expose raw
+        # fill values); integer data has no NaN, so masked cells fill with
+        # the variable's declared fill value on both backends
         def read(slices):
             block = data[slices]
             if isinstance(block, np.ma.MaskedArray):
-                block = block.filled(np.nan)
+                block = (block.filled(np.nan)
+                         if np.issubdtype(block.dtype, np.floating)
+                         else block.filled())
             return np.asarray(block)
 
         return read

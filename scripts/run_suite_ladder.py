@@ -67,6 +67,12 @@ def run_suite(n: int, timeout: float) -> dict:
                    pytest_s=float(dur))
     else:
         rec["tail"] = out.stdout.strip().splitlines()[-3:]
+    if out.returncode != 0:
+        # surface what broke in the CI log and the artifact — the summary
+        # line alone names no test and shows no traceback
+        tail = out.stdout.strip().splitlines()[-40:]
+        rec["failure_tail"] = tail
+        print("\n".join(tail), file=sys.stderr, flush=True)
     return rec
 
 
@@ -109,16 +115,19 @@ def main():
                     help="per-device-count suite budget (s)")
     ap.add_argument("--examples", action="store_true",
                     help="also smoke-run examples/ on the largest mesh")
+    ap.add_argument("--examples-only", action="store_true",
+                    help="skip the suite; run only the examples smoke")
     ap.add_argument("--examples-timeout", type=float, default=600.0)
     args = ap.parse_args()
 
     ladder = []
     devices = [int(d) for d in args.devices.split(",")]
-    for n in devices:
-        print(f"=== suite at {n} device(s) ===", flush=True)
-        rec = run_suite(n, args.timeout)
-        print(json.dumps(rec), flush=True)
-        ladder.append(rec)
+    if not args.examples_only:
+        for n in devices:
+            print(f"=== suite at {n} device(s) ===", flush=True)
+            rec = run_suite(n, args.timeout)
+            print(json.dumps(rec), flush=True)
+            ladder.append(rec)
 
     artifact = {
         "date": time.strftime("%Y-%m-%d"),
@@ -130,7 +139,8 @@ def main():
                 "the auditable skip inventory.",
         "ladder": ladder,
     }
-    if args.examples:
+    ex = []
+    if args.examples or args.examples_only:
         n = max(devices)
         print(f"=== examples smoke at {n} device(s) ===", flush=True)
         ex = run_examples(n, args.examples_timeout)
@@ -141,7 +151,8 @@ def main():
     with open(os.path.join(_REPO, args.out), "w") as f:
         json.dump(artifact, f, indent=1)
     print(f"wrote {args.out}")
-    bad = [r for r in ladder if r.get("rc") != 0]
+    bad = ([r for r in ladder if r.get("rc") != 0]
+           + [r for r in ex if r.get("rc") != 0])
     sys.exit(1 if bad else 0)
 
 
