@@ -166,6 +166,12 @@ def _ring_kernel(X, Y, tile_fn, expand, jdt, comm, metric_key):
         def body(x_blk, y_blk):
             x_blk = x_blk.astype(jdt)
             y_cur = y_blk.astype(jdt)
+            if size == 1:
+                # single-device (the bench configuration): the tile IS the
+                # whole output — the zeros buffer + dynamic_update_slice +
+                # final slice of the general ring would each risk a full
+                # extra pass over the n*m matrix (PERF_r04.md §cdist)
+                return tile_fn(x_blk, y_cur, expand)[:, :m]
             me = jax.lax.axis_index(axis)
             out = jnp.zeros((x_blk.shape[0], m_pad), jdt)
             for step in range(size):
@@ -176,6 +182,8 @@ def _ring_kernel(X, Y, tile_fn, expand, jdt, comm, metric_key):
                 out = jax.lax.dynamic_update_slice(out, tile, (zero, src * c_y))
                 if step != size - 1:
                     y_cur = jax.lax.ppermute(y_cur, axis, perm)
+            if m_pad == m:
+                return out  # no padding: skip the trailing-slice copy
             return out[:, :m]
 
         sm = shard_map(
