@@ -182,9 +182,7 @@ def _ring_kernel(X, Y, tile_fn, expand, jdt, comm, metric_key):
                 out = jax.lax.dynamic_update_slice(out, tile, (zero, src * c_y))
                 if step != size - 1:
                     y_cur = jax.lax.ppermute(y_cur, axis, perm)
-            if m_pad == m:
-                return out  # no padding: skip the trailing-slice copy
-            return out[:, :m]
+            return out[:, :m]  # identity slice when m_pad == m (XLA elides)
 
         sm = shard_map(
             body, mesh=comm.mesh, in_specs=(spec, spec), out_specs=spec, check_vma=False
