@@ -149,6 +149,49 @@ def _audit_one(ndev: int, programs: list) -> list:
              "exactly d-1 collective-permutes (systolic ring), payload "
              "O(m/p * feats) each")
 
+    if "transformer_tp" in programs and ndev > 1:
+        # Megatron tensor parallelism: the all-reduce COUNT is set by the
+        # layer structure (row-parallel projections fwd + column-parallel
+        # input grads bwd, + grad syncs of replicated params), NOT by the
+        # device count; per-device payloads shrink as O(1/tp)
+        import optax
+        from heat_tpu.nn.transformer import TransformerLM, TransformerLMConfig
+
+        grid = ht.MeshGrid((1, 1, ndev, 1), ("dp", "pp", "tp", "sp"),
+                           devices=jax.devices()[:ndev])
+        cfg = TransformerLMConfig(vocab=32, d_model=8 * ndev,
+                                  n_heads=2 * ndev, n_layers=2,
+                                  d_ff=8 * ndev)
+        model = TransformerLM(grid, cfg)
+        params = model.init(0)
+        tx = optax.sgd(0.05)
+        opt_state = tx.init(params)
+        step = model.make_train_step(tx)
+        toks = model.shard_batch(
+            np.zeros((2, 8), dtype=np.int32))
+        emit("transformer_tp_step", step, (params, opt_state, toks),
+             "all-reduce count set by layer structure (constant in tp for "
+             "fixed layers); payload O(activations), shrinking with tp")
+
+    if "transformer_sp" in programs and ndev > 1:
+        import optax
+        from heat_tpu.nn.transformer import TransformerLM, TransformerLMConfig
+
+        grid = ht.MeshGrid((1, 1, 1, ndev), ("dp", "pp", "tp", "sp"),
+                           devices=jax.devices()[:ndev])
+        cfg = TransformerLMConfig(vocab=32, d_model=8, n_heads=2,
+                                  n_layers=2, d_ff=8)
+        model = TransformerLM(grid, cfg)
+        params = model.init(0)
+        tx = optax.sgd(0.05)
+        opt_state = tx.init(params)
+        step = model.make_train_step(tx)
+        toks = model.shard_batch(np.zeros((2, 8 * ndev), dtype=np.int32))
+        emit("transformer_sp_step", step, (params, opt_state, toks),
+             "ring attention: collective-permute rounds O(d) per layer "
+             "(fwd + bwd recompute), payload O(S/p * H * D) each; "
+             "all-reduces for replicated-param grad sync only")
+
     if "attention" in programs and ndev > 1:
         from heat_tpu.nn.attention import ring_attention
 
@@ -258,6 +301,24 @@ def audit_verdicts(results: list) -> dict:
             else:
                 ok = True
             checks.append({"devices": d, "ok": ok, **st})
+        # cross-record structure checks for the transformer train step
+        if prog == "transformer_tp_step" and len(checks) > 1:
+            # Megatron TP: the all-reduce count is a property of the layer
+            # structure, identical at every tensor-parallel width
+            counts = {c.get("all-reduce", {}).get("count") for c in checks}
+            if len(counts) != 1:
+                for c in checks:
+                    c["ok"] = False
+        if prog == "transformer_sp_step" and len(checks) > 1:
+            # ring attention: permute count linear in d -> (cp - base) /
+            # (d - 1) is the same per-layer ring constant at every d
+            ratios = {
+                (c.get("collective-permute", {}).get("count", 0) - 1)
+                / (c["devices"] - 1)
+                for c in checks}
+            if len(ratios) != 1:
+                for c in checks:
+                    c["ok"] = False
         v[prog] = {"all_ok": all(c["ok"] for c in checks), "ladder": checks}
     return v
 
