@@ -18,7 +18,7 @@ from heat_tpu.nn.transformer import TransformerLM, TransformerLMConfig
 def _grid(shape):
     n = ht.MESH_WORLD.size
     if int(np.prod(shape)) != n:
-        pytest.skip(f"needs a {np.prod(shape)}-device mesh, have {n}")
+        pytest.skip(f"needs a mesh factorable as {shape} ({max(1, int(np.prod(shape)))} devices), have {n}")
     return ht.MeshGrid(shape, ("dp", "pp", "tp", "sp"))
 
 
