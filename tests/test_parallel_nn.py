@@ -251,7 +251,7 @@ class TestPipeline:
 
     def test_with_dp_axis(self):
         """pp composed with dp: batch sharded over dp, stages over pp."""
-        grid = _grid((2, ht.MESH_WORLD.size // 2), ("dp", "pp"))
+        grid = _grid((2, max(1, ht.MESH_WORLD.size // 2)), ("dp", "pp"))
         pp = grid.mesh.shape["pp"]
         rng = np.random.default_rng(7)
         D, mb, n_micro = 4, 2, 4
