@@ -40,6 +40,15 @@ __all__ = [
     "median",
     "min",
     "minimum",
+    "nanargmax",
+    "nanargmin",
+    "nanmax",
+    "nanmean",
+    "nanmin",
+    "nanprod",
+    "nanstd",
+    "nansum",
+    "nanvar",
     "percentile",
     "skew",
     "std",
@@ -464,6 +473,144 @@ def min(x: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDar
 def minimum(x1, x2, out=None) -> DNDarray:
     """Element-wise minimum (reference ``statistics.py:1150``)."""
     return _operations._binary_op(jnp.minimum, x1, x2, out)
+
+
+# --------------------------------------------------------------------------- #
+# NaN-ignoring reductions (beyond the reference — heat has none; NumPy       #
+# users expect them). Each is the corresponding masked reduction over the    #
+# sharded array: NaNs are replaced with the op's neutral element in-register #
+# and the existing distributed reduction runs unchanged.                     #
+# --------------------------------------------------------------------------- #
+
+
+def _nan_filled(x: DNDarray, fill) -> DNDarray:
+    """``x`` with NaNs replaced by ``fill`` (lazy DNDarray expression)."""
+    from . import logical, indexing, factories
+
+    bad = logical.isnan(x)
+    return indexing.where(bad, factories.full_like(x, fill, dtype=x.dtype), x)
+
+
+def _nan_count(x: DNDarray, axis, keepdims: bool = False) -> DNDarray:
+    """Count of non-NaN elements along ``axis``."""
+    from . import logical
+
+    return arithmetics.sum(
+        logical.logical_not(logical.isnan(x)).astype(types.int64),
+        axis=axis, keepdims=keepdims)
+
+
+def nansum(x: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Sum ignoring NaNs (``numpy.nansum``; all-NaN slices sum to 0)."""
+    if not types.heat_type_is_inexact(x.dtype):
+        return arithmetics.sum(x, axis=axis, out=out, keepdims=keepdims)
+    return arithmetics.sum(_nan_filled(x, 0.0), axis=axis, out=out,
+                           keepdims=keepdims)
+
+
+def nanprod(x: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Product ignoring NaNs (``numpy.nanprod``; all-NaN slices give 1)."""
+    if not types.heat_type_is_inexact(x.dtype):
+        return arithmetics.prod(x, axis=axis, out=out, keepdims=keepdims)
+    return arithmetics.prod(_nan_filled(x, 1.0), axis=axis, out=out,
+                            keepdims=keepdims)
+
+
+def _nan_extremum(x, axis, keepdims, fill, reducer):
+    from . import indexing, factories
+
+    if not types.heat_type_is_inexact(x.dtype):
+        return reducer(x, axis=axis, keepdims=keepdims)
+    red = reducer(_nan_filled(x, fill), axis=axis, keepdims=keepdims)
+    cnt = _nan_count(x, axis, keepdims=keepdims)
+    # all-NaN slices: NumPy yields NaN (with a RuntimeWarning we skip)
+    return indexing.where(cnt == 0, factories.full_like(red, float("nan"), dtype=red.dtype), red)
+
+
+def nanmax(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
+    """Maximum ignoring NaNs (``numpy.nanmax``; all-NaN slices give NaN)."""
+    return _nan_extremum(x, axis, keepdims, float("-inf"), max)
+
+
+def nanmin(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
+    """Minimum ignoring NaNs (``numpy.nanmin``; all-NaN slices give NaN)."""
+    return _nan_extremum(x, axis, keepdims, float("inf"), min)
+
+
+def nanmean(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
+    """Mean ignoring NaNs (``numpy.nanmean``; all-NaN slices give NaN)."""
+    from . import indexing, factories
+
+    if not types.heat_type_is_inexact(x.dtype):
+        # no NaN exists in integral data; still honor keepdims (mean()
+        # matches the reference signature, which has none)
+        s = arithmetics.sum(x, axis=axis, keepdims=keepdims)
+        n = (x.size if axis is None
+             else int(np.prod([x.shape[a] for a in _axes(x, axis)])))
+        return arithmetics.div(s, float(n) if n else 1.0)
+    s = arithmetics.sum(_nan_filled(x, 0.0), axis=axis, keepdims=keepdims)
+    cnt = _nan_count(x, axis, keepdims=keepdims)
+    safe = indexing.where(cnt == 0, factories.ones_like(cnt, dtype=cnt.dtype), cnt)
+    out = arithmetics.div(s, safe.astype(s.dtype))
+    return indexing.where(cnt == 0, factories.full_like(out, float("nan"), dtype=out.dtype), out)
+
+
+def nanvar(x: DNDarray, axis=None, ddof: int = 0, keepdims: bool = False) -> DNDarray:
+    """Variance ignoring NaNs (``numpy.nanvar``; slices with fewer than
+    ``ddof + 1`` non-NaN values give NaN)."""
+    from . import indexing, factories, logical
+
+    if not types.heat_type_is_inexact(x.dtype):
+        v = var(x, axis=axis, ddof=ddof)
+        if keepdims and axis is not None:  # var() has no keepdims (parity)
+            ax = _axes(x, axis)
+            v = v.reshape(tuple(1 if i in ax else s
+                                for i, s in enumerate(x.shape)))
+        elif keepdims:
+            v = v.reshape((1,) * x.ndim)
+        return v
+    mu = nanmean(x, axis=axis, keepdims=True)
+    dev2 = (x - mu) * (x - mu)
+    bad = logical.isnan(x)
+    dev2 = indexing.where(bad, factories.full_like(dev2, 0.0, dtype=dev2.dtype), dev2)
+    s = arithmetics.sum(dev2, axis=axis, keepdims=keepdims)
+    cnt = arithmetics.sum(logical.logical_not(bad).astype(types.int64),
+                          axis=axis, keepdims=keepdims)
+    denom = cnt - ddof
+    safe = indexing.where(denom <= 0, factories.ones_like(denom, dtype=denom.dtype), denom)
+    out = arithmetics.div(s, safe.astype(s.dtype))
+    return indexing.where(denom <= 0, factories.full_like(out, float("nan"), dtype=out.dtype), out)
+
+
+def nanstd(x: DNDarray, axis=None, ddof: int = 0, keepdims: bool = False) -> DNDarray:
+    """Standard deviation ignoring NaNs (``numpy.nanstd``)."""
+    from . import exponential
+
+    return exponential.sqrt(nanvar(x, axis=axis, ddof=ddof, keepdims=keepdims))
+
+
+def _nan_arg_extremum(x, axis, fill, arg_reducer):
+    if not types.heat_type_is_inexact(x.dtype):
+        return arg_reducer(x, axis=axis)
+    # NumPy raises on any all-NaN slice; checking costs one fetch, which
+    # these convenience APIs accept (parity with numpy's error contract)
+    size = (x.size if axis is None
+            else int(np.prod([x.shape[a] for a in _axes(x, axis)])))
+    n_bad = size - _nan_count(x, axis)
+    if bool(np.any(np.asarray(n_bad.resplit(None).larray) >= size)):
+        raise ValueError("All-NaN slice encountered")
+    return arg_reducer(_nan_filled(x, fill), axis=axis)
+
+
+def nanargmax(x: DNDarray, axis=None) -> DNDarray:
+    """Index of the maximum ignoring NaNs (``numpy.nanargmax``; raises
+    ``ValueError`` on an all-NaN slice like NumPy)."""
+    return _nan_arg_extremum(x, axis, float("-inf"), argmax)
+
+
+def nanargmin(x: DNDarray, axis=None) -> DNDarray:
+    """Index of the minimum ignoring NaNs (``numpy.nanargmin``)."""
+    return _nan_arg_extremum(x, axis, float("inf"), argmin)
 
 
 def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False, keepdim=None) -> DNDarray:

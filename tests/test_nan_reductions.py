@@ -1,0 +1,105 @@
+"""NaN-ignoring reductions (beyond the reference — heat has none;
+``numpy.nan*`` contract, distributed over every split)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+from utils import all_splits
+
+
+def _gather(x):
+    return np.asarray(x.resplit_(None).larray)
+
+
+@pytest.fixture
+def nan_data():
+    rng = np.random.default_rng(7)
+    a = rng.standard_normal((6, 7)).astype(np.float32)
+    a[rng.random((6, 7)) > 0.6] = np.nan
+    return a
+
+
+class TestNanReductions:
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_against_numpy(self, nan_data, split):
+        a = nan_data
+        x = ht.array(a.copy(), split=split)
+        np.testing.assert_allclose(float(ht.nansum(x)), np.nansum(a), rtol=1e-5)
+        for axis in (0, 1):
+            np.testing.assert_allclose(
+                _gather(ht.nansum(x, axis=axis)), np.nansum(a, axis=axis),
+                rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(
+                _gather(ht.nanmean(x, axis=axis)), np.nanmean(a, axis=axis),
+                rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(
+                _gather(ht.nanmax(x, axis=axis)), np.nanmax(a, axis=axis),
+                rtol=1e-5)
+            np.testing.assert_allclose(
+                _gather(ht.nanmin(x, axis=axis)), np.nanmin(a, axis=axis),
+                rtol=1e-5)
+            np.testing.assert_allclose(
+                _gather(ht.nanvar(x, axis=axis)), np.nanvar(a, axis=axis),
+                rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(
+                _gather(ht.nanstd(x, axis=axis)), np.nanstd(a, axis=axis),
+                rtol=1e-4, atol=1e-5)
+
+    def test_nanvar_ddof(self, nan_data):
+        a = nan_data
+        x = ht.array(a.copy(), split=0)
+        np.testing.assert_allclose(
+            _gather(ht.nanvar(x, axis=0, ddof=1)),
+            np.nanvar(a, axis=0, ddof=1), rtol=1e-4, atol=1e-5)
+
+    def test_all_nan_slices_give_nan(self, nan_data):
+        b = nan_data.copy()
+        b[:, 2] = np.nan
+        x = ht.array(b, split=0)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # numpy warns on all-NaN slices
+            np.testing.assert_allclose(
+                _gather(ht.nanmax(x, axis=0)), np.nanmax(b, axis=0),
+                equal_nan=True, rtol=1e-5)
+            np.testing.assert_allclose(
+                _gather(ht.nanmean(x, axis=0)), np.nanmean(b, axis=0),
+                equal_nan=True, rtol=1e-5)
+            np.testing.assert_allclose(
+                _gather(ht.nanvar(x, axis=0)), np.nanvar(b, axis=0),
+                equal_nan=True, rtol=1e-4, atol=1e-5)
+
+    def test_nanprod(self):
+        x = ht.array(np.array([2.0, np.nan, 3.0], np.float32), split=0)
+        assert float(ht.nanprod(x)) == pytest.approx(6.0)
+
+    def test_nanarg(self):
+        a = np.array([3.0, np.nan, -1.0, 7.0, np.nan], np.float32)
+        for split in all_splits(1):
+            x = ht.array(a.copy(), split=split)
+            assert int(ht.nanargmax(x)) == int(np.nanargmax(a))
+            assert int(ht.nanargmin(x)) == int(np.nanargmin(a))
+        with pytest.raises(ValueError, match="All-NaN"):
+            ht.nanargmax(ht.array(np.full(5, np.nan, np.float32), split=0))
+
+    def test_integer_passthrough(self):
+        x = ht.arange(10, split=0)
+        assert int(ht.nansum(x)) == 45
+        assert int(ht.nanmax(x)) == 9
+        assert float(ht.nanmean(x)) == pytest.approx(4.5)
+
+    def test_keepdims(self, nan_data):
+        a = nan_data
+        x = ht.array(a.copy(), split=0)
+        np.testing.assert_allclose(
+            _gather(ht.nansum(x, axis=1, keepdims=True)),
+            np.nansum(a, axis=1, keepdims=True), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            _gather(ht.nanmean(x, axis=0, keepdims=True)),
+            np.nanmean(a, axis=0, keepdims=True), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            _gather(ht.nanmax(x, axis=1, keepdims=True)),
+            np.nanmax(a, axis=1, keepdims=True), rtol=1e-5)
