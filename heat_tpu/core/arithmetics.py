@@ -25,6 +25,9 @@ __all__ = [
     "cumproduct",
     "cumsum",
     "diff",
+    "ediff1d",
+    "nancumprod",
+    "nancumsum",
     "div",
     "divide",
     "floordiv",
@@ -92,6 +95,54 @@ cumproduct = cumprod
 def cumsum(a: DNDarray, axis: int, dtype=None, out=None) -> DNDarray:
     """Cumulative sum along ``axis`` (reference ``:330``)."""
     return _operations._cum_op(a, jnp.cumsum, axis, 0, out, dtype)
+
+
+def nancumsum(a: DNDarray, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative sum treating NaNs as zero (``numpy.nancumsum``)."""
+    from . import types as _t
+    from .statistics import _nan_filled
+
+    if not _t.heat_type_is_inexact(a.dtype):
+        return cumsum(a, axis, dtype=dtype, out=out)
+    return cumsum(_nan_filled(a, 0.0), axis, dtype=dtype, out=out)
+
+
+def nancumprod(a: DNDarray, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative product treating NaNs as one (``numpy.nancumprod``)."""
+    from . import types as _t
+    from .statistics import _nan_filled
+
+    if not _t.heat_type_is_inexact(a.dtype):
+        return cumprod(a, axis, dtype=dtype, out=out)
+    return cumprod(_nan_filled(a, 1.0), axis, dtype=dtype, out=out)
+
+
+def ediff1d(ary: DNDarray, to_end=None, to_begin=None) -> DNDarray:
+    """Differences of the flattened array (``numpy.ediff1d``), with the
+    optional prepend/append tails."""
+    from . import manipulations, factories
+
+    flat = manipulations.flatten(ary)
+    d = diff(flat)
+
+    def _tail(v, name):
+        arr = np.ravel(np.asarray(v))
+        # numpy raises for incompatible tail dtypes (same_kind rule)
+        # instead of silently truncating, e.g. float tails on int input
+        if not np.can_cast(arr.dtype, np.dtype(d.dtype.jax_type()),
+                           casting="same_kind"):
+            raise TypeError(
+                f"dtype of {name} ({arr.dtype}) is not compatible with the "
+                f"difference dtype ({d.dtype}) under the same_kind rule")
+        return factories.array(arr, dtype=d.dtype, comm=ary.comm)
+
+    parts = []
+    if to_begin is not None:
+        parts.append(_tail(to_begin, "to_begin"))
+    parts.append(d)
+    if to_end is not None:
+        parts.append(_tail(to_end, "to_end"))
+    return manipulations.concatenate(parts, axis=0) if len(parts) > 1 else d
 
 
 def diff(a: DNDarray, n: int = 1, axis: int = -1, prepend=None, append=None) -> DNDarray:

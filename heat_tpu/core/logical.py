@@ -14,6 +14,7 @@ from .dndarray import DNDarray
 __all__ = [
     "all",
     "allclose",
+    "count_nonzero",
     "any",
     "isclose",
     "isfinite",
@@ -72,6 +73,15 @@ def isfinite(x: DNDarray) -> DNDarray:
 def isinf(x: DNDarray) -> DNDarray:
     """Element-wise infinity test (reference ``:340``)."""
     return _operations._local_op(jnp.isinf, x)
+
+
+def count_nonzero(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
+    """Number of nonzero elements (``numpy.count_nonzero``): one masked
+    distributed sum."""
+    from . import arithmetics, types as _t
+
+    return arithmetics.sum((x != 0).astype(_t.int64), axis=axis,
+                           keepdims=keepdims)
 
 
 def isnan(x: DNDarray) -> DNDarray:

@@ -46,13 +46,23 @@ __all__ = [
     "nanmean",
     "nanmin",
     "nanprod",
+    "nanmedian",
+    "nanpercentile",
+    "nanquantile",
     "nanstd",
     "nansum",
     "nanvar",
     "percentile",
+    "ptp",
+    "quantile",
+    "searchsorted",
     "skew",
     "std",
+    "trapz",
     "var",
+    "corrcoef",
+    "gradient",
+    "interp",
 ]
 
 
@@ -542,12 +552,14 @@ def nanmean(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
     from . import indexing, factories
 
     if not types.heat_type_is_inexact(x.dtype):
-        # no NaN exists in integral data; still honor keepdims (mean()
-        # matches the reference signature, which has none)
-        s = arithmetics.sum(x, axis=axis, keepdims=keepdims)
-        n = (x.size if axis is None
-             else int(np.prod([x.shape[a] for a in _axes(x, axis)])))
-        return arithmetics.div(s, float(n) if n else 1.0)
+        # no NaN exists in integral data; mean() matches the reference
+        # signature (no keepdims), so reshape after
+        m = mean(x, axis=axis)
+        if keepdims:
+            ax = _axes(x, axis)
+            m = m.reshape(tuple(1 if i in ax else s
+                                for i, s in enumerate(x.shape)))
+        return m
     s = arithmetics.sum(_nan_filled(x, 0.0), axis=axis, keepdims=keepdims)
     cnt = _nan_count(x, axis, keepdims=keepdims)
     safe = indexing.where(cnt == 0, factories.ones_like(cnt, dtype=cnt.dtype), cnt)
@@ -613,6 +625,218 @@ def nanargmin(x: DNDarray, axis=None) -> DNDarray:
     return _nan_arg_extremum(x, axis, float("inf"), argmin)
 
 
+def nanpercentile(x: DNDarray, q, axis=None, out=None,
+                  interpolation: str = "linear",
+                  keepdims: bool = False) -> DNDarray:
+    """q-th percentile ignoring NaNs (``numpy.nanpercentile``).
+
+    ``axis=None`` compresses the NaNs out (distributed boolean selection,
+    stays split) and runs the exact distributed percentile; an ``axis``
+    reduction first reshards so the reduced axis is device-local (one
+    all-to-all, no gather), then applies the per-slice NaN-aware order
+    statistic locally."""
+    if not types.heat_type_is_inexact(x.dtype):
+        return percentile(x, q, axis=axis, out=out,
+                          interpolation=interpolation, keepdims=keepdims)
+    from . import logical, manipulations
+
+    if axis is None:
+        flat = manipulations.flatten(x)
+        kept = flat[logical.logical_not(logical.isnan(flat))]
+        res = percentile(kept, q, axis=None, interpolation=interpolation)
+        if keepdims:
+            res = res.reshape(tuple(np.shape(q)) + (1,) * x.ndim)
+        return _operations._finalize(res, out)
+    axis_s = sanitize_axis(x.shape, axis)
+    ftype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    qa = jnp.asarray(q, dtype=ftype)
+    distributed = x.split is not None and x.comm.size > 1
+    if distributed and x.split == axis_s:
+        # move the split off the reduced axis (one reshard, gather-free)
+        x = x.resplit((axis_s + 1) % x.ndim) if x.ndim > 1 else x.resplit(None)
+        distributed = x.split is not None
+
+    def _nanpct(arr):
+        # jnp.nanpercentile rejects q of rank > 1; flatten and restore
+        r = jnp.nanpercentile(arr.astype(ftype), qa.reshape(-1),
+                              axis=axis_s, method=interpolation,
+                              keepdims=keepdims)
+        if qa.ndim != 1:
+            r = r.reshape(tuple(qa.shape) + r.shape[1:])
+        return r
+
+    q_ndim = np.ndim(q)
+    if keepdims:
+        gshape = tuple(np.shape(q)) + tuple(
+            1 if i == axis_s else s for i, s in enumerate(x.shape))
+    else:
+        gshape = tuple(np.shape(q)) + tuple(
+            s for i, s in enumerate(x.shape) if i != axis_s)
+    if not distributed:
+        # single shard / replicated: operate on the logical view and keep
+        # the result replicated (percentile's local route, split=None)
+        res = _nanpct(x._logical())
+        result = DNDarray.from_logical(res, None, x.device, x.comm)
+        return _operations._finalize(result, out)
+    # per-shard local reduction along a non-split axis
+    res = _nanpct(x.larray)
+    out_split = (x.split + q_ndim if keepdims
+                 else (x.split - (1 if axis_s < x.split else 0)) + q_ndim)
+    result = DNDarray(res, gshape, types.canonical_heat_type(res.dtype),
+                      out_split, x.device, x.comm)
+    return _operations._finalize(result, out)
+
+
+def nanmedian(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
+    """Median ignoring NaNs (``numpy.nanmedian``)."""
+    return nanpercentile(x, 50.0, axis=axis, keepdims=keepdims)
+
+
+def nanquantile(x: DNDarray, q, axis=None, out=None,
+                interpolation: str = "linear",
+                keepdims: bool = False) -> DNDarray:
+    """q-th quantile (``q`` in [0, 1]) ignoring NaNs (``numpy.nanquantile``)."""
+    qn = np.asarray(q, dtype=np.float64)
+    if qn.size and not bool((qn >= 0).all() and (qn <= 1).all()):
+        raise ValueError("Quantiles must be in the range [0, 1]")
+    return nanpercentile(x, np.asarray(q) * 100.0, axis=axis, out=out,
+                         interpolation=interpolation, keepdims=keepdims)
+
+
+def quantile(x: DNDarray, q, axis=None, out=None,
+             interpolation: str = "linear", keepdims: bool = False) -> DNDarray:
+    """q-th quantile, ``q`` in [0, 1] (``numpy.quantile``) — the [0, 100]
+    scale of :func:`percentile`."""
+    qn = np.asarray(q, dtype=np.float64)
+    if qn.size and not bool((qn >= 0).all() and (qn <= 1).all()):
+        raise ValueError("Quantiles must be in the range [0, 1]")
+    return percentile(x, np.asarray(q) * 100.0, axis=axis, out=out,
+                      interpolation=interpolation, keepdims=keepdims)
+
+
+def ptp(x: DNDarray, axis=None, out=None, keepdims: bool = False) -> DNDarray:
+    """Peak-to-peak range, ``max - min`` (``numpy.ptp``)."""
+    result = arithmetics.sub(max(x, axis=axis, keepdims=keepdims),
+                             min(x, axis=axis, keepdims=keepdims))
+    return _operations._finalize(result, out)
+
+
+def corrcoef(m: DNDarray, y=None, rowvar: bool = True) -> DNDarray:
+    """Pearson correlation coefficients (``numpy.corrcoef``) from
+    :func:`cov`: ``C[i,j] / sqrt(C[i,i] * C[j,j])``, clipped to [-1, 1]."""
+    from . import exponential, manipulations
+
+    c = cov(m, y, rowvar=rowvar)
+    if c.ndim == 0:
+        from . import factories
+
+        return factories.array(1.0, dtype=c.dtype, comm=m.comm)
+    d = exponential.sqrt(manipulations.diag(c))
+    outer_d = arithmetics.mul(d.reshape((d.shape[0], 1)),
+                              d.reshape((1, d.shape[0])))
+    return arithmetics.div(c, outer_d).clip(-1.0, 1.0)
+
+
+def searchsorted(a: DNDarray, v, side: str = "left", sorter=None) -> DNDarray:
+    """Insertion indices into a sorted 1-D array (``numpy.searchsorted``).
+    The sorted ``a`` replicates (it is the boundary set, like
+    :func:`bucketize`'s boundaries); ``v`` may stay split."""
+    if side not in ("left", "right"):
+        raise ValueError(f"side must be 'left' or 'right', got {side!r}")
+    if sorter is not None:
+        raise NotImplementedError("searchsorted: sorter is not supported")
+    from . import factories
+
+    av = a._logical() if isinstance(a, DNDarray) else jnp.asarray(a)
+    if not isinstance(v, DNDarray):
+        v = factories.array(v, comm=a.comm if isinstance(a, DNDarray) else None)
+    return _operations._local_op(
+        lambda t: jnp.searchsorted(av, t, side=side).astype(jnp.int64), v)
+
+
+def trapz(y: DNDarray, x=None, dx: float = 1.0, axis: int = -1) -> DNDarray:
+    """Trapezoidal integration (``numpy.trapz``): built from distributed
+    slicing + one reduction, gather-free on split arrays."""
+    axis = sanitize_axis(y.shape, axis)
+    n = y.shape[axis]
+    if n < 2:
+        raise ValueError("trapz requires at least 2 samples along axis")
+    sl_lo = tuple(slice(None, -1) if i == axis else slice(None)
+                  for i in range(y.ndim))
+    sl_hi = tuple(slice(1, None) if i == axis else slice(None)
+                  for i in range(y.ndim))
+    pair_sum = arithmetics.add(y[sl_hi], y[sl_lo])
+    if x is None:
+        return arithmetics.mul(arithmetics.sum(pair_sum, axis=axis),
+                               0.5 * float(dx))
+    xs = x if isinstance(x, DNDarray) else None
+    xv = xs._logical() if xs is not None else jnp.asarray(x)
+    if xv.ndim == 1:
+        d = jnp.diff(xv)
+        shape = [1] * y.ndim
+        shape[axis] = d.shape[0]
+        d = d.reshape(shape)
+    else:
+        raise NotImplementedError("trapz: only 1-D sample positions")
+    from . import factories
+
+    dd = factories.array(np.asarray(d), comm=y.comm)
+    return arithmetics.mul(arithmetics.sum(
+        arithmetics.mul(pair_sum, dd), axis=axis), 0.5)
+
+
+def gradient(f: DNDarray, *varargs, axis=None, edge_order: int = 1):
+    """Numerical gradient (``numpy.gradient``): central differences in the
+    interior, one-sided at the edges — distributed slicing + concatenate
+    (the split-axis case rides the O(1) ppermute window fetch).
+
+    Unit or scalar spacing only; returns a list for multiple axes like
+    NumPy."""
+    if edge_order != 1:
+        raise NotImplementedError("gradient: only edge_order=1")
+    if len(varargs) > 1:
+        raise NotImplementedError("gradient: per-axis spacing arrays are "
+                                  "not supported (scalar spacing only)")
+    h = float(varargs[0]) if varargs else 1.0
+    axes = (tuple(range(f.ndim)) if axis is None
+            else ((axis,) if isinstance(axis, int) else tuple(axis)))
+    axes = tuple(sanitize_axis(f.shape, a) for a in axes)
+    from . import manipulations
+
+    outs = []
+    for ax in axes:
+        if f.shape[ax] < 2:
+            raise ValueError("gradient requires at least 2 points per axis")
+
+        def sl(a, b):
+            return tuple(slice(a, b) if i == ax else slice(None)
+                         for i in range(f.ndim))
+
+        interior = arithmetics.div(
+            arithmetics.sub(f[sl(2, None)], f[sl(None, -2)]), 2.0 * h)
+        first = arithmetics.div(
+            arithmetics.sub(f[sl(1, 2)], f[sl(0, 1)]), h)
+        last = arithmetics.div(
+            arithmetics.sub(f[sl(-1, None)], f[sl(-2, -1)]), h)
+        outs.append(manipulations.concatenate([first, interior, last],
+                                              axis=ax))
+    return outs[0] if len(axes) == 1 else outs
+
+
+def interp(x: DNDarray, xp, fp, left=None, right=None) -> DNDarray:
+    """1-D linear interpolation (``numpy.interp``): the sample table
+    ``(xp, fp)`` replicates (it is a lookup table); ``x`` stays split."""
+    xpv = xp._logical() if isinstance(xp, DNDarray) else jnp.asarray(xp)
+    fpv = fp._logical() if isinstance(fp, DNDarray) else jnp.asarray(fp)
+    from . import factories
+
+    if not isinstance(x, DNDarray):
+        x = factories.array(x)
+    return _operations._local_op(
+        lambda t: jnp.interp(t, xpv, fpv,
+                             left=left, right=right), x)
+
+
 def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear", keepdims: bool = False, keepdim=None) -> DNDarray:
     """q-th percentile (reference ``statistics.py:1256``).
 
@@ -627,7 +851,8 @@ def percentile(x: DNDarray, q, axis=None, out=None, interpolation: str = "linear
     if keepdim is not None:  # reference/torch keyword name
         keepdims = keepdim
     q_np = np.asarray(q, dtype=np.float64)
-    if q_np.size and (q_np.min() < 0 or q_np.max() > 100):
+    if q_np.size and not bool((q_np >= 0).all() and (q_np <= 100).all()):
+        # NaN q fails both comparisons -> raises, matching numpy
         raise ValueError("Percentiles must be in the range [0, 100]")
     if interpolation not in ("linear", "lower", "higher", "nearest", "midpoint"):
         raise ValueError(f"unknown interpolation method {interpolation!r}")

@@ -1,0 +1,120 @@
+"""NumPy-surface conveniences beyond the reference: ptp/quantile/
+nanmedian/nanpercentile/nanquantile/corrcoef/gradient/trapz/interp/
+searchsorted/ediff1d/nancumsum/nancumprod/count_nonzero — distributed
+over every split, verified against NumPy."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+rng = np.random.default_rng(1)
+
+
+def _g(t):
+    return np.asarray(t.resplit_(None).larray)
+
+
+@pytest.fixture
+def data():
+    a = rng.standard_normal((5, 8)).astype(np.float32)
+    an = a.copy()
+    an[rng.random((5, 8)) > 0.7] = np.nan
+    return a, an
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+class TestConveniences:
+    def test_ptp_quantile(self, data, split):
+        a, _ = data
+        x = ht.array(a.copy(), split=split)
+        np.testing.assert_allclose(_g(ht.ptp(x, axis=1)), np.ptp(a, axis=1),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(ht.ptp(x)), np.ptp(a), rtol=1e-5)
+        np.testing.assert_allclose(_g(ht.quantile(x, 0.3, axis=0)),
+                                   np.quantile(a, 0.3, axis=0),
+                                   rtol=1e-4, atol=1e-5)
+        with pytest.raises(ValueError):
+            ht.quantile(x, 1.5)
+
+    def test_nan_order_statistics(self, data, split):
+        _, an = data
+        xn = ht.array(an.copy(), split=split)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # numpy warns on all-NaN risk
+            np.testing.assert_allclose(_g(ht.nanmedian(xn, axis=1)),
+                                       np.nanmedian(an, axis=1),
+                                       rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(
+                _g(ht.nanpercentile(xn, 70.0, axis=0)),
+                np.nanpercentile(an, 70.0, axis=0), rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(float(ht.nanquantile(xn, 0.5)),
+                                       np.nanquantile(an, 0.5), rtol=1e-4)
+            np.testing.assert_allclose(float(ht.nanmedian(xn)),
+                                       np.nanmedian(an), rtol=1e-4)
+
+    def test_corrcoef_gradient_trapz(self, data, split):
+        a, _ = data
+        x = ht.array(a.copy(), split=split)
+        np.testing.assert_allclose(_g(ht.corrcoef(x)), np.corrcoef(a),
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(_g(ht.gradient(x, axis=1)),
+                                   np.gradient(a, axis=1),
+                                   rtol=1e-4, atol=1e-5)
+        g0, g1 = ht.gradient(x)
+        ref0, ref1 = np.gradient(a)
+        np.testing.assert_allclose(_g(g0), ref0, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(_g(g1), ref1, rtol=1e-4, atol=1e-5)
+        ref_trapz = (np.trapezoid(a, axis=1) if hasattr(np, "trapezoid")
+                     else np.trapz(a, axis=1))
+        np.testing.assert_allclose(_g(ht.trapz(x, axis=1)), ref_trapz,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_nancum_count(self, data, split):
+        _, an = data
+        xn = ht.array(an.copy(), split=split)
+        np.testing.assert_allclose(_g(ht.nancumsum(xn, 1)),
+                                   np.nancumsum(an, 1), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(_g(ht.nancumprod(xn, 0)),
+                                   np.nancumprod(an, 0), rtol=1e-4, atol=1e-4)
+        assert int(ht.count_nonzero(xn > 0)) == int(np.count_nonzero(an > 0))
+        np.testing.assert_array_equal(
+            _g(ht.count_nonzero(ht.array(an > 0, split=split), axis=0)),
+            np.count_nonzero(an > 0, axis=0))
+
+
+class TestOneDimUtilities:
+    def test_searchsorted(self):
+        v = rng.standard_normal(11).astype(np.float32)
+        sv = np.sort(rng.standard_normal(6).astype(np.float32))
+        x = ht.array(v, split=0)
+        for side in ("left", "right"):
+            np.testing.assert_array_equal(
+                _g(ht.searchsorted(sv, x, side=side)),
+                np.searchsorted(sv, v, side=side))
+        with pytest.raises(ValueError):
+            ht.searchsorted(sv, x, side="middle")
+
+    def test_ediff1d(self):
+        v = rng.standard_normal(11).astype(np.float32)
+        x = ht.array(v, split=0)
+        np.testing.assert_allclose(_g(ht.ediff1d(x)), np.ediff1d(v),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(
+            _g(ht.ediff1d(x, to_begin=0.0, to_end=[1.0, 2.0])),
+            np.ediff1d(v, to_begin=0.0, to_end=[1.0, 2.0]), rtol=1e-6)
+
+    def test_interp(self):
+        xp = np.linspace(0, 1, 5)
+        fp = np.sin(xp)
+        q = rng.random(9).astype(np.float32)
+        np.testing.assert_allclose(
+            _g(ht.interp(ht.array(q, split=0), xp, fp)),
+            np.interp(q, xp, fp), rtol=1e-5, atol=1e-6)
+        # out-of-range uses left/right fills
+        q2 = np.array([-1.0, 2.0], np.float32)
+        np.testing.assert_allclose(
+            _g(ht.interp(ht.array(q2, split=0), xp, fp, left=-7.0, right=7.0)),
+            np.interp(q2, xp, fp, left=-7.0, right=7.0))
