@@ -25,19 +25,20 @@ __all__ = [
     "cumproduct",
     "cumsum",
     "diff",
-    "ediff1d",
-    "nancumprod",
-    "nancumsum",
     "div",
     "divide",
-    "floordiv",
+    "ediff1d",
     "floor_divide",
+    "floordiv",
     "fmod",
+    "heaviside",
     "invert",
     "left_shift",
     "mod",
     "mul",
     "multiply",
+    "nancumprod",
+    "nancumsum",
     "neg",
     "negative",
     "pos",
@@ -328,3 +329,8 @@ def sum(a: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDar
     if keepdim is not None:  # reference/torch keyword name
         keepdims = keepdim
     return _operations._reduce_op(a, jnp.sum, 0, axis=axis, out=out, keepdims=keepdims)
+
+
+def heaviside(x1, x2, out=None) -> DNDarray:
+    """Heaviside step function (``numpy.heaviside``)."""
+    return _operations._binary_op(jnp.heaviside, x1, x2, out)

@@ -7,7 +7,20 @@ import jax.numpy as jnp
 from . import _operations
 from .dndarray import DNDarray
 
-__all__ = ["exp", "expm1", "exp2", "log", "log2", "log10", "log1p", "logaddexp", "logaddexp2", "sqrt", "square"]
+__all__ = [
+    "exp",
+    "exp2",
+    "expm1",
+    "i0",
+    "log",
+    "log10",
+    "log1p",
+    "log2",
+    "logaddexp",
+    "logaddexp2",
+    "sqrt",
+    "square",
+]
 
 
 def exp(x: DNDarray, out=None) -> DNDarray:
@@ -63,3 +76,10 @@ def sqrt(x: DNDarray, out=None) -> DNDarray:
 def square(x: DNDarray, out=None) -> DNDarray:
     """Element-wise square (reference ``:298``)."""
     return _operations._local_op(jnp.square, x, out)
+
+
+def i0(x: DNDarray, out=None) -> DNDarray:
+    """Modified Bessel function of order 0 (``numpy.i0``)."""
+    from jax.scipy.special import i0 as _jsp_i0
+
+    return _operations._local_op(_jsp_i0, x, out)

@@ -36,6 +36,10 @@ __all__ = [
     "meshgrid",
     "ones",
     "ones_like",
+    "tri",
+    "tril_indices",
+    "triu_indices",
+    "vander",
     "zeros",
     "zeros_like",
 ]
@@ -484,3 +488,41 @@ def from_partitioned(x, comm=None) -> DNDarray:
     except AttributeError:
         pass
     return DNDarray.from_logical(arr, split, devices.get_device(), comm)
+
+
+def tri(N: int, M=None, k: int = 0, dtype=types.float32, split=None,
+        device=None, comm=None) -> DNDarray:
+    """Lower-triangular ones matrix (``numpy.tri``)."""
+    M = N if M is None else M
+    return array(np.tri(int(N), int(M), int(k)), dtype=dtype, split=split,
+                 device=device, comm=comm)
+
+
+def tril_indices(n: int, k: int = 0, m=None, split=None, comm=None):
+    """Row/col indices of the lower triangle (``numpy.tril_indices``)."""
+    rows, cols = np.tril_indices(int(n), int(k), None if m is None else int(m))
+    return (array(rows, dtype=types.int64, split=split, comm=comm),
+            array(cols, dtype=types.int64, split=split, comm=comm))
+
+
+def triu_indices(n: int, k: int = 0, m=None, split=None, comm=None):
+    """Row/col indices of the upper triangle (``numpy.triu_indices``)."""
+    rows, cols = np.triu_indices(int(n), int(k), None if m is None else int(m))
+    return (array(rows, dtype=types.int64, split=split, comm=comm),
+            array(cols, dtype=types.int64, split=split, comm=comm))
+
+
+def vander(x: DNDarray, N=None, increasing: bool = False) -> DNDarray:
+    """Vandermonde matrix (``numpy.vander``): built as distributed
+    broadcast powers — a split input yields a row-split result."""
+    from . import arithmetics
+
+    if not isinstance(x, DNDarray):
+        x = array(np.asarray(x))
+    if x.ndim != 1:
+        raise ValueError("vander expects a 1-D array")
+    N = x.shape[0] if N is None else int(N)
+    exps = np.arange(N) if increasing else np.arange(N - 1, -1, -1)
+    col = x.reshape((x.shape[0], 1))
+    return arithmetics.pow(col, array(exps[None, :], dtype=x.dtype,
+                                      comm=x.comm))

@@ -10,7 +10,17 @@ import jax.numpy as jnp
 from . import _operations, types
 from .dndarray import DNDarray
 
-__all__ = ["nonzero", "where"]
+__all__ = [
+    "compress",
+    "extract",
+    "indices",
+    "nonzero",
+    "ravel_multi_index",
+    "take",
+    "trim_zeros",
+    "unravel_index",
+    "where",
+]
 
 
 def _nonzero_distributed(x: DNDarray) -> DNDarray:
@@ -101,3 +111,123 @@ def where(cond, x=None, y=None) -> DNDarray:
     picked_x = _operations._binary_op(lambda c_, x_: jnp.where(c_, x_, 0), c, x)
     picked_y = _operations._binary_op(lambda c_, y_: jnp.where(c_, 0, y_), c, y)
     return arithmetics.add(picked_x, picked_y)
+
+
+def take(a: DNDarray, indices, axis=None, out=None) -> DNDarray:
+    """Elements at the given indices (``numpy.take``): routed through the
+    distributed fancy getitem, which keeps the result split."""
+    from . import factories, manipulations, _operations
+    from .stride_tricks import sanitize_axis
+
+    if not isinstance(a, DNDarray):
+        a = factories.array(a)
+    idx = (indices.astype("int64") if isinstance(indices, DNDarray)
+           else np.asarray(indices))
+    if axis is None:
+        flat = manipulations.flatten(a)
+        result = flat[idx]
+    else:
+        axis = sanitize_axis(a.shape, axis)
+        key = tuple(slice(None) for _ in range(axis)) + (idx,)
+        result = a[key]
+    return _operations._finalize(result, out)
+
+
+def compress(condition, a: DNDarray, axis=None, out=None) -> DNDarray:
+    """Selection by a 1-D boolean (``numpy.compress``): the condition is
+    host-small by numpy's contract (it is truncated to its own length);
+    data selection runs through :func:`take`."""
+    cond = np.asarray(condition, dtype=bool).ravel()
+    (idx,) = np.nonzero(cond)
+    return take(a, idx, axis=axis, out=out)
+
+
+def extract(condition, arr: DNDarray) -> DNDarray:
+    """Flat elements where ``condition`` is nonzero (``numpy.extract``):
+    the distributed boolean selection (stays split)."""
+    from . import factories, manipulations
+
+    if not isinstance(arr, DNDarray):
+        arr = factories.array(arr)
+    if not isinstance(condition, DNDarray):
+        condition = factories.array(np.asarray(condition), comm=arr.comm,
+                                    split=arr.split)
+    flat = manipulations.flatten(arr)
+    mask = manipulations.flatten(condition) != 0
+    if mask.split != flat.split:
+        mask = mask.resplit(flat.split)
+    return flat[mask]
+
+
+def trim_zeros(filt: DNDarray, trim: str = "fb") -> DNDarray:
+    """Trim leading/trailing zeros of a 1-D array (``numpy.trim_zeros``).
+    Only the two boundary positions sync to host (scalar fetches)."""
+    from . import factories
+
+    if not isinstance(filt, DNDarray):
+        filt = factories.array(filt)
+    if filt.ndim != 1:
+        raise ValueError("trim_zeros expects a 1-D array")
+    trim = trim.lower()
+    nz = nonzero(filt != 0)
+    nz = nz[0] if isinstance(nz, tuple) else nz
+    if nz.size == 0:
+        return filt[0:0]
+    start = int(nz[0].item()) if "f" in trim else 0
+    stop = int(nz[-1].item()) + 1 if "b" in trim else filt.shape[0]
+    return filt[start:stop]
+
+
+def unravel_index(indices, shape):
+    """Flat indices -> coordinate tuple (``numpy.unravel_index``), as
+    elementwise arithmetic on the (possibly split) index array."""
+    from . import factories
+
+    if not isinstance(indices, DNDarray):
+        indices = factories.array(np.asarray(indices))
+    total = int(np.prod(shape))
+    # numpy raises for out-of-bounds flat indices; one scalar sync each
+    hi = int(indices.max().item()) if indices.size else 0
+    lo = int(indices.min().item()) if indices.size else 0
+    if indices.size and (hi >= total or lo < 0):
+        raise ValueError(
+            f"index {hi if hi >= total else lo} is out of bounds for array "
+            f"with size {total}")
+    out = []
+    stride = total
+    for dim in shape:
+        stride //= int(dim)
+        out.append((indices // stride) % int(dim))
+    return tuple(out)
+
+
+def ravel_multi_index(multi_index, dims) -> DNDarray:
+    """Coordinate tuple -> flat indices (``numpy.ravel_multi_index``)."""
+    from . import factories
+
+    arrs = [a if isinstance(a, DNDarray) else factories.array(np.asarray(a))
+            for a in multi_index]
+    if len(arrs) != len(dims):
+        raise ValueError("multi_index length must match dims")
+    flat = None
+    stride = int(np.prod(dims))
+    for a, dim in zip(arrs, dims):
+        # numpy raises for out-of-range coordinates (one scalar sync each)
+        if a.size and (int(a.max().item()) >= int(dim)
+                       or int(a.min().item()) < 0):
+            raise ValueError(
+                f"invalid entry in coordinates array for dimension of "
+                f"size {dim}")
+        stride //= int(dim)
+        term = a * stride
+        flat = term if flat is None else flat + term
+    return flat
+
+
+def indices(dimensions, dtype=None, split=None) -> DNDarray:
+    """Index grids (``numpy.indices``): shape ``(len(dims), *dims)``; pass
+    ``split`` to shard the result (split counts the leading grid axis)."""
+    from . import factories, types
+
+    grids = np.indices(tuple(int(d) for d in dimensions))
+    return factories.array(grids, dtype=dtype or types.int64, split=split)

@@ -14,10 +14,12 @@ from .dndarray import DNDarray
 __all__ = [
     "all",
     "allclose",
-    "count_nonzero",
     "any",
+    "count_nonzero",
+    "in1d",
     "isclose",
     "isfinite",
+    "isin",
     "isinf",
     "isnan",
     "isneginf",
@@ -82,6 +84,32 @@ def count_nonzero(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
 
     return arithmetics.sum((x != 0).astype(_t.int64), axis=axis,
                            keepdims=keepdims)
+
+
+def isin(element, test_elements, assume_unique: bool = False,
+         invert: bool = False) -> DNDarray:
+    """Membership test (``numpy.isin``): ``test_elements`` replicates (it
+    is the lookup set); ``element`` stays split."""
+    from . import _operations, factories
+
+    t = (test_elements._logical() if isinstance(test_elements, DNDarray)
+         else jnp.asarray(test_elements))
+    if not isinstance(element, DNDarray):
+        element = factories.array(element)
+    return _operations._local_op(
+        lambda a: jnp.isin(a, t, assume_unique=assume_unique,
+                           invert=invert), element)
+
+
+def in1d(ar1, ar2, assume_unique: bool = False,
+         invert: bool = False) -> DNDarray:
+    """1-D membership (``numpy.in1d``): :func:`isin` on the raveled input."""
+    from . import manipulations, factories
+
+    if not isinstance(ar1, DNDarray):
+        ar1 = factories.array(ar1)
+    return isin(manipulations.flatten(ar1), ar2,
+                assume_unique=assume_unique, invert=invert)
 
 
 def isnan(x: DNDarray) -> DNDarray:

@@ -31,6 +31,7 @@ from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis, sanitize_shape
 
 __all__ = [
+    "array_split",
     "balance",
     "broadcast_arrays",
     "broadcast_to",
@@ -47,6 +48,7 @@ __all__ = [
     "flipud",
     "hsplit",
     "hstack",
+    "intersect1d",
     "moveaxis",
     "pad",
     "ravel",
@@ -57,15 +59,17 @@ __all__ = [
     "roll",
     "rot90",
     "row_stack",
+    "setdiff1d",
+    "setxor1d",
     "shape",
     "sort",
-    "array_split",
     "split",
     "squeeze",
     "stack",
     "swapaxes",
     "tile",
     "topk",
+    "union1d",
     "unique",
     "vsplit",
     "vstack",
@@ -1008,6 +1012,67 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
         out[1].larray = idx_d.larray
         return out
     return vals_d, idx_d
+
+
+def union1d(ar1: DNDarray, ar2: DNDarray) -> DNDarray:
+    """Sorted union of two arrays (``numpy.union1d``): one distributed
+    unique over the concatenated (flattened) inputs."""
+    from . import factories
+
+    if not isinstance(ar1, DNDarray):
+        ar1 = factories.array(ar1)
+    if not isinstance(ar2, DNDarray):
+        ar2 = factories.array(ar2, comm=ar1.comm)
+    return unique(concatenate([flatten(ar1), flatten(ar2)], axis=0),
+                  sorted=True)
+
+
+def intersect1d(ar1: DNDarray, ar2, assume_unique: bool = False) -> DNDarray:
+    """Sorted intersection (``numpy.intersect1d``): distributed unique +
+    membership selection (stays split). ``assume_unique=True`` skips the
+    unique pass; the result is sorted either way, like numpy."""
+    from . import logical, factories
+
+    if not isinstance(ar1, DNDarray):
+        ar1 = factories.array(ar1)
+    if assume_unique:
+        sel = flatten(ar1)
+        return sort(sel[logical.isin(sel, ar2)], axis=0)[0]
+    u = unique(flatten(ar1), sorted=True)
+    return u[logical.isin(u, ar2)]
+
+
+def setdiff1d(ar1: DNDarray, ar2, assume_unique: bool = False) -> DNDarray:
+    """Sorted values of ``ar1`` not in ``ar2`` (``numpy.setdiff1d``).
+    ``assume_unique=True`` skips the unique pass and preserves input
+    order, like numpy."""
+    from . import logical, factories
+
+    if not isinstance(ar1, DNDarray):
+        ar1 = factories.array(ar1)
+    u = (flatten(ar1) if assume_unique
+         else unique(flatten(ar1), sorted=True))
+    return u[logical.isin(u, ar2, invert=True)]
+
+
+def setxor1d(ar1: DNDarray, ar2, assume_unique: bool = False) -> DNDarray:
+    """Sorted symmetric difference (``numpy.setxor1d``): elements of the
+    concatenated per-input uniques that appear exactly once.
+    ``assume_unique=True`` skips the per-input unique passes."""
+    from . import factories
+
+    if not isinstance(ar1, DNDarray):
+        ar1 = factories.array(ar1)
+    if not isinstance(ar2, DNDarray):
+        ar2 = factories.array(ar2, comm=ar1.comm)
+    if assume_unique:
+        u1, u2 = flatten(ar1), flatten(ar2)
+    else:
+        u1 = unique(flatten(ar1), sorted=True)
+        u2 = unique(flatten(ar2), sorted=True)
+    both = concatenate([u1, u2], axis=0)
+    u, counts = unique(both, sorted=True, return_counts=True)
+    return u[counts == 1]
 
 
 def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False,

@@ -10,8 +10,21 @@ from . import _operations, types
 from .dndarray import DNDarray
 
 __all__ = [
-    "abs", "absolute", "ceil", "clip", "copysign", "fabs", "floor", "modf",
-    "round", "sgn", "sign", "trunc",
+    "abs",
+    "absolute",
+    "ceil",
+    "clip",
+    "copysign",
+    "fabs",
+    "fix",
+    "floor",
+    "modf",
+    "nan_to_num",
+    "round",
+    "round_",
+    "sgn",
+    "sign",
+    "trunc",
 ]
 
 
@@ -97,3 +110,25 @@ def sign(x: DNDarray, out=None) -> DNDarray:
 def trunc(x: DNDarray, out=None) -> DNDarray:
     """Truncate toward zero (reference ``:440``)."""
     return _operations._local_op(jnp.trunc, x, out)
+
+
+def fix(x: DNDarray, out=None) -> DNDarray:
+    """Round toward zero, result floating (``numpy.fix``)."""
+    from . import types
+
+    res = trunc(x if types.heat_type_is_inexact(x.dtype)
+                else x.astype(types.float32), out=None)
+    return _operations._finalize(res, out)
+
+
+def round_(x: DNDarray, decimals: int = 0, out=None) -> DNDarray:
+    """Alias of :func:`round` (``numpy.round_``)."""
+    return round(x, decimals=decimals, out=out)
+
+
+def nan_to_num(x: DNDarray, nan: float = 0.0, posinf=None, neginf=None,
+               out=None) -> DNDarray:
+    """Replace NaN/inf with finite numbers (``numpy.nan_to_num``)."""
+    return _operations._local_op(
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        x, out)

@@ -29,10 +29,13 @@ __all__ = [
     "average",
     "bincount",
     "bucketize",
+    "corrcoef",
     "cov",
     "digitize",
+    "gradient",
     "histc",
     "histogram",
+    "interp",
     "kurtosis",
     "max",
     "maximum",
@@ -44,10 +47,10 @@ __all__ = [
     "nanargmin",
     "nanmax",
     "nanmean",
-    "nanmin",
-    "nanprod",
     "nanmedian",
+    "nanmin",
     "nanpercentile",
+    "nanprod",
     "nanquantile",
     "nanstd",
     "nansum",
@@ -60,9 +63,6 @@ __all__ = [
     "std",
     "trapz",
     "var",
-    "corrcoef",
-    "gradient",
-    "interp",
 ]
 
 
@@ -692,14 +692,20 @@ def nanmedian(x: DNDarray, axis=None, keepdims: bool = False) -> DNDarray:
     return nanpercentile(x, 50.0, axis=axis, keepdims=keepdims)
 
 
+def _q01_to_percent(q):
+    """Validate quantile inputs on [0, 1] (NaN fails) and rescale to the
+    percentile range."""
+    qn = np.asarray(q, dtype=np.float64)
+    if qn.size and not bool((qn >= 0).all() and (qn <= 1).all()):
+        raise ValueError("Quantiles must be in the range [0, 1]")
+    return qn * 100.0
+
+
 def nanquantile(x: DNDarray, q, axis=None, out=None,
                 interpolation: str = "linear",
                 keepdims: bool = False) -> DNDarray:
     """q-th quantile (``q`` in [0, 1]) ignoring NaNs (``numpy.nanquantile``)."""
-    qn = np.asarray(q, dtype=np.float64)
-    if qn.size and not bool((qn >= 0).all() and (qn <= 1).all()):
-        raise ValueError("Quantiles must be in the range [0, 1]")
-    return nanpercentile(x, np.asarray(q) * 100.0, axis=axis, out=out,
+    return nanpercentile(x, _q01_to_percent(q), axis=axis, out=out,
                          interpolation=interpolation, keepdims=keepdims)
 
 
@@ -707,10 +713,7 @@ def quantile(x: DNDarray, q, axis=None, out=None,
              interpolation: str = "linear", keepdims: bool = False) -> DNDarray:
     """q-th quantile, ``q`` in [0, 1] (``numpy.quantile``) — the [0, 100]
     scale of :func:`percentile`."""
-    qn = np.asarray(q, dtype=np.float64)
-    if qn.size and not bool((qn >= 0).all() and (qn <= 1).all()):
-        raise ValueError("Quantiles must be in the range [0, 1]")
-    return percentile(x, np.asarray(q) * 100.0, axis=axis, out=out,
+    return percentile(x, _q01_to_percent(q), axis=axis, out=out,
                       interpolation=interpolation, keepdims=keepdims)
 
 
@@ -760,7 +763,11 @@ def trapz(y: DNDarray, x=None, dx: float = 1.0, axis: int = -1) -> DNDarray:
     axis = sanitize_axis(y.shape, axis)
     n = y.shape[axis]
     if n < 2:
-        raise ValueError("trapz requires at least 2 samples along axis")
+        # numpy integrates a single sample to 0 (nothing to accumulate)
+        from . import factories
+
+        gshape = tuple(sz for i, sz in enumerate(y.shape) if i != axis)
+        return factories.zeros(gshape, dtype=y.dtype, comm=y.comm)
     sl_lo = tuple(slice(None, -1) if i == axis else slice(None)
                   for i in range(y.ndim))
     sl_hi = tuple(slice(1, None) if i == axis else slice(None)

@@ -8,10 +8,32 @@ from . import _operations
 from .dndarray import DNDarray
 
 __all__ = [
-    "arccos", "acos", "arccosh", "acosh", "arcsin", "asin", "arcsinh", "asinh",
-    "arctan", "atan", "arctanh", "atanh", "arctan2", "atan2", "hypot",
-    "cos", "cosh", "deg2rad", "degrees", "rad2deg", "radians",
-    "sin", "sinh", "tan", "tanh",
+    "acos",
+    "acosh",
+    "arccos",
+    "arccosh",
+    "arcsin",
+    "arcsinh",
+    "arctan",
+    "arctan2",
+    "arctanh",
+    "asin",
+    "asinh",
+    "atan",
+    "atan2",
+    "atanh",
+    "cos",
+    "cosh",
+    "deg2rad",
+    "degrees",
+    "hypot",
+    "rad2deg",
+    "radians",
+    "sin",
+    "sinc",
+    "sinh",
+    "tan",
+    "tanh",
 ]
 
 
@@ -121,3 +143,8 @@ def tan(x: DNDarray, out=None) -> DNDarray:
 def tanh(x: DNDarray, out=None) -> DNDarray:
     """Element-wise hyperbolic tangent (reference ``trigonometrics.py:475``)."""
     return _operations._local_op(jnp.tanh, x, out)
+
+
+def sinc(x: DNDarray, out=None) -> DNDarray:
+    """Normalized sinc (``numpy.sinc``)."""
+    return _operations._local_op(jnp.sinc, x, out)

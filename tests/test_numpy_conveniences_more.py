@@ -1,0 +1,125 @@
+"""Batch-2 NumPy conveniences beyond the reference: membership/set ops,
+take/compress/extract/trim_zeros, index arithmetic, constructors, and
+elementwise specials — distributed, verified against NumPy."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+rng = np.random.default_rng(5)
+
+
+def _g(t):
+    return np.asarray(t.resplit_(None).larray)
+
+
+class TestMembershipSetOps:
+    def setup_method(self, _):
+        self.a = rng.integers(0, 20, 23)
+        self.b = rng.integers(0, 20, 17)
+        self.xa = ht.array(self.a.copy(), split=0)
+        self.xb = ht.array(self.b.copy(), split=0)
+
+    def test_isin(self):
+        np.testing.assert_array_equal(_g(ht.isin(self.xa, self.xb)),
+                                      np.isin(self.a, self.b))
+        np.testing.assert_array_equal(
+            _g(ht.isin(self.xa, self.xb, invert=True)),
+            np.isin(self.a, self.b, invert=True))
+        np.testing.assert_array_equal(_g(ht.in1d(self.xa, self.b)),
+                                      np.in1d(self.a, self.b))
+
+    def test_set_ops(self):
+        np.testing.assert_array_equal(_g(ht.union1d(self.xa, self.xb)),
+                                      np.union1d(self.a, self.b))
+        np.testing.assert_array_equal(_g(ht.intersect1d(self.xa, self.xb)),
+                                      np.intersect1d(self.a, self.b))
+        np.testing.assert_array_equal(_g(ht.setdiff1d(self.xa, self.xb)),
+                                      np.setdiff1d(self.a, self.b))
+        np.testing.assert_array_equal(_g(ht.setxor1d(self.xa, self.xb)),
+                                      np.setxor1d(self.a, self.b))
+
+
+class TestSelection:
+    def setup_method(self, _):
+        self.m = rng.standard_normal((6, 7)).astype(np.float32)
+        self.x = ht.array(self.m.copy(), split=0)
+
+    def test_take(self):
+        idx = np.array([2, 0, 5, 2])
+        for axis in (None, 0, 1):
+            np.testing.assert_allclose(_g(ht.take(self.x, idx, axis=axis)),
+                                       np.take(self.m, idx, axis=axis))
+
+    def test_compress_extract(self):
+        cond = np.array([True, False, True])
+        np.testing.assert_allclose(
+            _g(ht.compress(cond, self.x, axis=1)),
+            np.compress(cond, self.m, axis=1))
+        np.testing.assert_allclose(
+            np.sort(_g(ht.extract(self.x > 0, self.x))),
+            np.sort(np.extract(self.m > 0, self.m)))
+
+    def test_trim_zeros(self):
+        z = np.array([0, 0, 1, 2, 0, 3, 0, 0], np.float32)
+        xz = ht.array(z, split=0)
+        for trim in ("fb", "f", "b"):
+            np.testing.assert_array_equal(_g(ht.trim_zeros(xz, trim)),
+                                          np.trim_zeros(z, trim))
+        # all-zero input trims to empty
+        assert ht.trim_zeros(ht.array(np.zeros(4, np.float32), split=0)).size == 0
+
+
+class TestIndexMath:
+    def test_unravel_ravel_roundtrip(self):
+        flat = rng.integers(0, 24, 11)
+        xf = ht.array(flat.copy(), split=0)
+        got = ht.unravel_index(xf, (4, 6))
+        want = np.unravel_index(flat, (4, 6))
+        for gg, ww in zip(got, want):
+            np.testing.assert_array_equal(_g(gg), ww)
+        np.testing.assert_array_equal(
+            _g(ht.ravel_multi_index(got, (4, 6))), flat)
+
+    def test_indices(self):
+        np.testing.assert_array_equal(_g(ht.indices((3, 4))),
+                                      np.indices((3, 4)))
+
+
+class TestConstructors:
+    def test_tri_and_indices(self):
+        np.testing.assert_array_equal(_g(ht.tri(4, 5, 1)), np.tri(4, 5, 1))
+        for fn, ref in ((ht.tril_indices, np.tril_indices),
+                        (ht.triu_indices, np.triu_indices)):
+            r_, c_ = fn(4, 1)
+            wr, wc = ref(4, 1)
+            np.testing.assert_array_equal(_g(r_), wr)
+            np.testing.assert_array_equal(_g(c_), wc)
+
+    def test_vander(self):
+        v = rng.standard_normal(5).astype(np.float64)
+        x = ht.array(v, split=0)
+        np.testing.assert_allclose(_g(ht.vander(x)), np.vander(v), rtol=1e-6)
+        np.testing.assert_allclose(_g(ht.vander(x, 3, increasing=True)),
+                                   np.vander(v, 3, increasing=True),
+                                   rtol=1e-6)
+        assert ht.vander(x).split == 0  # stays row-split
+
+
+class TestElementwiseSpecials:
+    def test_all(self):
+        xs = rng.standard_normal(9).astype(np.float32)
+        x = ht.array(xs, split=0)
+        np.testing.assert_allclose(_g(ht.sinc(x)), np.sinc(xs),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(_g(ht.i0(x)), np.i0(xs),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            _g(ht.heaviside(x, ht.array(np.float32(0.5)))),
+            np.heaviside(xs, 0.5))
+        np.testing.assert_allclose(_g(ht.fix(x * 3)), np.fix(xs * 3))
+        np.testing.assert_allclose(_g(ht.round_(x, 1)), np.round(xs, 1))
+        bad = np.array([np.nan, np.inf, -np.inf, 1.0], np.float32)
+        np.testing.assert_allclose(_g(ht.nan_to_num(ht.array(bad, split=0))),
+                                   np.nan_to_num(bad))
