@@ -15,8 +15,10 @@ __all__ = [
     "extract",
     "indices",
     "nonzero",
+    "put_along_axis",
     "ravel_multi_index",
     "take",
+    "take_along_axis",
     "trim_zeros",
     "unravel_index",
     "where",
@@ -231,3 +233,95 @@ def indices(dimensions, dtype=None, split=None) -> DNDarray:
 
     grids = np.indices(tuple(int(d) for d in dimensions))
     return factories.array(grids, dtype=dtype or types.int64, split=split)
+
+
+def _align_indices(arr, indices, axis):
+    """Indices as a DNDarray sharded like ``arr`` (same split; shapes may
+    differ only along ``axis``), with numpy's out-of-bounds error."""
+    from . import factories
+
+    # broadcast dims (size 1 where arr is larger) must stay replicated —
+    # sharding a length-1 dim across ranks is meaningless
+    def _can_shard(idx):
+        return (arr.split is not None and idx.ndim > arr.split
+                and idx.shape[arr.split] == arr.shape[arr.split])
+
+    if not isinstance(indices, DNDarray):
+        ind_np = np.asarray(indices)
+        split = (arr.split if (arr.split is not None
+                               and ind_np.ndim > arr.split
+                               and ind_np.shape[arr.split]
+                               == arr.shape[arr.split]) else None)
+        indices = factories.array(ind_np, split=split, comm=arr.comm)
+    elif indices.split != arr.split:
+        indices = indices.resplit(arr.split if _can_shard(indices) else None)
+    if indices.size:
+        hi = int(indices.max().item())
+        lo = int(indices.min().item())
+        if hi >= arr.shape[axis] or lo < -arr.shape[axis]:
+            raise IndexError(
+                f"index {hi if hi >= arr.shape[axis] else lo} is out of "
+                f"bounds for axis {axis} with size {arr.shape[axis]}")
+    return indices
+
+
+def take_along_axis(arr: DNDarray, indices, axis) -> DNDarray:
+    """Match-shaped gather (``numpy.take_along_axis``): per-shard
+    ``jnp.take_along_axis`` once the split is off the gather axis (at most
+    one reshard, no material gather)."""
+    from . import factories, manipulations
+    from .stride_tricks import sanitize_axis
+
+    if not isinstance(arr, DNDarray):
+        arr = factories.array(arr)
+    if axis is None:
+        return take_along_axis(manipulations.flatten(arr), indices, 0)
+    axis = sanitize_axis(arr.shape, axis)
+    if arr.split == axis and arr.comm.size > 1:
+        arr = (arr.resplit((axis + 1) % arr.ndim) if arr.ndim > 1
+               else arr.resplit(None))
+    indices = _align_indices(arr, indices, axis)
+    res = jnp.take_along_axis(arr.larray, indices.larray, axis=axis)
+    # numpy broadcasts the non-gather dims of arr and indices
+    gshape = tuple(np.broadcast_shapes(
+        tuple(1 if i == axis else s for i, s in enumerate(arr.shape)),
+        indices.gshape))
+    return DNDarray(res, gshape, arr.dtype, arr.split, arr.device, arr.comm)
+
+
+def put_along_axis(arr: DNDarray, indices, values, axis) -> None:
+    """Match-shaped scatter (``numpy.put_along_axis``): updates ``arr`` in
+    place (numpy semantics) via a per-shard functional scatter."""
+    from . import factories, types
+    from .stride_tricks import sanitize_axis
+
+    if not isinstance(arr, DNDarray):
+        raise TypeError("put_along_axis updates in place and requires a "
+                        "DNDarray")
+    if axis is None:
+        raise NotImplementedError(
+            "put_along_axis with axis=None (flattened in-place update) is "
+            "not supported on the canonical layout; reshape explicitly")
+    axis = sanitize_axis(arr.shape, axis)
+    original_split = arr.split
+    work = arr
+    if work.split == axis and work.comm.size > 1:
+        work = (work.resplit((axis + 1) % work.ndim) if work.ndim > 1
+                else work.resplit(None))
+    indices = _align_indices(work, indices, axis)
+    if isinstance(values, DNDarray):
+        # aligned same-shape values keep their shards; anything else
+        # (scalars, broadcastable shapes) goes through the logical view
+        if values.gshape == indices.gshape and values.split == work.split:
+            vals = values.larray.astype(work.larray.dtype)
+        else:
+            vals = values._logical().astype(work.larray.dtype)
+    else:
+        vals = jnp.asarray(np.asarray(values), dtype=work.larray.dtype)
+    res = jnp.put_along_axis(work.larray, indices.larray,
+                             vals, axis=axis, inplace=False)
+    updated = DNDarray(res, work.gshape, work.dtype, work.split, work.device,
+                       work.comm)
+    if updated.split != original_split:
+        updated = updated.resplit(original_split)
+    arr.larray = updated.larray

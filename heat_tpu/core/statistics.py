@@ -11,6 +11,8 @@ canonical-padding neutral fill (``DNDarray.filled``).
 
 from __future__ import annotations
 
+from builtins import range as builtins_range
+
 from typing import Optional, Tuple, Union
 
 import numpy as np
@@ -35,6 +37,8 @@ __all__ = [
     "gradient",
     "histc",
     "histogram",
+    "histogram2d",
+    "histogramdd",
     "interp",
     "kurtosis",
     "max",
@@ -403,6 +407,109 @@ def histogram(a: DNDarray, bins=10, range=None, normed=None, weights=None, densi
         DNDarray.from_logical(hist, None, a.device, a.comm),
         DNDarray.from_logical(edges, None, a.device, a.comm),
     )
+
+
+def histogramdd(sample, bins=10, range=None, weights=None,
+                density: bool = False):
+    """D-dimensional histogram (``numpy.histogramdd``): per-dimension bin
+    indices (elementwise on the split sample) collapse to one flat index
+    and ONE distributed bincount psum — out-of-range samples route to a
+    dropped overflow bin, so nothing gathers.
+
+    ``sample`` is an ``(N, D)`` DNDarray or a sequence of ``(N,)`` arrays.
+    Returns ``(H, edges)`` with ``H`` replicated like :func:`histogram`'s
+    counts."""
+    from . import factories, logical, indexing
+
+    if isinstance(sample, DNDarray):
+        if sample.ndim == 1:
+            sample = sample.reshape((sample.shape[0], 1))
+        cols = [sample[:, d] for d in builtins_range(sample.shape[1])]
+    else:
+        cols = [c if isinstance(c, DNDarray) else factories.array(np.asarray(c))
+                for c in sample]
+    nbins, edges_list = [], []
+    for d, col in enumerate(cols):
+        b = bins[d] if isinstance(bins, (list, tuple)) else bins
+        if np.ndim(b) == 0:
+            if range is not None and range[d] is not None:
+                lo, hi = float(range[d][0]), float(range[d][1])
+            elif col.size == 0:
+                lo, hi = 0.0, 1.0  # numpy's empty-sample default edges
+            else:
+                lo, hi = _minmax_scalars(col)
+            if lo == hi:
+                lo, hi = lo - 0.5, hi + 0.5
+            edges = np.linspace(lo, hi, int(b) + 1)
+        else:
+            edges = np.asarray(b, dtype=np.float64)
+        nbins.append(len(edges) - 1)
+        edges_list.append(edges)
+
+    total = int(np.prod(nbins))
+    flat = None
+    valid = None
+    stride = total
+    for col, edges, nb in zip(cols, edges_list, nbins):
+        stride //= nb
+        idx = _searchsorted_minus1(col, edges)
+        # the rightmost edge is closed (numpy): fold it into the last bin
+        idx = indexing.where(col == float(edges[-1]),
+                             factories.full_like(idx, nb - 1,
+                                                 dtype=idx.dtype), idx)
+        ok = logical.logical_and(col >= float(edges[0]),
+                                 col <= float(edges[-1]))
+        valid = ok if valid is None else logical.logical_and(valid, ok)
+        term = idx.clip(0, nb - 1) * stride
+        flat = term if flat is None else flat + term
+    # invalid samples -> overflow bin (dropped after the count)
+    flat = indexing.where(valid, flat,
+                          factories.full_like(flat, total, dtype=flat.dtype))
+    counts = bincount(flat.astype(types.int64), weights=weights,
+                      minlength=total + 1)
+    H = counts[:total].reshape(tuple(nbins))
+    if density:
+        vol = edges_list[0][1:] - edges_list[0][:-1]
+        for e in edges_list[1:]:
+            vol = np.multiply.outer(vol, e[1:] - e[:-1])
+        tot = float(H.sum())
+        H = H / (factories.array(vol, dtype=types.float64, comm=H.comm)
+                 * (tot if tot else 1.0))
+    return H, [factories.array(e, comm=H.comm) for e in edges_list]
+
+
+def _searchsorted_minus1(col, edges):
+    """``searchsorted(edges, col, 'right') - 1`` as a split-preserving
+    elementwise op (the bin index before edge handling)."""
+    ev = jnp.asarray(edges)
+    return _operations._local_op(
+        lambda t: (jnp.searchsorted(ev, t, side="right") - 1).astype(
+            jnp.int64), col)
+
+
+def histogram2d(x: DNDarray, y: DNDarray, bins=10, range=None, weights=None,
+                density: bool = False):
+    """2-D histogram (``numpy.histogram2d``): :func:`histogramdd` over the
+    coordinate pair."""
+    # numpy's bins forms: scalar -> both dims; length-2 sequence -> one
+    # spec per dim; any other 1-D array_like -> SHARED edges for both dims
+    # numpy's forms: scalar -> both dims; length-2 sequence -> one spec
+    # per dim (counts/edges, possibly mixed); any other 1-D array_like ->
+    # SHARED edges for both dims (np.ndim would choke on mixed tuples)
+    if not np.isscalar(bins) and not isinstance(bins, DNDarray):
+        try:
+            length = len(bins)
+        except TypeError:
+            length = None
+        if length is not None and length != 2 and all(
+                np.isscalar(b) for b in bins):
+            shared = np.asarray(bins)
+            bins = [shared, shared]
+        else:
+            bins = list(bins)
+    H, edges = histogramdd((x, y), bins=bins, range=range, weights=weights,
+                           density=density)
+    return H, edges[0], edges[1]
 
 
 def kurtosis(x: DNDarray, axis=None, unbiased: bool = True, Fischer: bool = True) -> DNDarray:

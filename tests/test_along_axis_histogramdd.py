@@ -1,0 +1,97 @@
+"""take/put_along_axis + histogramdd/histogram2d (beyond the reference),
+distributed, verified against NumPy."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+rng = np.random.default_rng(9)
+
+
+def _g(t):
+    return np.asarray(t.resplit(None).larray)
+
+
+class TestAlongAxis:
+    def setup_method(self, _):
+        self.a = rng.standard_normal((6, 5)).astype(np.float32)
+        self.x = ht.array(self.a.copy(), split=0)
+
+    def test_take_along_axis(self):
+        idx = rng.integers(0, 5, (6, 3))
+        got = ht.take_along_axis(self.x, ht.array(idx, split=0), 1)
+        np.testing.assert_allclose(_g(got), np.take_along_axis(self.a, idx, 1))
+        assert got.split == self.x.split
+        # gather axis == split axis: reshards internally, stays correct
+        idx0 = rng.integers(0, 6, (2, 5))
+        np.testing.assert_allclose(
+            _g(ht.take_along_axis(self.x, ht.array(idx0, split=None), 0)),
+            np.take_along_axis(self.a, idx0, 0))
+
+    def test_take_along_axis_flat(self):
+        v = rng.standard_normal(7).astype(np.float32)
+        idx = rng.integers(0, 7, 4)
+        np.testing.assert_allclose(
+            _g(ht.take_along_axis(ht.array(v, split=0),
+                                  ht.array(idx, split=0), None)),
+            np.take_along_axis(v, idx, None))
+
+    def test_put_along_axis(self):
+        idx = rng.integers(0, 5, (6, 3))
+        b = self.a.copy()
+        xb = ht.array(self.a.copy(), split=0)
+        np.put_along_axis(b, idx, -1.0, 1)
+        ht.put_along_axis(xb, ht.array(idx, split=0), -1.0, 1)
+        assert xb.split == 0
+        np.testing.assert_allclose(_g(xb), b)
+
+    def test_put_along_split_axis(self):
+        idxr = rng.integers(0, 6, (2, 5))
+        b = self.a.copy()
+        xb = ht.array(self.a.copy(), split=0)
+        np.put_along_axis(b, idxr, 9.0, 0)
+        ht.put_along_axis(xb, ht.array(idxr, split=None), 9.0, 0)
+        assert xb.split == 0  # split restored after the internal reshard
+        np.testing.assert_allclose(_g(xb), b)
+
+    def test_out_of_bounds(self):
+        with pytest.raises(IndexError):
+            ht.take_along_axis(self.x, ht.array(np.array([[9] * 5]),
+                                                split=None), 0)
+
+
+class TestHistogramDD:
+    def setup_method(self, _):
+        self.pts = rng.standard_normal((200, 3)).astype(np.float64)
+        self.xs = ht.array(self.pts.copy(), split=0)
+
+    def test_basic(self):
+        H, edges = ht.histogramdd(self.xs, bins=(4, 5, 3))
+        Hn, edn = np.histogramdd(self.pts, bins=(4, 5, 3))
+        np.testing.assert_allclose(_g(H), Hn)
+        for e, en in zip(edges, edn):
+            np.testing.assert_allclose(_g(e), en, rtol=1e-12)
+
+    def test_range_weights(self):
+        w = rng.random(200)
+        H, _ = ht.histogramdd(self.xs, bins=3,
+                              range=[(-1, 1), (-2, 2), (-1, 2)],
+                              weights=ht.array(w.copy(), split=0))
+        Hn, _ = np.histogramdd(self.pts, bins=3,
+                               range=[(-1, 1), (-2, 2), (-1, 2)], weights=w)
+        np.testing.assert_allclose(_g(H), Hn, rtol=1e-6)
+
+    def test_density(self):
+        H, _ = ht.histogramdd(self.xs, bins=(4, 5, 3), density=True)
+        Hn, _ = np.histogramdd(self.pts, bins=(4, 5, 3), density=True)
+        np.testing.assert_allclose(_g(H), Hn, rtol=1e-6)
+
+    def test_sequence_input_and_2d(self):
+        xx, yy = self.pts[:, 0].copy(), self.pts[:, 1].copy()
+        H, ex, ey = ht.histogram2d(ht.array(xx, split=0),
+                                   ht.array(yy, split=0), bins=(6, 4))
+        Hn, exn, eyn = np.histogram2d(xx, yy, bins=(6, 4))
+        np.testing.assert_allclose(_g(H), Hn)
+        np.testing.assert_allclose(_g(ex), exn)
+        np.testing.assert_allclose(_g(ey), eyn)
