@@ -84,7 +84,9 @@ def _ring_predict_fn(comm, k, n_train, c_train, jdt, ldt, shapes):
                 lab_cur = jax.lax.ppermute(lab_cur, axis, perm)
         return _vote(carry_l, k)
 
-    sm = jax.shard_map(
+    from ..core._compat import shard_map
+
+    sm = shard_map(
         body, mesh=comm.mesh, in_specs=(spec2, spec2, spec1),
         out_specs=spec1, check_vma=False)
     fn = jax.jit(sm)

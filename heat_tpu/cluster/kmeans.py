@@ -25,7 +25,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..core._compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..core.dndarray import DNDarray

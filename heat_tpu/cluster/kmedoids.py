@@ -16,7 +16,7 @@ from typing import Optional, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from ..core._compat import shard_map
 
 from ..core.dndarray import DNDarray
 from ..core import types

@@ -32,6 +32,14 @@ from .stride_tricks import broadcast_shape, sanitize_axis
 __all__ = []
 
 
+def _count_align_resplit() -> None:
+    """Metrics tick for an op-engine distribution-alignment reshard (lazy
+    import: utils imports back into core)."""
+    from ..utils import metrics
+
+    metrics.inc("op_engine.align_resplits")
+
+
 def _split_in_output(split: Optional[int], ndim_in: int, ndim_out: int) -> Optional[int]:
     """Map an input split axis to output coordinates after broadcasting
     (leading dimensions are prepended)."""
@@ -53,6 +61,11 @@ def __binary_op(
     Promotes scalars, broadcasts shapes, aligns distributions (resplit of the
     non-dominant operand — the reference's ``sanitize_distribution`` redisti-
     bution trigger), and applies the ``jnp`` operation on physical arrays.
+    Alignment resplits run through the explicit reshard planner
+    (:mod:`.resharding`: split→split is ONE planned all_to_all, never an
+    all-gather) and are counted in the metrics registry
+    (``op_engine.align_resplits``) — resplit sits on the hot path of every
+    cross-split op alignment, so its volume is worth watching.
     """
     fn_kwargs = fn_kwargs or {}
 
@@ -96,9 +109,11 @@ def __binary_op(
     # an operand split along an axis it broadcasts over (size 1) must be
     # replicated first — its padded physical layout cannot broadcast
     if s1 is not None and t1.shape[t1.split] == 1 and out_shape[s1] != 1:
+        _count_align_resplit()
         t1 = t1.resplit(None)
         s1 = None
     if s2 is not None and t2.shape[t2.split] == 1 and out_shape[s2] != 1:
+        _count_align_resplit()
         t2 = t2.resplit(None)
         s2 = None
 
@@ -108,6 +123,7 @@ def __binary_op(
     if s1 is not None:
         out_split = s1
         if s2 is not None and s2 != s1:
+            _count_align_resplit()
             ax2 = s1 - (ndim_out - t2.ndim)
             if ax2 >= 0 and t2.shape[ax2] == out_shape[s1]:
                 t2 = t2.resplit(ax2)
@@ -117,6 +133,7 @@ def __binary_op(
         out_split = s2
         ax1 = s2 - (ndim_out - t1.ndim)
         if t1.ndim > 0 and t1.shape and ax1 >= 0 and t1.shape[ax1] == out_shape[s2]:
+            _count_align_resplit()
             t1 = t1.resplit(ax1)
     else:
         out_split = None

@@ -31,7 +31,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ._compat import shard_map
 
 from ._sort import (
     _float_key_dtype,

@@ -35,7 +35,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ._compat import shard_map
 
 __all__ = ["batcher_rounds", "distributed_sort_fn", "distributed_flat_sort_fn"]
 

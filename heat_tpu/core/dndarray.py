@@ -45,39 +45,20 @@ __all__ = ["DNDarray"]
 Device = devices.Device
 
 
-# cached jitted reshard kernels keyed by (shape, dtype, from_split, to_split, mesh)
-_RESHARD_CACHE: dict = {}
-
-
 def _reshard_physical(parray, gshape, from_split, to_split, comm):
     """Move a canonical physical array between split layouts, on device.
 
-    slice-off-old-padding → pad-new-axis → constrain output sharding, all in
-    one jitted XLA program so the reshard compiles to collectives over
-    ICI (replaces the reference's ``resplit_`` Isend/Irecv tile shuffle,
-    ``dndarray.py:1239-1361``).
+    Delegates to the explicit reshard planner (:mod:`.resharding`):
+    split→split is ONE planned ``all_to_all`` + local reslice (the
+    arXiv:2112.01075 decomposition, O(N/p) peak per device), None→split is
+    a zero-collective local slice, and split→None is the only all-gather
+    case — replacing both the reference's ``resplit_`` Isend/Irecv tile
+    shuffle (``dndarray.py:1239-1361``) and the GSPMD-blind
+    ``out_shardings`` constraint XLA could lower as an all-gather.
     """
-    gshape = tuple(gshape)
-    key = (parray.shape, str(parray.dtype), gshape, from_split, to_split, comm.cache_key)
-    fn = _RESHARD_CACHE.get(key)
-    if fn is None:
-        out_sharding = comm.sharding(len(gshape), to_split)
+    from . import resharding
 
-        def _go(x):
-            # slice physical -> logical
-            if x.shape != gshape:
-                x = jax.lax.slice(x, (0,) * x.ndim, gshape)
-            # pad logical -> new physical
-            if to_split is not None:
-                pad = comm.padded_size(gshape[to_split]) - gshape[to_split]
-                if pad:
-                    cfg = [(0, pad if i == to_split else 0, 0) for i in range(x.ndim)]
-                    x = jax.lax.pad(x, jnp.zeros((), x.dtype), cfg)
-            return x
-
-        fn = jax.jit(_go, out_shardings=out_sharding)
-        _RESHARD_CACHE[key] = fn
-    return fn(parray)
+    return resharding.reshard(parray, gshape, from_split, to_split, comm)
 
 
 class LocalIndex:
@@ -397,7 +378,7 @@ class DNDarray:
         chunk = self.__parray.shape[k] // n
         if halo_size > chunk:
             raise ValueError(f"halo_size {halo_size} exceeds chunk size {chunk}")
-        from jax import shard_map
+        from ._compat import shard_map
 
         spec = comm.spec(self.ndim, k)
 
@@ -427,7 +408,7 @@ class DNDarray:
         from_prev, from_next = parts
         k = self.__split
         comm = self.__comm
-        from jax import shard_map
+        from ._compat import shard_map
 
         spec = comm.spec(self.ndim, k)
         fn = shard_map(

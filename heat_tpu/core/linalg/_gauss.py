@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from .._compat import shard_map
 
 from .._sort import _index_dtype
 

@@ -176,7 +176,7 @@ def _cholesky_split0(A: DNDarray) -> DNDarray:
     the logical array is never materialized.
     """
     import jax
-    from jax import shard_map
+    from .._compat import shard_map
     from jax.scipy.linalg import solve_triangular
 
     from .. import types

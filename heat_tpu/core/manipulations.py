@@ -226,7 +226,7 @@ def _diag_construct_distributed(a: DNDarray, offset: int):
     (reference ``:512``). Row ``j`` holds ``w[j]`` at column ``j + offset``
     where ``w`` is the vector zero-extended to length ``L``."""
     import jax
-    from jax import shard_map
+    from ._compat import shard_map
     from . import factories
 
     comm = a.comm
@@ -272,7 +272,7 @@ def _diagonal_extract_distributed(a: DNDarray, offset: int):
     """diagonal of a row-split 2-D matrix: each row's diagonal element is
     shard-local; the length-``L`` prefix re-chunks through the mask ring."""
     import jax
-    from jax import shard_map
+    from ._compat import shard_map
 
     comm = a.comm
     n, m = a.shape
@@ -665,7 +665,10 @@ def reshape(a: DNDarray, *shape, new_split=None, **kwargs) -> DNDarray:
     ):
         # distributed re-chunking of the row-major flat sequence (reference's
         # Alltoallv formulation): resplit to rows, ring-exchange flat ranges,
-        # resplit to the target split — never materializes the logical array
+        # resplit to the target split — never materializes the logical array.
+        # Both resplits run through the explicit reshard planner
+        # (core/resharding.py): each is ONE all_to_all + local reslice, so
+        # the whole reshape path stays all-gather-free end to end
         from . import _manips
 
         src = a if a.split == 0 else a.resplit(0)

@@ -220,7 +220,7 @@ def cdist_tile(x, y, sqrt: bool = True, block_m: int = 256,
             pl.BlockSpec((bn, dp), lambda i, j: (_i32(j), _i32(0))),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j: (_i32(i), _i32(j))),
-        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype, vma=_vma(xp, yp)),
+        out_shape=_sds((mp, np_), out_dtype, vma=_vma(xp, yp)),
         interpret=_interpret(),
     )(xp, yp)
     return out[:m, :n]
@@ -323,10 +323,23 @@ def _vma(*ts):
     """Union of the operands' varying-across-mesh-axes type, so pallas_call
     outputs typecheck inside a ``check_vma=True`` shard_map (e.g. the
     flagship transformer's train step)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:  # older jax: no vma tracking — nothing varies
+        return frozenset()
     out = frozenset()
     for t in ts:
-        out = out | frozenset(getattr(jax.typeof(t), "vma", ()) or ())
+        out = out | frozenset(getattr(typeof(t), "vma", ()) or ())
     return out
+
+
+def _sds(shape, dtype, vma=frozenset()):
+    """``jax.ShapeDtypeStruct`` with the ``vma`` type annotation when this
+    jax supports it (older releases have neither the kwarg nor the
+    tracking, so dropping it is exact)."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:
+        return jax.ShapeDtypeStruct(shape, dtype)
 
 
 @functools.partial(
@@ -381,8 +394,8 @@ def _flash_impl(
             pl.BlockSpec((1, bq, 8), lambda b, i, j: (_i32(b), _i32(i), _i32(0))),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, sqp, dp), q.dtype, vma=_vma(q, k, v)),
-            jax.ShapeDtypeStruct((B * H, sqp, 8), jnp.float32, vma=_vma(q, k, v)),
+            _sds((B * H, sqp, dp), q.dtype, vma=_vma(q, k, v)),
+            _sds((B * H, sqp, 8), jnp.float32, vma=_vma(q, k, v)),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, dp), acc_dtype),
@@ -592,8 +605,8 @@ def _flash_bwd_impl(q, k, v, out, lse, dout, dlse, scale: float, causal: bool,
             pl.BlockSpec((1, bk, dp), lambda b, kb, qi: (_i32(b), _i32(kb), _i32(0))),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, skp, dp), k.dtype, vma=vma),
-            jax.ShapeDtypeStruct((BH, skp, dp), v.dtype, vma=vma),
+            _sds((BH, skp, dp), k.dtype, vma=vma),
+            _sds((BH, skp, dp), v.dtype, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, dp), acc_dtype),
@@ -616,7 +629,7 @@ def _flash_bwd_impl(q, k, v, out, lse, dout, dlse, scale: float, causal: bool,
         out_specs=[
             pl.BlockSpec((1, bq, dp), lambda b, qi, kb: (_i32(b), _i32(qi), _i32(0))),
         ],
-        out_shape=[jax.ShapeDtypeStruct((BH, sqp, dp), q.dtype, vma=vma)],
+        out_shape=[_sds((BH, sqp, dp), q.dtype, vma=vma)],
         scratch_shapes=[pltpu.VMEM((bq, dp), acc_dtype)],
         interpret=_interpret(),
     )(qf, kf, vf, dof, lse_c, dmb_c)[0]
@@ -897,9 +910,9 @@ def _kmeans_step_tile(x, centroids, valid_mask, block_rows: int,
             pl.BlockSpec((8, 128), lambda i: (_i32(0), _i32(0))),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((kp, d), acc_dtype, vma=_vma(x, centroids)),
-            jax.ShapeDtypeStruct((8, kp), acc_dtype, vma=_vma(x, centroids)),
-            jax.ShapeDtypeStruct((8, 128), acc_dtype, vma=_vma(x, centroids)),
+            _sds((kp, d), acc_dtype, vma=_vma(x, centroids)),
+            _sds((8, kp), acc_dtype, vma=_vma(x, centroids)),
+            _sds((8, 128), acc_dtype, vma=_vma(x, centroids)),
         ],
         scratch_shapes=[
             pltpu.VMEM((kp, d), acc_dtype),

@@ -19,7 +19,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ._compat import shard_map
 
 from . import _operations, arithmetics, types
 from .dndarray import DNDarray

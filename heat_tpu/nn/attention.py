@@ -26,7 +26,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..core._compat import shard_map
 
 from ..core.communication import TPUCommunication, sanitize_comm
 from ..core.dndarray import DNDarray

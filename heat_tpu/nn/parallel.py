@@ -240,9 +240,15 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro, *, axis: str):
             lambda c, xm: (c, stage_fn(stage_params, xm)), 0, x_micro)
         return lax.psum(out, axis)
 
-    # initial carries are device-varying (they hold per-stage activations)
-    _vary = (partial(lax.pcast, to="varying") if hasattr(lax, "pcast")
-             else lax.pvary)
+    # initial carries are device-varying (they hold per-stage activations);
+    # on jax versions without vma tracking (no pcast/pvary) the annotation
+    # is unnecessary and the identity is correct
+    if hasattr(lax, "pcast"):
+        _vary = partial(lax.pcast, to="varying")
+    elif hasattr(lax, "pvary"):
+        _vary = lax.pvary
+    else:
+        _vary = lambda x, _axis: x  # noqa: E731
     out_buf = _vary(jnp.zeros_like(x_micro), axis)
     recv = _vary(jnp.zeros_like(x_micro[0]), axis)
 

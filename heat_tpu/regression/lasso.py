@@ -31,7 +31,7 @@ def _cd_sweep_fn(phys_shape, n: int, comm):
     if fn is not None:
         return fn
     import jax
-    from jax import shard_map
+    from ..core._compat import shard_map
 
     c = phys_shape[0] // comm.size
     mm = phys_shape[1] + 1
@@ -125,7 +125,7 @@ class Lasso(RegressionMixin, BaseEstimator):
         if x.ndim != 2:
             raise ValueError("x needs to be 2-dimensional (n_samples, n_features)")
         import jax
-        from jax import shard_map
+        from ..core._compat import shard_map
 
         n, m = x.shape
         mm = m + 1

@@ -21,7 +21,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ..core._compat import shard_map
 
 from ..core import types
 from ..core.dndarray import DNDarray

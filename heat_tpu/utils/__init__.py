@@ -4,6 +4,7 @@ and profiling subsystems the reference lacks (SURVEY.md §5)."""
 from . import data
 from . import vision_transforms
 from . import checkpointing
+from . import hlo_audit
 from . import metrics
 from . import profiling
 from .checkpointing import (

@@ -1,0 +1,97 @@
+"""Optimized-HLO collective parsing and memory accounting (jax-free).
+
+Shared by ``scripts/collective_audit.py`` and the tier-1 HLO-audit tests:
+given the ``compiled.as_text()`` dump of an XLA program, count the
+collective instructions and sum their per-device result-shape payload
+bytes — the partitioned payloads XLA actually emits, not a model.
+
+Parsing is per-line (HLO prints one instruction per line) with ``/*...*/``
+comments stripped first: long tuple results embed ``/*index=5*/`` markers
+whose ``=`` defeats naive cross-line regexes (an 8-way all-to-all result is
+an 8-tuple and WAS undercounted by the previous parser).
+
+This module must stay importable without jax: the audit script's parent
+process never touches the backend.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict
+
+__all__ = ["collective_stats", "total_collective_bytes", "memory_stats",
+           "COLLECTIVES"]
+
+COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+    "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8, "u64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_COMMENT_RE = re.compile(r"/\*.*?\*/")
+# ``%all-to-all.2 = (f32[128,80]{1,0}, ...) all-to-all(`` — result portion
+# captured up to the mnemonic; ``-start``/``-done`` async halves are counted
+# once via the start instruction.
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*?)\s*("
+    + "|".join(COLLECTIVES) + r")(?:-start)?\(")
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def collective_stats(hlo: str) -> Dict[str, Dict[str, int]]:
+    """``{kind: {"count": int, "bytes": int}}`` over an optimized-HLO dump.
+
+    ``bytes`` sums each instruction's result-shape payload once — all
+    elements of a tuple-shaped result (XLA fuses independent psums into ONE
+    tuple-shaped all-reduce, and tiled all-to-alls are n-tuples).
+    """
+    stats = {k: {"count": 0, "bytes": 0} for k in COLLECTIVES}
+    for line in hlo.splitlines():
+        m = _INSTR_RE.match(_COMMENT_RE.sub("", line))
+        if m is None:
+            continue
+        result, kind = m.groups()
+        total = 0
+        for dt, dims in _SHAPE_RE.findall(result):
+            n = 1
+            for piece in dims.split(","):
+                if piece:
+                    n *= int(piece)
+            total += n * _DTYPE_BYTES.get(dt, 4)
+        stats[kind]["count"] += 1
+        stats[kind]["bytes"] += total
+    return {k: v for k, v in stats.items() if v["count"]}
+
+
+def total_collective_bytes(stats: Dict[str, Dict[str, int]]) -> int:
+    return sum(v["bytes"] for v in stats.values())
+
+
+def memory_stats(compiled) -> Dict[str, int]:
+    """Per-device buffer accounting from ``compiled.memory_analysis()``.
+
+    Fail-soft: backends without the analysis (or older jax) return ``{}``;
+    callers treat memory numbers as optional evidence on top of the
+    deterministic HLO counts.
+    """
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    out = {}
+    for name in ("temp_size_in_bytes", "argument_size_in_bytes",
+                 "output_size_in_bytes", "generated_code_size_in_bytes"):
+        try:
+            v = getattr(ma, name)
+        except AttributeError:
+            continue
+        if isinstance(v, int):
+            out[name] = v
+    return out

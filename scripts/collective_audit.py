@@ -33,47 +33,10 @@ Usage (writes one JSON line per (program, d) plus a summary):
 import argparse
 import json
 import os
-import re
 import subprocess
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-_DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
-    "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8, "u64": 8, "c64": 8,
-    "c128": 16,
-}
-
-_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter", "all-to-all",
-                "collective-permute")
-
-# matches the result portion of a collective instruction, e.g.
-# ``%all-reduce.9 = (f32[8,64]{1,0}, f32[8]{0}, f32[]) all-reduce(`` —
-# XLA fuses independent psums into ONE tuple-shaped all-reduce, so the
-# result may be a tuple of shapes; the payload is their sum.
-_INSTR_RE = re.compile(
-    r"= ([^=]*?)\s(" + "|".join(_COLLECTIVES) + r")\(")
-_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
-
-
-def hlo_collective_stats(hlo: str) -> dict:
-    """{kind: {"count": int, "bytes": int}} over an optimized-HLO dump.
-    ``bytes`` sums each instruction's result-shape payload once (all
-    elements of a tuple-shaped result)."""
-    stats = {k: {"count": 0, "bytes": 0} for k in _COLLECTIVES}
-    for m in _INSTR_RE.finditer(hlo):
-        result, kind = m.groups()
-        total = 0
-        for dt, dims in _SHAPE_RE.findall(result):
-            n = 1
-            for piece in dims.split(","):
-                if piece:
-                    n *= int(piece)
-            total += n * _DTYPE_BYTES.get(dt, 4)
-        stats[kind]["count"] += 1
-        stats[kind]["bytes"] += total
-    return {k: v for k, v in stats.items() if v["count"]}
 
 
 def _audit_one(ndev: int, programs: list) -> list:
@@ -87,18 +50,26 @@ def _audit_one(ndev: int, programs: list) -> list:
     import heat_tpu as ht
     from heat_tpu.core.communication import TPUCommunication
 
+    from heat_tpu.utils import hlo_audit
+
     comm = TPUCommunication(jax.devices()[:ndev])
     out = []
 
     def emit(name, fn, args, expect):
         try:
-            hlo = fn.lower(*args).compile().as_text()
+            compiled = fn.lower(*args).compile()
+            hlo = compiled.as_text()
         except Exception as exc:
             out.append({"program": name, "devices": ndev,
                         "error": str(exc)[-200:]})
             return
+        # hlo_audit parses per line with comment stripping, so long
+        # tuple-shaped results (``/*index=5*/`` markers) are counted fully;
+        # the previous in-script regex undercounted 8-way tiled all-to-alls
         out.append({"program": name, "devices": ndev,
-                    "stats": hlo_collective_stats(hlo), "expect": expect})
+                    "stats": hlo_audit.collective_stats(hlo),
+                    "memory": hlo_audit.memory_stats(compiled),
+                    "expect": expect})
 
     n_per = 128  # rows per device: payloads scale as O(n/p) by construction
     feats, k = 64, 8
@@ -188,6 +159,42 @@ def _audit_one(ndev: int, programs: list) -> list:
              "(fwd + bwd recompute), payload O(S/p * H * D) each; "
              "all-reduces for replicated-param grad sync only")
 
+    if "resplit" in programs and ndev > 1:
+        # The explicit reshard planner vs the GSPMD-blind baseline (the
+        # pre-planner ``out_shardings`` program, kept for exactly this
+        # audit), at a FIXED global size so the ladder shows the O(N/p)
+        # per-device payload and temp-buffer scaling. "even" divides at
+        # every audited d; "uneven" exercises the padded canonical layout,
+        # where the baseline re-lays-out through a larger temp buffer.
+        from heat_tpu.core import resharding
+
+        for label, gshape in (("even", (1024, 640)), ("uneven", (1000, 636))):
+            x = ht.random.rand(*gshape, dtype=ht.float32, split=0, comm=comm)
+            phys, jdt = x.larray.shape, x.larray.dtype
+            emit(f"resplit_planned_{label}",
+                 resharding.planned_reshard_fn(phys, jdt, gshape, 0, 1, comm),
+                 (x.larray,),
+                 "split0->split1: exactly ONE all-to-all, ZERO all-gather, "
+                 "payload and temp O(N/p)")
+            emit(f"resplit_gspmd_{label}",
+                 resharding.gspmd_reshard_fn(phys, jdt, gshape, 0, 1, comm),
+                 (x.larray,),
+                 "GSPMD-blind baseline for the same reshard: whatever XLA "
+                 "chooses (audited, not trusted)")
+        x = ht.random.rand(1024, 640, dtype=ht.float32, split=None, comm=comm)
+        emit("resplit_place",
+             resharding.planned_reshard_fn(
+                 x.larray.shape, x.larray.dtype, (1024, 640), None, 0, comm),
+             (x.larray,),
+             "None->split0: local slice per device, ZERO collectives")
+        xs = ht.random.rand(1024, 640, dtype=ht.float32, split=0, comm=comm)
+        emit("resplit_gather",
+             resharding.planned_reshard_fn(
+                 xs.larray.shape, xs.larray.dtype, (1024, 640), 0, None,
+                 comm),
+             (xs.larray,),
+             "split0->None: the ONE legitimate all-gather case")
+
     if "attention" in programs and ndev > 1:
         from heat_tpu.nn.attention import ring_attention
 
@@ -207,9 +214,15 @@ def _audit_one(ndev: int, programs: list) -> list:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--devices", default="1,4,16,64,256")
+    ap.add_argument("--devices", default=None,
+                    help="device-count ladder (default 1,4,16,64,256; "
+                         "4,8 under --resplit)")
     ap.add_argument("--programs",
-                    default="kmeans,roll,reshape,cdist,attention")
+                    default="kmeans,roll,reshape,cdist,attention,resplit")
+    ap.add_argument("--resplit", action="store_true",
+                    help="audit ONLY the resplit planner vs the GSPMD "
+                         "baseline (standalone mode; also run from "
+                         "scripts/run_suite_ladder.py every round)")
     ap.add_argument("--timeout", type=float, default=1800.0,
                     help="per-device-count compile budget (s)")
     ap.add_argument("--out", default=None, help="also write summary JSON here")
@@ -217,7 +230,9 @@ def main():
                     help="(internal) run the audit in THIS process")
     args = ap.parse_args()
 
-    programs = args.programs.split(",")
+    programs = ["resplit"] if args.resplit else args.programs.split(",")
+    if args.devices is None:
+        args.devices = "4,8" if args.resplit else "1,4,16,64,256"
     if args.measure_devices:
         _audit_one(args.measure_devices, programs)
         return
@@ -268,6 +283,29 @@ def main():
         with open(args.out, "w") as f:
             json.dump({"results": all_results, "verdict": verdicts}, f,
                       indent=1)
+    if args.resplit:
+        # standalone/CI mode: the collective bounds are the contract — and a
+        # compile failure must FAIL the gate, not skip it. Error records
+        # carry no 'stats', so audit_verdicts never sees them; require every
+        # resplit program to have a full >=2-rung ladder and every planned
+        # rung to carry its baseline comparison (cf. the transformer checks
+        # above: a single surviving record must not pass).
+        bad = [p for p, rec in verdicts.items() if not rec.get("all_ok")]
+        required = ("resplit_planned_even", "resplit_planned_uneven",
+                    "resplit_gspmd_even", "resplit_gspmd_uneven",
+                    "resplit_place", "resplit_gather")
+        for p in required:
+            if len(verdicts.get(p, {}).get("ladder", [])) < 2:
+                bad.append(f"{p}: missing ladder records (compile failure?)")
+        for label in ("even", "uneven"):
+            for c in verdicts.get(f"resplit_planned_{label}",
+                                  {}).get("ladder", []):
+                if "bytes_vs_gspmd" not in c:
+                    bad.append(f"resplit_planned_{label}@d={c['devices']}: "
+                               "no GSPMD baseline to compare against")
+        if bad:
+            print(json.dumps({"resplit_audit_failed": bad}))
+            sys.exit(1)
 
 
 def audit_verdicts(results: list) -> dict:
@@ -286,6 +324,7 @@ def audit_verdicts(results: list) -> dict:
             cp = st.get("collective-permute", {"count": 0, "bytes": 0})
             ar = st.get("all-reduce", {"count": 0, "bytes": 0})
             ag = st.get("all-gather", {"count": 0})
+            a2a = st.get("all-to-all", {"count": 0, "bytes": 0})
             if prog == "kmeans_lloyd_step":
                 ok = (ag["count"] == 0 and cp["count"] == 0
                       and ar["count"] <= 4)
@@ -295,9 +334,19 @@ def audit_verdicts(results: list) -> dict:
                 ok = ag["count"] == 0 and cp["count"] == d - 1
             elif prog == "ring_attention":
                 ok = ag["count"] == 0 and cp["count"] == 2 * (d - 1)
+            elif prog.startswith("resplit_planned"):
+                # the tentpole invariant: zero all-gather, ONE all-to-all
+                ok = ag["count"] == 0 and a2a["count"] == 1
+            elif prog == "resplit_place":
+                ok = not st  # None->split: ZERO collectives of any kind
+            elif prog == "resplit_gather":
+                ok = ag["count"] == 1 and a2a["count"] == 0
             else:
                 ok = True
-            checks.append({"devices": d, "ok": ok, **st})
+            entry = {"devices": d, "ok": ok, **st}
+            if r.get("memory"):
+                entry["memory"] = r["memory"]
+            checks.append(entry)
         # cross-record structure checks for the transformer train step;
         # these NEED a ladder — a single surviving record (others failed to
         # compile) or a missing collective kind must FAIL, not pass
@@ -320,6 +369,51 @@ def audit_verdicts(results: list) -> dict:
                 for c in checks:
                     c["ok"] = False
         v[prog] = {"all_ok": all(c["ok"] for c in checks), "ladder": checks}
+
+    # cross-program resplit bounds: at every device count the planned path
+    # must move no more collective bytes than the GSPMD-blind baseline and
+    # peak no higher in temp buffers; across the ladder the per-device
+    # payload must scale ~1/p (fixed global size by construction above)
+    for label in ("even", "uneven"):
+        planned = v.get(f"resplit_planned_{label}")
+        baseline = v.get(f"resplit_gspmd_{label}")
+        if planned is None:
+            continue
+        base_by_d = {c["devices"]: c
+                     for c in (baseline or {"ladder": []})["ladder"]}
+        for c in planned["ladder"]:
+            b = base_by_d.get(c["devices"])
+            if b is None:
+                continue
+            kinds = ("all-reduce", "all-gather", "reduce-scatter",
+                     "all-to-all", "collective-permute")
+            pb = sum(c.get(k, {}).get("bytes", 0) for k in kinds)
+            bb = sum(b.get(k, {}).get("bytes", 0) for k in kinds)
+            c["bytes_vs_gspmd"] = {"planned": pb, "gspmd": bb,
+                                   "ok": pb <= bb}
+            pt = c.get("memory", {}).get("temp_size_in_bytes")
+            bt = b.get("memory", {}).get("temp_size_in_bytes")
+            if pt is not None and bt is not None:
+                c["temp_vs_gspmd"] = {"planned": pt, "gspmd": bt,
+                                      "ok": pt <= bt}
+            c["ok"] = (c["ok"] and c["bytes_vs_gspmd"]["ok"]
+                       and c.get("temp_vs_gspmd", {}).get("ok", True))
+        lad = sorted(planned["ladder"], key=lambda c: c["devices"])
+        for lo, hi in zip(lad, lad[1:]):
+            blo = lo.get("all-to-all", {}).get("bytes")
+            bhi = hi.get("all-to-all", {}).get("bytes")
+            if blo and bhi:
+                # recorded bytes are the per-device payload = N/p at fixed
+                # global N, so bytes·p is constant across the ladder
+                # (±25% for padding granularity on the uneven shape)
+                ratio = (blo * lo["devices"]) / (bhi * hi["devices"])
+                hi["payload_scaling_1_over_p"] = {
+                    "vs_devices": lo["devices"],
+                    "ratio": round(ratio, 3),
+                    "ok": 0.75 <= ratio <= 1.34,
+                }
+                hi["ok"] = hi["ok"] and hi["payload_scaling_1_over_p"]["ok"]
+        planned["all_ok"] = all(c["ok"] for c in planned["ladder"])
     return v
 
 

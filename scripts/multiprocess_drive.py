@@ -65,7 +65,7 @@ def worker(pid: int, nprocs: int) -> None:
     w = jnp.arange(4 * k, dtype=jnp.float32).reshape(4, k)
     w = jax.device_put(w, NamedSharding(grid.mesh, P(("dcn", "ici"))))
 
-    from jax import shard_map
+    from heat_tpu.core._compat import shard_map
 
     def blend(wblk):
         # bf16 on the wire, f32 math — DASO's global-sync recipe

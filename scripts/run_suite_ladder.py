@@ -43,8 +43,12 @@ def _env(n: int) -> dict:
 def run_suite(n: int, timeout: float) -> dict:
     t0 = time.time()
     try:
+        # -X faulthandler: the rare 4-device XLA:CPU SIGABRT (NEXT.md §2b)
+        # kills the interpreter below pytest — only a faulthandler dump on
+        # stderr survives it, and it is persisted into the ladder JSON
         out = subprocess.run(
-            [sys.executable, "-m", "pytest", "tests/", "-x", "-q", "-rs"],
+            [sys.executable, "-X", "faulthandler", "-m", "pytest", "tests/",
+             "-x", "-q", "-rs"],
             env=_env(n), capture_output=True, text=True, timeout=timeout,
             cwd=_REPO)
     except subprocess.TimeoutExpired:
@@ -73,6 +77,14 @@ def run_suite(n: int, timeout: float) -> dict:
         tail = out.stdout.strip().splitlines()[-40:]
         rec["failure_tail"] = tail
         print("\n".join(tail), file=sys.stderr, flush=True)
+    stderr = out.stderr or ""
+    if out.returncode < 0 or "Fatal Python error" in stderr:
+        # interpreter abort (SIGABRT/SIGSEGV): pytest never reported — the
+        # faulthandler dump on stderr is the only trace; keep it
+        rec["abort_signal"] = -out.returncode if out.returncode < 0 else None
+        rec["abort_traceback"] = stderr.strip().splitlines()[-120:]
+        print("\n".join(rec["abort_traceback"][-40:]), file=sys.stderr,
+              flush=True)
     return rec
 
 
@@ -118,6 +130,8 @@ def main():
     ap.add_argument("--examples-only", action="store_true",
                     help="skip the suite; run only the examples smoke")
     ap.add_argument("--examples-timeout", type=float, default=600.0)
+    ap.add_argument("--no-resplit-audit", action="store_true",
+                    help="skip the collective_audit --resplit bounds check")
     args = ap.parse_args()
 
     ladder = []
@@ -148,12 +162,38 @@ def main():
             print(json.dumps(r), flush=True)
         artifact["examples"] = ex
 
+    audit_bad = False
+    if not (args.no_resplit_audit or args.examples_only):
+        # re-check the reshard planner's collective bounds every round:
+        # zero all-gather on split->split, bytes/temp <= the GSPMD
+        # baseline, O(N/p) payload scaling (collective_audit --resplit)
+        print("=== resplit collective audit (4,8 devices) ===", flush=True)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        try:
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(_REPO, "scripts", "collective_audit.py"),
+                 "--resplit"],
+                env=env, capture_output=True, text=True, timeout=900.0,
+                cwd=_REPO)
+            line = next((l for l in reversed(out.stdout.splitlines())
+                         if l.startswith("{\"summary\"")), None)
+            artifact["resplit_audit"] = (
+                json.loads(line)["summary"] if line
+                else {"error": (out.stderr or "no output").strip()[-300:]})
+            audit_bad = out.returncode != 0
+        except subprocess.TimeoutExpired:
+            artifact["resplit_audit"] = {"error": "audit exceeded 900s"}
+            audit_bad = True
+        print(json.dumps({"resplit_audit_ok": not audit_bad}), flush=True)
+
     with open(os.path.join(_REPO, args.out), "w") as f:
         json.dump(artifact, f, indent=1)
     print(f"wrote {args.out}")
     bad = ([r for r in ladder if r.get("rc") != 0]
            + [r for r in ex if r.get("rc") != 0])
-    sys.exit(1 if bad else 0)
+    sys.exit(1 if bad or audit_bad else 0)
 
 
 if __name__ == "__main__":

@@ -170,7 +170,7 @@ class TestZigzagRingAttention:
         v = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
 
         spec = comm.spec(4, 1)
-        from jax import shard_map
+        from heat_tpu.core._compat import shard_map
         from heat_tpu.nn.attention import _ring_body_zigzag
         from functools import partial
 

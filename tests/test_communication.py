@@ -8,7 +8,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from heat_tpu.core._compat import shard_map
 
 import heat_tpu as ht
 

