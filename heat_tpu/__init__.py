@@ -52,8 +52,21 @@ from . import spatial
 from . import nn
 from . import optim
 from . import utils
+from . import serve
 
 __version__ = core.__version__
+
+
+def runtime_stats() -> dict:
+    """The process's one observability snapshot: serving figures (latency
+    percentiles, queue depth, batch occupancy, shed count, program-cache
+    stats), the resharding plan cache (``"resharding"`` is exactly
+    :func:`heat_tpu.core.resharding.plan_cache_stats` — the supported alias
+    for it), the op-engine alignment counter, and every process-wide
+    counter. See :mod:`heat_tpu.serve.metrics`."""
+    from .serve.metrics import runtime_stats as _rs
+
+    return _rs()
 
 
 def __getattr__(name):

@@ -318,8 +318,10 @@ class TransformerLM:
         h = _rmsnorm(h, params["final_ln"].astype(c.compute_dtype))
         return (h @ params["unembed"].astype(c.compute_dtype)).astype(jnp.float32)
 
-    def _loss_device(self, params, toks):
-        """Per-device code: toks (B_local, S_local) -> replicated global loss."""
+    def _forward_device(self, params, toks):
+        """Per-device forward: toks (B_local, S_local) -> f32 logits
+        (B_local, S_local, vocab). Shared by the training loss and the
+        serving forward (:meth:`logits_fn`)."""
         c = self.cfg
         sp_comm = self.grid.axis("sp")
         B_local, S_local = toks.shape
@@ -373,7 +375,12 @@ class TransformerLM:
         h = out.reshape(B_local, S_local, c.d_model)
         if zigzag:
             h = zigzag_unlayout(h, sp_comm)
-        logits = self._head(params, h)
+        return self._head(params, h)
+
+    def _loss_device(self, params, toks):
+        """Per-device code: toks (B_local, S_local) -> replicated global loss."""
+        B_local, S_local = toks.shape
+        logits = self._forward_device(params, toks)
 
         # next-token targets across the sharded sequence: local shift plus
         # the neighbour shard's first token via ppermute (the halo pattern,
@@ -433,6 +440,26 @@ class TransformerLM:
                 in_specs=(specs, self._data_spec()),
                 out_specs=(P(), specs),
                 check_vma=True)
+            fn = jax.jit(sm)
+            self._step_cache[key] = fn
+        return fn
+
+    def logits_fn(self):
+        """jitted ``(params, toks) -> (B, S, vocab) f32 logits`` over the
+        full grid — the serving forward (``heat_tpu.serve.adapters``).
+
+        Same per-device program as the training loss up to the head
+        (:meth:`_forward_device`), compiled once and cached; runs with
+        ``check_vma=False`` (inference needs no replication-type tracking,
+        and the forward then traces on every supported jax)."""
+        key = "logits"
+        fn = self._step_cache.get(key)
+        if fn is None:
+            sm = shard_map(
+                self._forward_device, mesh=self.grid.mesh,
+                in_specs=(self.param_specs(), self._data_spec()),
+                out_specs=P("dp", "sp", None),
+                check_vma=False)
             fn = jax.jit(sm)
             self._step_cache[key] = fn
         return fn

@@ -1,0 +1,70 @@
+"""heat_tpu.serve — batched, backpressured inference serving.
+
+The request path between user traffic and the sharded models: a
+:class:`ServingExecutor` coalesces per-request arrays into micro-batches,
+pads them onto a finite shape-bucket ladder (:mod:`~heat_tpu.serve.bucketing`),
+runs one compiled sharded program per batch from a counter-instrumented
+:class:`ProgramCache`, and scatters results to per-request futures — with
+bounded admission (:class:`ServeOverloaded`), per-request deadlines
+(:class:`ServeDeadlineExceeded`), a drain/close lifecycle and a degraded
+single-request fallback. ``heat_tpu.serve.metrics.runtime_stats`` (exported
+as ``ht.runtime_stats()``) is the process's one observability surface.
+
+>>> import heat_tpu as ht
+>>> from heat_tpu.serve import serve_estimator
+>>> est = ht.cluster.KMeans(n_clusters=8).fit(x)
+>>> ex = serve_estimator(est)
+>>> ex.warmup(feat_shape=(64,), rows=range(1, 17))
+>>> labels = ex.predict(batch_rows)          # or ex.submit(...) -> Future
+>>> ex.stats()["latency_ms"]["p99"]
+
+Model adapters (transformer forward, sklearn-layer estimators) live in
+:mod:`heat_tpu.serve.adapters`; they are imported lazily so ``import
+heat_tpu`` does not pay for the model stacks.
+"""
+
+from . import bucketing
+from . import errors
+from . import metrics
+from .bucketing import FixedBuckets, Pow2Buckets
+from .errors import (ServeClosed, ServeDeadlineExceeded, ServeError,
+                     ServeOverloaded)
+from .executor import ServeConfig, ServingExecutor, live_executors
+from .metrics import ServeMetrics, runtime_stats
+from .program_cache import ProgramCache
+
+__all__ = [
+    "ServingExecutor",
+    "ServeConfig",
+    "ProgramCache",
+    "ServeMetrics",
+    "Pow2Buckets",
+    "FixedBuckets",
+    "ServeError",
+    "ServeOverloaded",
+    "ServeDeadlineExceeded",
+    "ServeClosed",
+    "runtime_stats",
+    "live_executors",
+    # lazy (module __getattr__): adapters and its helpers
+    "adapters",
+    "serve_transformer",
+    "serve_estimator",
+    "transformer_logits_fn",
+    "estimator_predict_fn",
+]
+
+_LAZY_ADAPTERS = ("serve_transformer", "serve_estimator",
+                  "transformer_logits_fn", "estimator_predict_fn")
+
+
+def __getattr__(name):
+    # adapters pull in nn/cluster/classification — loaded on first use only
+    # (importlib, not ``from . import``: the latter re-enters this
+    # __getattr__ through hasattr and recurses)
+    if name == "adapters" or name in _LAZY_ADAPTERS:
+        import importlib
+
+        adapters = importlib.import_module(".adapters", __name__)
+        return adapters if name == "adapters" else getattr(adapters, name)
+    raise AttributeError(f"module 'heat_tpu.serve' has no attribute {name!r}")

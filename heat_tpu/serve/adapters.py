@@ -1,0 +1,187 @@
+"""Model-family adapters: wrap the repo's models as executor callables.
+
+An executor callable is ``(bucket_rows, *feat) -> rows-leading output`` —
+row-independent, shape-stable per bucket, parameters captured by closure
+(closed-over ``jax.Array`` leaves become jaxpr constants handed to the
+executable as buffers, not baked into HLO). Two families are wired:
+
+* the transformer LM (:func:`transformer_logits_fn` /
+  :func:`serve_transformer`) — the full sharded forward
+  (``TransformerLM.logits_fn``) with the batch axis over ``dp``;
+* the sklearn-layer estimators (:func:`estimator_predict_fn` /
+  :func:`serve_estimator`) — ``KMeans.predict``-style nearest-centroid
+  assignment and ``KNeighborsClassifier.predict`` voting, re-expressed as
+  one ``shard_map`` program over the serving mesh (training data
+  replicated once at adapter build, request rows sharded).
+
+The ``serve_*`` helpers return ready-to-go executors whose bucket policy
+respects the mesh divisibility constraint (bucket % mesh size == 0) and
+whose program-cache token is the mesh identity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core._compat import shard_map
+from ..core.communication import sanitize_comm
+from .bucketing import Pow2Buckets
+from .executor import ServeConfig, ServingExecutor
+
+__all__ = [
+    "transformer_logits_fn",
+    "serve_transformer",
+    "estimator_predict_fn",
+    "serve_estimator",
+]
+
+
+# ---------------------------------------------------------------------- #
+# transformer LM                                                         #
+# ---------------------------------------------------------------------- #
+def transformer_logits_fn(model, params) -> Callable:
+    """``(B, S) int32 tokens -> (B, S, vocab) f32 logits`` closure over a
+    :class:`~heat_tpu.nn.transformer.TransformerLM` and its params.
+
+    Uses the model's compiled sharded forward (``logits_fn``): batch over
+    ``dp``, sequence over ``sp``, heads/features over ``tp`` — so the
+    bucket's batch rows must divide by ``dp`` (and ``S`` by ``sp``), which
+    :func:`serve_transformer`'s bucket policy guarantees.
+    """
+    fwd = model.logits_fn()
+
+    def fn(toks):
+        return fwd(params, toks)
+
+    return fn
+
+
+def serve_transformer(model, params, seq_len: int,
+                      config: Optional[ServeConfig] = None,
+                      **kwargs) -> ServingExecutor:
+    """A configured executor serving ``model``'s forward at ``seq_len``.
+
+    Requests are ``(rows, seq_len)`` int32 token arrays. The default
+    bucket policy is powers of two with a floor of ``dp`` (so every
+    padded batch divides over the data-parallel axis); pp must be 1 for
+    the non-pipelined forward latency path to make sense, but any
+    dp x tp grid serves.
+    """
+    c = model.cfg
+    if seq_len % max(1, model.sp):
+        raise ValueError(
+            f"seq_len ({seq_len}) must divide over sp ({model.sp})")
+    if config is None:
+        # the forward runs the model's microbatch schedule, so every
+        # bucket's per-device batch (bucket / dp) must divide n_micro too
+        q = model.dp * max(1, c.n_micro)
+        config = ServeConfig(bucket_rows=Pow2Buckets(min_rows=q,
+                                                     multiple_of=q))
+    token = ("transformer", c.vocab, c.d_model, c.n_layers, seq_len,
+             tuple(model.grid.mesh.shape.items()),
+             tuple(d.id for d in model.grid.mesh.devices.flatten()))
+    ex = ServingExecutor(
+        transformer_logits_fn(model, params), config,
+        name="transformer", cache_token=token, **kwargs)
+    return ex
+
+
+# ---------------------------------------------------------------------- #
+# sklearn-layer estimators                                               #
+# ---------------------------------------------------------------------- #
+def _centroid_assign_fn(centroids, comm) -> Callable:
+    """Nearest-centroid labels (the ``_KCluster.predict`` semantics) as one
+    sharded program: request rows over the mesh, centroids replicated; the
+    x^2 term is label-invariant and dropped (same trick as
+    ``cluster/kmeans.py::_assign_fn``)."""
+    c = jnp.asarray(centroids)
+    c2 = jnp.sum(c.astype(jnp.float32) * c.astype(jnp.float32), axis=1)[None, :]
+
+    def local(x):
+        xc = jax.lax.dot_general(
+            x, c.astype(x.dtype),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return jnp.argmin(c2 - 2.0 * xc, axis=1)
+
+    if comm.size <= 1:
+        return local
+    return shard_map(local, mesh=comm.mesh, in_specs=comm.spec(2, 0),
+                     out_specs=comm.spec(1, 0), check_vma=False)
+
+
+def _knn_vote_fn(train_x, train_y, k: int, comm) -> Callable:
+    """``KNeighborsClassifier.predict`` semantics as one sharded program:
+    request rows over the mesh, the (replicated) training set visited once
+    per row via a top-k over the distance tile, then the reference's
+    majority vote with smallest-label tie-break."""
+    from ..classification.kneighborsclassifier import _vote
+
+    xt = jnp.asarray(train_x)
+    yt = jnp.asarray(train_y).reshape(-1)
+    t2 = jnp.sum(xt.astype(jnp.float32) * xt.astype(jnp.float32),
+                 axis=1)[None, :]
+
+    def local(x):
+        xc = jax.lax.dot_general(
+            x, xt.astype(x.dtype),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        _, idx = jax.lax.top_k(-(t2 - 2.0 * xc), k)
+        return _vote(yt[idx], k)
+
+    if comm.size <= 1:
+        return local
+    return shard_map(local, mesh=comm.mesh, in_specs=comm.spec(2, 0),
+                     out_specs=comm.spec(1, 0), check_vma=False)
+
+
+def estimator_predict_fn(estimator, comm=None) -> Callable:
+    """``(rows, d) -> (rows,) labels`` closure over a FITTED estimator.
+
+    Supports the cluster family (anything exposing ``cluster_centers_``:
+    KMeans/KMedians/KMedoids) and :class:`KNeighborsClassifier`. Training
+    state is replicated onto the serving mesh ONCE here — request handling
+    never re-moves it.
+    """
+    comm = sanitize_comm(comm)
+    if hasattr(estimator, "cluster_centers_"):
+        centers = estimator.cluster_centers_
+        if centers is None:
+            raise ValueError("estimator is not fitted (no cluster centers)")
+        return _centroid_assign_fn(centers.resplit(None)._logical(), comm)
+    if (getattr(estimator, "x", None) is not None
+            and hasattr(estimator, "n_neighbors")):
+        xt = estimator.x.resplit(None)._logical()
+        yt = estimator.y.resplit(None)._logical()
+        return _knn_vote_fn(xt, yt, int(estimator.n_neighbors), comm)
+    raise TypeError(
+        f"no serving adapter for {type(estimator).__name__}: expected a "
+        "fitted cluster estimator (cluster_centers_) or "
+        "KNeighborsClassifier")
+
+
+def serve_estimator(estimator, comm=None,
+                    config: Optional[ServeConfig] = None,
+                    **kwargs) -> ServingExecutor:
+    """A configured executor serving ``estimator.predict`` row batches.
+
+    Requests are ``(rows, n_features)`` arrays; results are ``(rows,)``
+    label arrays, bitwise-identical to the estimator's own ``predict``
+    labels for the same rows (asserted in ``tests/test_serve.py``).
+    """
+    comm = sanitize_comm(comm)
+    if config is None:
+        config = ServeConfig(
+            bucket_rows=Pow2Buckets(min_rows=comm.size,
+                                    multiple_of=comm.size))
+    ex = ServingExecutor(
+        estimator_predict_fn(estimator, comm), config,
+        name=type(estimator).__name__.lower(),
+        cache_token=("estimator",) + tuple(comm.cache_key), **kwargs)
+    return ex

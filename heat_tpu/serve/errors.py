@@ -1,0 +1,38 @@
+"""Typed serving errors — the executor's robustness contract.
+
+Every failure mode a caller can act on has its own type, so admission
+control (`except ServeOverloaded: retry elsewhere`), deadline handling and
+shutdown races are distinguishable without string matching. All inherit
+:class:`ServeError`; :class:`ServeDeadlineExceeded` is also a
+``TimeoutError`` so generic timeout handlers catch it.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "ServeError",
+    "ServeOverloaded",
+    "ServeDeadlineExceeded",
+    "ServeClosed",
+]
+
+
+class ServeError(RuntimeError):
+    """Base class for serving-path errors."""
+
+
+class ServeOverloaded(ServeError):
+    """The bounded request queue is full — the request was load-shed at
+    admission (backpressure). The caller should retry with backoff or route
+    to another replica; the executor did NOT enqueue anything."""
+
+
+class ServeDeadlineExceeded(ServeError, TimeoutError):
+    """The request's deadline expired while it was still queued — it was
+    dropped without running (no compute is wasted on an answer nobody is
+    waiting for)."""
+
+
+class ServeClosed(ServeError):
+    """The executor is closed (or closing): no new requests are accepted,
+    and — on a non-draining close — pending requests fail with this."""

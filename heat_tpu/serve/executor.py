@@ -1,0 +1,412 @@
+"""The batched, backpressured serving executor.
+
+The request path the ROADMAP's "serves heavy traffic" north star needs and
+the reference framework never had: callers submit per-request host arrays
+from any thread; a single background worker coalesces them into
+micro-batches, pads each batch onto a shape bucket, runs ONE compiled
+sharded program from the :class:`~heat_tpu.serve.program_cache.ProgramCache`,
+and scatters the result rows back onto per-request futures.
+
+Design points, in the order they matter in production:
+
+* **Bounded admission.** ``submit`` never blocks and never queues beyond
+  ``queue_limit`` — an overloaded executor sheds at the door with a typed
+  :class:`~heat_tpu.serve.errors.ServeOverloaded` instead of growing an
+  unbounded backlog (queueing theory: past saturation, queue growth only
+  adds latency, never throughput).
+* **Micro-batching.** The worker takes the oldest request, then coalesces
+  up to ``max_batch`` compatible requests (same trailing shape + dtype),
+  waiting at most ``max_wait_ms`` for stragglers. Rows concatenate along
+  axis 0 and zero-pad to the bucket, so every mix of request sizes maps
+  onto the same finite set of compiled programs.
+* **One dispatch thread.** Only the worker thread touches the device —
+  concurrent dispatch is where the XLA:CPU in-process rendezvous deadlocks
+  (see ``heat_tpu/__init__.py``), and on TPU it serializes anyway.
+* **Deadlines.** A request whose deadline expires while queued is dropped
+  without running (:class:`ServeDeadlineExceeded`); compute is never spent
+  on an answer nobody is waiting for.
+* **Degraded single-request fallback.** With ``batching=False``, or when a
+  batch's bucket would exceed ``max_bucket_bytes``, requests run one at a
+  time (over-cap singles run at their exact shape — trading the bucket
+  ladder's compile reuse for bounded memory).
+* **Lifecycle.** ``close(drain=True)`` stops admission and answers what is
+  already queued; ``close(drain=False)`` fails pending requests with
+  :class:`ServeClosed`. The executor is a context manager.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+from .bucketing import Pow2Buckets, bucket_nbytes
+from .errors import ServeClosed, ServeDeadlineExceeded, ServeOverloaded
+from .metrics import DEFAULT as _DEFAULT_METRICS, ServeMetrics
+from .program_cache import ProgramCache
+
+__all__ = ["ServeConfig", "ServingExecutor", "live_executors"]
+
+# live executors (weak): runtime_stats() folds their queue depth and
+# program-cache counters into the one observability snapshot
+_EXECUTORS: "weakref.WeakSet[ServingExecutor]" = weakref.WeakSet()
+
+
+def live_executors():
+    return list(_EXECUTORS)
+
+
+@dataclass
+class ServeConfig:
+    """Executor policy knobs (all host-side; none affect results)."""
+
+    max_batch: int = 16                 # max requests coalesced per program run
+    max_wait_ms: float = 2.0            # straggler wait once a batch has begun
+    queue_limit: int = 128              # admission bound -> ServeOverloaded
+    default_deadline_ms: Optional[float] = None  # per-request override wins
+    batching: bool = True               # False -> degraded single-request path
+    min_rows: int = 1                   # bucket floor (mesh divisibility)
+    bucket_rows: Optional[Callable[[int], int]] = None  # rows -> bucket rows
+    max_bucket_bytes: Optional[int] = None  # memory cap -> single-request path
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.queue_limit < 1:
+            raise ValueError(
+                f"queue_limit must be >= 1, got {self.queue_limit}")
+        if self.bucket_rows is None:
+            self.bucket_rows = Pow2Buckets(min_rows=self.min_rows)
+
+
+class _Request:
+    __slots__ = ("x", "rows", "group", "enq_t", "deadline_t", "future")
+
+    def __init__(self, x: np.ndarray, deadline_t: Optional[float]):
+        self.x = x
+        self.rows = x.shape[0]
+        self.group = (x.shape[1:], x.dtype.str)
+        self.enq_t = time.monotonic()
+        self.deadline_t = deadline_t
+        self.future = Future()
+
+
+class ServingExecutor:
+    """Micro-batching inference front end for one model callable.
+
+    Parameters
+    ----------
+    model_fn : callable
+        ``batch -> result``: takes one ``(bucket_rows, *feat)`` array and
+        returns an array (or pytree of arrays) whose leaves all carry the
+        batch dimension first. Must be shape-polymorphic only across the
+        bucket ladder (it is traced/compiled once per bucket) and
+        row-independent — row ``i`` of the output must depend only on row
+        ``i`` of the input, which is what makes scatter-back exact.
+        Adapters for the transformer LM and the sklearn-layer estimators
+        live in :mod:`heat_tpu.serve.adapters`.
+    config : ServeConfig, optional
+    cache_token : hashable, optional
+        Extra program-cache key material — pass the communicator/mesh
+        ``cache_key`` so one callable served over two meshes cannot alias
+        compiled programs.
+    metrics : ServeMetrics, optional
+        Defaults to the process-wide shared registry
+        (:data:`heat_tpu.serve.metrics.DEFAULT`).
+    program_cache : ProgramCache, optional
+        Defaults to a private cache; pass a shared one to pool programs
+        across executors of the same model family.
+
+    Always ``close()`` an executor you are done with (or use it as a
+    context manager): the worker thread holds a reference to the
+    executor, so an abandoned one is never garbage-collected.
+    """
+
+    def __init__(self, model_fn: Callable, config: Optional[ServeConfig] = None,
+                 *, name: str = "serve", cache_token: Any = (),
+                 metrics: Optional[ServeMetrics] = None,
+                 program_cache: Optional[ProgramCache] = None):
+        self.model_fn = model_fn
+        self.config = config if config is not None else ServeConfig()
+        self.name = name
+        self.cache_token = cache_token
+        self.metrics = metrics if metrics is not None else _DEFAULT_METRICS
+        self.program_cache = (program_cache if program_cache is not None
+                              else ProgramCache(name=name))
+        self._q: list = []
+        self._cv = threading.Condition()
+        self._closed = False
+        self._draining = False
+        self._paused = False
+        self._inflight = 0
+        self._worker = threading.Thread(
+            target=self._run, name=f"heat-serve-{name}", daemon=True)
+        self._worker.start()
+        _EXECUTORS.add(self)
+
+    # ------------------------------------------------------------------ #
+    # submission                                                         #
+    # ------------------------------------------------------------------ #
+    def submit(self, x, deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue one request; returns a ``concurrent.futures.Future``.
+
+        ``x``: ``(rows, *feat)`` host or device array — axis 0 is the
+        batchable row axis (a single example is ``rows=1``). The future
+        resolves to the model output rows for exactly this request, as
+        host (numpy) arrays — the batch output is fetched once and sliced
+        zero-copy — or raises one of the typed serve errors.
+        """
+        x = np.asarray(x)
+        if x.ndim < 1 or x.shape[0] < 1:
+            raise ValueError(
+                f"request must have a leading row axis of >= 1, got shape "
+                f"{x.shape}")
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        deadline_t = (None if deadline_ms is None
+                      else time.monotonic() + deadline_ms / 1e3)
+        req = _Request(x, deadline_t)
+        with self._cv:
+            if self._closed:
+                raise ServeClosed(f"executor {self.name!r} is closed")
+            if len(self._q) >= self.config.queue_limit:
+                self.metrics.record_shed()
+                raise ServeOverloaded(
+                    f"executor {self.name!r} queue is full "
+                    f"({self.config.queue_limit} pending)")
+            self._q.append(req)
+            self._cv.notify_all()
+        return req.future
+
+    def predict(self, x, deadline_ms: Optional[float] = None,
+                timeout: Optional[float] = None):
+        """Synchronous convenience: ``submit(...).result(timeout)``."""
+        return self.submit(x, deadline_ms=deadline_ms).result(timeout)
+
+    def warmup(self, feat_shape: Sequence[int], dtype=np.float32,
+               rows: Optional[Sequence[int]] = None) -> dict:
+        """Pre-compile the bucket ladder so traffic never pays a compile.
+
+        Submits one zeros request per distinct bucket (sequentially, so
+        requests cannot coalesce across buckets) and waits for each.
+        Returns the program-cache stats afterwards — steady-state traffic
+        over the same ladder must add zero misses from here on.
+        """
+        if rows is None:
+            policy = self.config.bucket_rows
+            ladder = getattr(policy, "ladder", None)
+            rows = (ladder(self.config.max_batch * max(
+                1, self.config.min_rows)) if ladder is not None
+                else [self.config.max_batch])
+        feat_shape = tuple(int(s) for s in feat_shape)
+        seen = set()
+        for r in rows:
+            b = self.config.bucket_rows(int(r))
+            if b in seen:
+                continue
+            seen.add(b)
+            self.submit(np.zeros((b,) + feat_shape, dtype)).result()
+        return self.program_cache.stats()
+
+    # ------------------------------------------------------------------ #
+    # lifecycle / introspection                                          #
+    # ------------------------------------------------------------------ #
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._q) + self._inflight
+
+    def pause(self) -> None:
+        """Hold the worker before its next batch (testing/ops hook — lets
+        backpressure be exercised deterministically)."""
+        with self._cv:
+            self._paused = True
+            self._cv.notify_all()
+
+    def resume(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    def flush(self, timeout: Optional[float] = None) -> bool:
+        """Block until everything queued at call time has been answered."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._q or self._inflight:
+                rem = (None if deadline is None
+                       else deadline - time.monotonic())
+                if rem is not None and rem <= 0:
+                    return False
+                self._cv.wait(rem if rem is not None else 0.1)
+        return True
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Stop admission; then drain (answer pending) or abort (fail
+        pending with :class:`ServeClosed`). Idempotent."""
+        with self._cv:
+            self._closed = True
+            self._draining = drain
+            if not drain:
+                for req in self._q:
+                    req.future.set_exception(
+                        ServeClosed(f"executor {self.name!r} closed "
+                                    "without drain"))
+                self._q.clear()
+            self._paused = False  # a paused executor must still shut down
+            self._cv.notify_all()
+        self._worker.join(timeout)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def stats(self) -> dict:
+        """This executor's metrics snapshot + queue depth + cache stats."""
+        return self.metrics.snapshot(
+            queue_depth=self.queue_depth,
+            program_cache=self.program_cache.stats())
+
+    def __enter__(self) -> "ServingExecutor":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(drain=exc_type is None)
+
+    # ------------------------------------------------------------------ #
+    # worker                                                             #
+    # ------------------------------------------------------------------ #
+    def _run(self) -> None:
+        cfg = self.config
+        while True:
+            with self._cv:
+                # every state change (submit/pause/resume/close) notifies;
+                # the long timeout is only a lost-wakeup safety net, and
+                # keeps an abandoned (never-closed) executor nearly idle
+                while not self._closed and (not self._q or self._paused):
+                    self._cv.wait(1.0)
+                if self._closed and not (self._draining and self._q):
+                    # non-draining close already failed + cleared the queue
+                    return
+                first = self._q.pop(0)
+                batch = [first]
+                if cfg.batching and cfg.max_batch > 1:
+                    t_end = time.monotonic() + cfg.max_wait_ms / 1e3
+                    while len(batch) < cfg.max_batch:
+                        batch.extend(self._take_matching(
+                            first.group, cfg.max_batch - len(batch)))
+                        if len(batch) >= cfg.max_batch:
+                            break
+                        rem = t_end - time.monotonic()
+                        if rem <= 0 or self._closed:
+                            break
+                        self._cv.wait(rem)
+                    # arrivals during the final wait
+                    batch.extend(self._take_matching(
+                        first.group, cfg.max_batch - len(batch)))
+                self._inflight = len(batch)
+            try:
+                self._process(batch)
+            finally:
+                with self._cv:
+                    self._inflight = 0
+                    self._cv.notify_all()
+
+    def _take_matching(self, group, limit: int) -> list:
+        """Pop up to ``limit`` queued requests of ``group`` (lock held).
+        Non-matching requests keep their place — no head-of-line blocking
+        across shape groups."""
+        if limit <= 0:
+            return []
+        taken, keep = [], []
+        for req in self._q:
+            if len(taken) < limit and req.group == group:
+                taken.append(req)
+            else:
+                keep.append(req)
+        self._q[:] = keep
+        return taken
+
+    def _expire(self, batch: list) -> list:
+        """Drop queued-past-deadline requests; returns the live remainder."""
+        now = time.monotonic()
+        live = []
+        for req in batch:
+            if req.deadline_t is not None and now > req.deadline_t:
+                self.metrics.record_deadline_expired()
+                req.future.set_exception(ServeDeadlineExceeded(
+                    f"request expired after "
+                    f"{(now - req.enq_t) * 1e3:.1f} ms in queue"))
+            else:
+                live.append(req)
+        return live
+
+    def _process(self, batch: list) -> None:
+        cfg = self.config
+        batch = self._expire(batch)
+        if not batch:
+            return
+        rows = sum(r.rows for r in batch)
+        bucket = cfg.bucket_rows(rows)
+        feat, _ = batch[0].group
+        dtype = batch[0].x.dtype
+        if (cfg.max_bucket_bytes is not None and len(batch) > 1
+                and bucket_nbytes(bucket, feat, dtype)
+                > cfg.max_bucket_bytes):
+            # degraded path: the coalesced bucket would blow the memory
+            # cap — answer one request at a time instead
+            for req in batch:
+                self._process([req])
+            return
+        if (cfg.max_bucket_bytes is not None and len(batch) == 1
+                and bucket_nbytes(bucket, feat, dtype)
+                > cfg.max_bucket_bytes):
+            # a single over-cap request runs at (nearly) its exact shape:
+            # bounded memory at the price of bucket-ladder compile reuse.
+            # Sharded programs still need the batch axis to divide the
+            # mesh, so round up to the policy's divisibility quantum.
+            policy = cfg.bucket_rows
+            quantum = max(int(getattr(policy, "multiple_of", 1)), 1)
+            floor = max(int(getattr(policy, "min_rows", 1)), 1)
+            bucket = max(-(-rows // quantum) * quantum, floor)
+            self.metrics.record_fallback_single()
+        try:
+            payload = np.zeros((bucket,) + feat, dtype)
+            off = 0
+            for req in batch:
+                payload[off:off + req.rows] = req.x
+                off += req.rows
+            prog = self.program_cache.get(
+                self.model_fn, (bucket,) + feat, dtype, self.cache_token)
+            out = prog(payload)
+            # ONE device->host fetch per batch; per-request results are
+            # then zero-copy row views. Slicing the sharded device output
+            # per request instead would dispatch a device program per
+            # slice — more dispatches than the unbatched path it replaces.
+            out = jax.tree.map(np.asarray, jax.block_until_ready(out))
+        except Exception as exc:
+            self.metrics.record_error()
+            for req in batch:
+                req.future.set_exception(exc)
+            return
+        self.metrics.record_batch(len(batch), rows, bucket)
+        done_t = time.monotonic()
+        off = 0
+        # slices are COPIES when the request is smaller than the bucket: a
+        # zero-copy view would pin the whole batch output alive for as
+        # long as any client keeps its (possibly 1-row) result
+        whole = len(batch) == 1 and batch[0].rows == bucket
+        for req in batch:
+            sl = slice(off, off + req.rows)
+            res = jax.tree.map(
+                lambda a, s=sl: a[s] if whole else a[s].copy(), out)
+            off += req.rows
+            self.metrics.record_request(done_t - req.enq_t)
+            req.future.set_result(res)

@@ -1,0 +1,167 @@
+"""Serving metrics: latency percentiles, queue depth, batch occupancy,
+shed/deadline counts, cache stats — one plain-dict snapshot.
+
+The registry every :class:`~heat_tpu.serve.executor.ServingExecutor`
+reports into (executors share :data:`DEFAULT` unless given their own), and
+the home of :func:`runtime_stats` — the repo's single observability
+surface, exported as ``ht.runtime_stats()``. One call folds together:
+
+* this module's serving figures (latency p50/p95/p99, queue depth, batch
+  occupancy, shed count, program-cache stats),
+* the resharding plan cache (:func:`heat_tpu.core.resharding.plan_cache_stats`
+  rides through unchanged under the ``"resharding"`` key),
+* the op-engine alignment counter (``op_engine.align_resplits``) and every
+  other process-wide counter from :mod:`heat_tpu.utils.metrics`.
+
+Everything is host-side python state — snapshotting never touches the
+device, so it is safe from any thread at any rate.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+__all__ = ["ServeMetrics", "DEFAULT", "runtime_stats"]
+
+_WINDOW = 4096  # latency observations kept for the percentile window
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile of an ascending list (q in [0, 1])."""
+    n = len(sorted_vals)
+    idx = min(n - 1, max(0, int(round(q * (n - 1)))))
+    return sorted_vals[idx]
+
+
+class ServeMetrics:
+    """Thread-safe serving metrics registry."""
+
+    COUNTERS = ("requests", "batches", "rows", "padded_rows", "shed",
+                "deadline_expired", "fallback_single", "errors")
+
+    def __init__(self, name: str = "serve", window: int = _WINDOW):
+        self.name = name
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {k: 0 for k in self.COUNTERS}
+        self._latencies = deque(maxlen=window)   # seconds, completed requests
+        self._occupancy = deque(maxlen=512)      # rows / bucket per batch
+
+    # ------------------------------------------------------------------ #
+    # recording (called by executors)                                    #
+    # ------------------------------------------------------------------ #
+    def _inc(self, key: str, value: int = 1) -> None:
+        from ..utils import metrics as _pm
+
+        with self._lock:
+            self._counters[key] += value
+        _pm.inc(f"{self.name}.{key}", value)
+
+    def record_request(self, latency_s: float) -> None:
+        with self._lock:
+            self._counters["requests"] += 1
+            self._latencies.append(float(latency_s))
+        from ..utils import metrics as _pm
+
+        _pm.inc(f"{self.name}.requests")
+
+    def record_batch(self, n_requests: int, rows: int, bucket: int) -> None:
+        with self._lock:
+            self._counters["batches"] += 1
+            self._counters["rows"] += int(rows)
+            self._counters["padded_rows"] += int(bucket) - int(rows)
+            if bucket > 0:
+                self._occupancy.append(rows / bucket)
+        from ..utils import metrics as _pm
+
+        _pm.inc(f"{self.name}.batches")
+        _pm.inc(f"{self.name}.rows", int(rows))
+        _pm.inc(f"{self.name}.padded_rows", int(bucket) - int(rows))
+
+    def record_shed(self) -> None:
+        self._inc("shed")
+
+    def record_deadline_expired(self) -> None:
+        self._inc("deadline_expired")
+
+    def record_fallback_single(self) -> None:
+        self._inc("fallback_single")
+
+    def record_error(self) -> None:
+        self._inc("errors")
+
+    # ------------------------------------------------------------------ #
+    # snapshot                                                           #
+    # ------------------------------------------------------------------ #
+    def snapshot(self, **gauges) -> dict:
+        """Plain-dict snapshot; extra keyword gauges (e.g. ``queue_depth``)
+        are merged in verbatim."""
+        with self._lock:
+            counters = dict(self._counters)
+            lats = sorted(self._latencies)
+            occ = list(self._occupancy)
+        out = dict(counters)
+        if lats:
+            out["latency_ms"] = {
+                "count": len(lats),
+                "mean": 1e3 * sum(lats) / len(lats),
+                "p50": 1e3 * _percentile(lats, 0.50),
+                "p95": 1e3 * _percentile(lats, 0.95),
+                "p99": 1e3 * _percentile(lats, 0.99),
+                "max": 1e3 * lats[-1],
+            }
+        else:
+            out["latency_ms"] = {"count": 0}
+        out["batch_occupancy"] = (
+            {"count": len(occ), "mean": sum(occ) / len(occ),
+             "last": occ[-1]} if occ else {"count": 0})
+        out.update(gauges)
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            for k in self._counters:
+                self._counters[k] = 0
+            self._latencies.clear()
+            self._occupancy.clear()
+
+
+#: the registry executors share by default — what ``ht.runtime_stats()`` reads
+DEFAULT = ServeMetrics()
+
+
+def runtime_stats() -> dict:
+    """One observability snapshot for the whole process.
+
+    ``ht.runtime_stats()["resharding"]`` is exactly
+    :func:`heat_tpu.core.resharding.plan_cache_stats` (aliased through, not
+    copied-and-drifted); ``"serve"`` aggregates every live executor's queue
+    depth and program cache on top of the shared metrics registry;
+    ``"counters"`` is the full process-wide counter map (includes
+    ``op_engine.align_resplits``, ``resharding.plan_hits`` / ``_misses``,
+    ``serve.*``).
+    """
+    from ..core import resharding
+    from ..utils import metrics as _pm
+
+    from . import executor as _executor
+
+    depth = 0
+    cache_stats = {"hits": 0, "misses": 0, "compiles": 0, "entries": 0}
+    n_exec = 0
+    for ex in _executor.live_executors():
+        n_exec += 1
+        depth += ex.queue_depth
+        for k, v in ex.program_cache.stats().items():
+            cache_stats[k] += v
+    counters = _pm.counters()
+    return {
+        "serve": DEFAULT.snapshot(
+            queue_depth=depth, executors=n_exec, program_cache=cache_stats),
+        "resharding": resharding.plan_cache_stats(),
+        "op_engine": {
+            "align_resplits": int(counters.get("op_engine.align_resplits", 0))
+        },
+        "counters": counters,
+    }

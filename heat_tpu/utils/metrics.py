@@ -28,7 +28,8 @@ import time
 from contextlib import contextmanager
 from typing import Any, Dict
 
-__all__ = ["Metrics", "inc", "gauge", "observe", "timer", "to_dict", "dump", "reset"]
+__all__ = ["Metrics", "inc", "gauge", "observe", "timer", "to_dict",
+           "counters", "dump", "reset"]
 
 
 def _jsonable(v):
@@ -86,6 +87,11 @@ class Metrics:
         with Timer(name) as t:
             yield t
         self.observe(name, t.seconds)
+
+    def counters(self) -> Dict[str, float]:
+        """Copy of the counter section only — cheap (no device fetch), so
+        hot paths (serving snapshots, per-test CI hooks) can poll it."""
+        return dict(self._counters)
 
     def to_dict(self) -> Dict[str, Any]:
         """Sectioned snapshot with per-series summary statistics."""
@@ -168,6 +174,10 @@ def timer(name: str):
 
 def to_dict() -> Dict[str, Any]:
     return _default.to_dict()
+
+
+def counters() -> Dict[str, float]:
+    return _default.counters()
 
 
 def dump(path: str, reset_series: bool = True, **extra) -> Dict[str, Any]:

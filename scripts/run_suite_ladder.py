@@ -42,6 +42,17 @@ def _env(n: int) -> dict:
 
 def run_suite(n: int, timeout: float) -> dict:
     t0 = time.time()
+    # per-test executable/counter log (conftest appends one JSON line per
+    # test): on the rare 4-device SIGABRT (NEXT.md §2b) the last line names
+    # the accumulated jit-executable count right before the abort, so the
+    # flakiness can be correlated with cache growth
+    stats_path = os.path.join(_REPO, f".ladder_teststats_{n}.jsonl")
+    try:
+        os.unlink(stats_path)
+    except OSError:
+        pass
+    env = _env(n)
+    env["HEAT_TPU_LADDER_STATS"] = stats_path
     try:
         # -X faulthandler: the rare 4-device XLA:CPU SIGABRT (NEXT.md §2b)
         # kills the interpreter below pytest — only a faulthandler dump on
@@ -49,7 +60,7 @@ def run_suite(n: int, timeout: float) -> dict:
         out = subprocess.run(
             [sys.executable, "-X", "faulthandler", "-m", "pytest", "tests/",
              "-x", "-q", "-rs"],
-            env=_env(n), capture_output=True, text=True, timeout=timeout,
+            env=env, capture_output=True, text=True, timeout=timeout,
             cwd=_REPO)
     except subprocess.TimeoutExpired:
         return {"devices": n, "error": f"suite exceeded {timeout:.0f}s"}
@@ -85,6 +96,23 @@ def run_suite(n: int, timeout: float) -> dict:
         rec["abort_traceback"] = stderr.strip().splitlines()[-120:]
         print("\n".join(rec["abort_traceback"][-40:]), file=sys.stderr,
               flush=True)
+    # the last per-test counter line = state right before exit/abort
+    # (NEXT.md §2b: correlate the SIGABRT with executable-cache growth)
+    try:
+        with open(stats_path) as f:
+            lines = [l for l in f.read().splitlines() if l.strip()]
+        if lines:
+            rec["executable_counters"] = json.loads(lines[-1])
+            rec["executable_counters"]["tests_logged"] = len(lines)
+    except OSError:
+        pass
+    except Exception as exc:
+        rec["executable_counters"] = {"error": repr(exc)}
+    finally:
+        try:
+            os.unlink(stats_path)
+        except OSError:
+            pass
     return rec
 
 
@@ -132,6 +160,11 @@ def main():
     ap.add_argument("--examples-timeout", type=float, default=600.0)
     ap.add_argument("--no-resplit-audit", action="store_true",
                     help="skip the collective_audit --resplit bounds check")
+    ap.add_argument("--serve-smoke", dest="serve_smoke", action="store_true",
+                    default=True, help="run the serving smoke (default on)")
+    ap.add_argument("--no-serve-smoke", dest="serve_smoke",
+                    action="store_false",
+                    help="skip the serving executor smoke step")
     args = ap.parse_args()
 
     ladder = []
@@ -161,6 +194,31 @@ def main():
         for r in ex:
             print(json.dumps(r), flush=True)
         artifact["examples"] = ex
+
+    serve_bad = False
+    if args.serve_smoke and not args.examples_only:
+        # serving smoke: executor up -> 50 mixed-shape requests -> metrics
+        # snapshot sanity, on the 4-device CPU mesh (scripts/serve_smoke.py)
+        print("=== serve smoke (4 devices) ===", flush=True)
+        env = _env(4)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["PYTHONPATH"] = _REPO
+        try:
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(_REPO, "scripts", "serve_smoke.py")],
+                env=env, capture_output=True, text=True, timeout=600.0,
+                cwd=_REPO)
+            line = next((l for l in reversed(out.stdout.splitlines())
+                         if l.startswith("{")), None)
+            artifact["serve_smoke"] = (
+                json.loads(line) if line
+                else {"error": (out.stderr or "no output").strip()[-300:]})
+            serve_bad = out.returncode != 0
+        except subprocess.TimeoutExpired:
+            artifact["serve_smoke"] = {"error": "serve smoke exceeded 600s"}
+            serve_bad = True
+        print(json.dumps({"serve_smoke_ok": not serve_bad}), flush=True)
 
     audit_bad = False
     if not (args.no_resplit_audit or args.examples_only):
@@ -193,7 +251,7 @@ def main():
     print(f"wrote {args.out}")
     bad = ([r for r in ladder if r.get("rc") != 0]
            + [r for r in ex if r.get("rc") != 0])
-    sys.exit(1 if bad or audit_bad else 0)
+    sys.exit(1 if bad or audit_bad or serve_bad else 0)
 
 
 if __name__ == "__main__":

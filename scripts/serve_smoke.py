@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Serving smoke for the CI ladder: executor up → 50 requests → snapshot.
+
+Brings up a :class:`heat_tpu.serve.ServingExecutor` over the launch mesh
+(the ladder runs it at 4 virtual CPU devices), warms the bucket ladder,
+fires 50 mixed-shape requests from 4 client threads, and sanity-checks the
+metrics snapshot: everything answered, nothing shed, ZERO steady-state
+program-cache misses, latency percentiles present, and
+``ht.runtime_stats()`` carrying all three sections. Prints ONE JSON line;
+exit 1 on any violation (the ladder fails the round).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+        python scripts/serve_smoke.py
+"""
+
+import json
+import sys
+import threading
+
+import numpy as np
+
+
+def main() -> int:
+    import jax.numpy as jnp
+
+    import heat_tpu as ht
+    from heat_tpu.core._compat import shard_map
+    from heat_tpu.serve import (Pow2Buckets, ServeConfig, ServeMetrics,
+                                ServingExecutor)
+
+    comm = ht.get_comm()
+    d = 16
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((d, 8)).astype(np.float32))
+
+    def local(x):
+        return x @ w
+
+    fn = (local if comm.size == 1 else shard_map(
+        local, mesh=comm.mesh, in_specs=comm.spec(2, 0),
+        out_specs=comm.spec(2, 0), check_vma=False))
+    metrics = ServeMetrics()
+    ex = ServingExecutor(
+        fn, ServeConfig(max_batch=8, max_wait_ms=2.0, queue_limit=256,
+                        bucket_rows=Pow2Buckets(min_rows=comm.size,
+                                                multiple_of=comm.size)),
+        name="smoke", cache_token=comm.cache_key, metrics=metrics)
+    ex.warmup((d,), np.float32, rows=(1, 2, 5, 9, 17, 33, 65))
+    misses0 = ex.program_cache.stats()["misses"]
+    metrics.reset()  # percentiles describe traffic, not warmup compiles
+
+    mix = (1, 2, 3, 5, 8, 13, 16, 4, 7, 9)
+    reqs = [rng.standard_normal((r, d)).astype(np.float32)
+            for r in mix * 5]  # 50 requests
+    errors = []
+
+    def client(t):
+        try:
+            futs = [ex.submit(x) for x in reqs[t::4]]
+            for x, f in zip(reqs[t::4], futs):
+                out = np.asarray(f.result(120))
+                np.testing.assert_allclose(
+                    out, x @ np.asarray(w), rtol=1e-5, atol=1e-6)
+        except Exception as exc:
+            errors.append(repr(exc))
+
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(300)
+    ex.close()
+
+    snap = metrics.snapshot(program_cache=ex.program_cache.stats())
+    rt = ht.runtime_stats()
+    checks = {
+        "all_answered": snap["requests"] >= len(reqs),
+        "no_errors": not errors and snap["errors"] == 0,
+        "nothing_shed": snap["shed"] == 0,
+        "zero_steady_misses":
+            ex.program_cache.stats()["misses"] == misses0,
+        "latency_present": snap["latency_ms"].get("p99") is not None,
+        "runtime_stats_sections":
+            all(k in rt for k in ("serve", "resharding", "op_engine")),
+    }
+    record = {
+        "devices": comm.size,
+        "requests": snap["requests"],
+        "batches": snap["batches"],
+        "p50_ms": round(snap["latency_ms"].get("p50", -1), 2),
+        "p99_ms": round(snap["latency_ms"].get("p99", -1), 2),
+        "batch_occupancy": round(
+            snap["batch_occupancy"].get("mean", 0.0), 3),
+        "program_cache": ex.program_cache.stats(),
+        "checks": checks,
+        "errors": errors[:3],
+    }
+    print(json.dumps(record), flush=True)
+    return 0 if all(checks.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

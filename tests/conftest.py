@@ -46,6 +46,38 @@ if jax.default_backend() != "cpu":
 # (``-n auto --dist loadfile``; loadfile keeps each module's shared-rng
 # draw order intact) — this 1-core container runs the suite serially,
 # compile-dominated, in ~30 min.
+# Per-test executable/counter log for the ladder (NEXT.md §2b): when
+# HEAT_TPU_LADDER_STATS names a file, append one JSON line after every test
+# with the accumulated live-array count (the jit-executable growth proxy)
+# and the framework's compile counters. Written line-by-line with flush, so
+# on a SIGABRT the last line is the state right before the abort —
+# run_suite_ladder.py persists it next to abort_traceback.
+_LADDER_STATS = os.environ.get("HEAT_TPU_LADDER_STATS", "")
+
+
+def pytest_runtest_teardown(item, nextitem):
+    if not _LADDER_STATS:
+        return
+    try:
+        import json
+
+        from heat_tpu.utils import metrics as _metrics
+
+        c = _metrics.counters()
+        rec = {
+            "test": item.nodeid,
+            "live_arrays": len(jax.live_arrays()),
+            "plan_misses": int(c.get("resharding.plan_misses", 0)),
+            "serve_program_compiles": int(c.get("serve.program_compiles", 0)),
+            "align_resplits": int(c.get("op_engine.align_resplits", 0)),
+        }
+        with open(_LADDER_STATS, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+    except Exception:  # the log must never fail a test run
+        pass
+
+
 _cache_dir = os.environ.get("HEAT_TPU_JIT_CACHE", "")
 if _cache_dir:
     try:
