@@ -66,9 +66,14 @@ def main():
     ex.config.max_batch = args.max_batch
     ex.config.max_wait_ms = args.max_wait_ms
 
+    rows_mix = (1, 2, 3, 1, 2, 1)
     t0 = time.perf_counter()
-    # coalesced totals reach max_batch x 3 rows = 24: warm through bucket 32
-    ex.warmup((args.seq_len,), np.int32, rows=(1, 2, 3, 5, 9, 17))
+    # coalesced totals reach max_batch x max(rows_mix): warm every bucket
+    # the policy can produce up to that total (NOT a hardcoded row set —
+    # --max-batch changes the reachable ladder)
+    ex.warmup((args.seq_len,), np.int32,
+              rows=ex.config.bucket_rows.ladder(
+                  args.max_batch * max(rows_mix)))
     print(f"warmup ({ex.program_cache.stats()['compiles']} programs) "
           f"in {time.perf_counter() - t0:.1f}s")
     misses0 = ex.program_cache.stats()["misses"]
@@ -77,7 +82,6 @@ def main():
     serve_metrics.DEFAULT.reset()
 
     rng = np.random.default_rng(0)
-    rows_mix = (1, 2, 3, 1, 2, 1)
     reqs = [rng.integers(0, args.vocab,
                          (rows_mix[i % len(rows_mix)], args.seq_len)
                          ).astype(np.int32)

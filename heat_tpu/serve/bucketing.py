@@ -11,6 +11,20 @@ program-cache counters, ``tests/test_serve.py``).
 
 A policy is any callable ``rows -> bucket_rows`` with ``bucket_rows >=
 rows``; pass one via ``ServeConfig.bucket_rows`` to override the default.
+A policy MAY additionally expose the attributes the executor probes:
+
+* ``min_rows`` — the mesh-divisibility floor (every bucket is a multiple
+  of it). Drives the default ``warmup()`` coverage and the divisibility
+  of the over-cap exact-shape fallback; absent, the executor falls back
+  to ``ServeConfig.min_rows``.
+* ``multiple_of`` — additional divisibility constraint (default 1).
+* ``ladder(upto)`` — the distinct buckets for 1..upto rows; absent, a
+  no-args ``warmup()`` compiles only the single ``max_batch`` bucket.
+
+A bare callable without them still serves correctly, but gets those
+degraded defaults silently — implement the attributes (or subclass
+:class:`Pow2Buckets` / :class:`FixedBuckets`, which carry them) for full
+warmup coverage and a mesh-safe memory-cap fallback.
 """
 
 from __future__ import annotations

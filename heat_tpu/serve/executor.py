@@ -39,7 +39,7 @@ from __future__ import annotations
 import threading
 import time
 import weakref
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
@@ -87,7 +87,8 @@ class ServeConfig:
 
 
 class _Request:
-    __slots__ = ("x", "rows", "group", "enq_t", "deadline_t", "future")
+    __slots__ = ("x", "rows", "group", "enq_t", "deadline_t", "future",
+                 "started")
 
     def __init__(self, x: np.ndarray, deadline_t: Optional[float]):
         self.x = x
@@ -96,6 +97,7 @@ class _Request:
         self.enq_t = time.monotonic()
         self.deadline_t = deadline_t
         self.future = Future()
+        self.started = False  # set_running_or_notify_cancel already called
 
 
 class ServingExecutor:
@@ -160,8 +162,10 @@ class ServingExecutor:
         ``x``: ``(rows, *feat)`` host or device array — axis 0 is the
         batchable row axis (a single example is ``rows=1``). The future
         resolves to the model output rows for exactly this request, as
-        host (numpy) arrays — the batch output is fetched once and sliced
-        zero-copy — or raises one of the typed serve errors.
+        host (numpy) arrays — the batch output is fetched to host once,
+        then each request gets an independent copy of its rows (so no
+        result pins the whole batch buffer alive) — or raises one of the
+        typed serve errors.
         """
         x = np.asarray(x)
         if x.ndim < 1 or x.shape[0] < 1:
@@ -198,13 +202,23 @@ class ServingExecutor:
         requests cannot coalesce across buckets) and waits for each.
         Returns the program-cache stats afterwards — steady-state traffic
         over the same ladder must add zero misses from here on.
+
+        The default ``rows`` covers the policy's ladder up to
+        ``max_batch * policy.min_rows`` — the reachable buckets when every
+        request is ``policy.min_rows`` rows. Callers whose requests carry
+        more rows each must pass explicit ``rows`` up to
+        ``max_batch * max_request_rows``, or coalesced traffic will still
+        reach (and compile) buckets above the default ladder.
         """
         if rows is None:
             policy = self.config.bucket_rows
             ladder = getattr(policy, "ladder", None)
-            rows = (ladder(self.config.max_batch * max(
-                1, self.config.min_rows)) if ladder is not None
-                else [self.config.max_batch])
+            # the floor that actually shapes the ladder lives on the
+            # policy (adapters set it there, not on the config)
+            min_rows = max(
+                1, int(getattr(policy, "min_rows", self.config.min_rows)))
+            rows = (ladder(self.config.max_batch * min_rows)
+                    if ladder is not None else [self.config.max_batch])
         feat_shape = tuple(int(s) for s in feat_shape)
         seen = set()
         for r in rows:
@@ -251,18 +265,32 @@ class ServingExecutor:
               timeout: Optional[float] = None) -> None:
         """Stop admission; then drain (answer pending) or abort (fail
         pending with :class:`ServeClosed`). Idempotent."""
+        failed: list = []
         with self._cv:
             self._closed = True
             self._draining = drain
             if not drain:
-                for req in self._q:
-                    req.future.set_exception(
-                        ServeClosed(f"executor {self.name!r} closed "
-                                    "without drain"))
+                failed = list(self._q)
                 self._q.clear()
             self._paused = False  # a paused executor must still shut down
             self._cv.notify_all()
-        self._worker.join(timeout)
+        # fail futures OUTSIDE the lock: set_exception runs done-callbacks
+        # synchronously, and a callback that re-enters close() would
+        # otherwise join the worker while holding the lock the worker
+        # needs to wake up and exit — deadlock
+        for req in failed:
+            # returns False iff the client already cancelled; otherwise it
+            # moves the future to RUNNING so set_exception cannot race a
+            # concurrent cancel
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(
+                    ServeClosed(f"executor {self.name!r} closed "
+                                "without drain"))
+        # close() can be reached FROM the worker (a future done-callback
+        # fires on the thread that set the result) — joining yourself
+        # raises; admission is already stopped, so just skip the wait
+        if threading.current_thread() is not self._worker:
+            self._worker.join(timeout)
 
     @property
     def closed(self) -> bool:
@@ -314,6 +342,17 @@ class ServingExecutor:
                 self._inflight = len(batch)
             try:
                 self._process(batch)
+            except Exception as exc:
+                # backstop: NOTHING may kill the worker thread — a dead
+                # worker leaves every queued future unresolved forever
+                # while submit() keeps admitting. Fail the batch instead.
+                self.metrics.record_error()
+                for req in batch:
+                    try:
+                        if not req.future.done():
+                            req.future.set_exception(exc)
+                    except InvalidStateError:
+                        pass  # lost a race with a client cancel
             finally:
                 with self._cv:
                     self._inflight = 0
@@ -334,11 +373,43 @@ class ServingExecutor:
         self._q[:] = keep
         return taken
 
+    def _split_to_ladder(self, batch: list) -> list:
+        """Greedily pack ``batch`` into chunks whose row totals the bucket
+        policy accepts. A request too large even alone becomes its own
+        chunk — reprocessing it routes the policy's error to its future."""
+        policy = self.config.bucket_rows
+
+        def fits(rows: int) -> bool:
+            try:
+                policy(rows)
+                return True
+            except Exception:
+                return False
+
+        chunks, cur, cur_rows = [], [], 0
+        for req in batch:
+            if cur and not fits(cur_rows + req.rows):
+                chunks.append(cur)
+                cur, cur_rows = [], 0
+            cur.append(req)
+            cur_rows += req.rows
+        if cur:
+            chunks.append(cur)
+        return chunks
+
     def _expire(self, batch: list) -> list:
-        """Drop queued-past-deadline requests; returns the live remainder."""
+        """Drop client-cancelled and queued-past-deadline requests; returns
+        the live remainder, every future moved to RUNNING — from here on a
+        client ``Future.cancel()`` returns False instead of racing the
+        worker's ``set_result`` (which would raise ``InvalidStateError``
+        and poison the batch-mates via the backstop)."""
         now = time.monotonic()
         live = []
         for req in batch:
+            if not req.started:
+                if not req.future.set_running_or_notify_cancel():
+                    continue  # cancelled while queued: never run it
+                req.started = True
             if req.deadline_t is not None and now > req.deadline_t:
                 self.metrics.record_deadline_expired()
                 req.future.set_exception(ServeDeadlineExceeded(
@@ -354,42 +425,64 @@ class ServingExecutor:
         if not batch:
             return
         rows = sum(r.rows for r in batch)
-        bucket = cfg.bucket_rows(rows)
         feat, _ = batch[0].group
         dtype = batch[0].x.dtype
-        if (cfg.max_bucket_bytes is not None and len(batch) > 1
-                and bucket_nbytes(bucket, feat, dtype)
-                > cfg.max_bucket_bytes):
+        try:
+            bucket = cfg.bucket_rows(rows)
+            over_cap = (cfg.max_bucket_bytes is not None
+                        and bucket_nbytes(bucket, feat, dtype)
+                        > cfg.max_bucket_bytes)
+        except Exception as exc:
+            # a bounded policy (FixedBuckets top size, Pow2Buckets
+            # max_rows) can reject the COALESCED row count even when every
+            # member request fits on its own — re-split into the largest
+            # sub-batches the ladder still admits (NOT one-at-a-time:
+            # sustained traffic can overflow on every cycle, and singles
+            # would quietly revert to the sequential baseline).
+            # A single request the policy rejects outright is a client
+            # error: route it to that request's future, never the worker.
+            if len(batch) > 1:
+                for chunk in self._split_to_ladder(batch):
+                    self._process(chunk)
+            else:
+                self.metrics.record_error()
+                batch[0].future.set_exception(exc)
+            return
+        if over_cap and len(batch) > 1:
             # degraded path: the coalesced bucket would blow the memory
             # cap — answer one request at a time instead
             for req in batch:
                 self._process([req])
             return
-        if (cfg.max_bucket_bytes is not None and len(batch) == 1
-                and bucket_nbytes(bucket, feat, dtype)
-                > cfg.max_bucket_bytes):
+        if over_cap:
             # a single over-cap request runs at (nearly) its exact shape:
             # bounded memory at the price of bucket-ladder compile reuse.
             # Sharded programs still need the batch axis to divide the
-            # mesh, so round up to the policy's divisibility quantum.
+            # mesh; min_rows carries that requirement (its documented job)
+            # even when multiple_of is 1 — e.g. Pow2Buckets(min_rows=4)
+            # yields only multiples of 4, so the exact-shape fallback must
+            # round to min_rows too, or a 1001-row request hands the
+            # sharded program an indivisible batch axis.
             policy = cfg.bucket_rows
-            quantum = max(int(getattr(policy, "multiple_of", 1)), 1)
-            floor = max(int(getattr(policy, "min_rows", 1)), 1)
-            bucket = max(-(-rows // quantum) * quantum, floor)
+            quantum = max(int(getattr(policy, "multiple_of", 1)),
+                          int(getattr(policy, "min_rows", cfg.min_rows)), 1)
+            bucket = -(-rows // quantum) * quantum
             self.metrics.record_fallback_single()
         try:
-            payload = np.zeros((bucket,) + feat, dtype)
+            payload = np.empty((bucket,) + feat, dtype)
             off = 0
             for req in batch:
                 payload[off:off + req.rows] = req.x
                 off += req.rows
+            if off < bucket:
+                payload[off:] = 0  # zero only the pad tail, not the bucket
             prog = self.program_cache.get(
                 self.model_fn, (bucket,) + feat, dtype, self.cache_token)
             out = prog(payload)
-            # ONE device->host fetch per batch; per-request results are
-            # then zero-copy row views. Slicing the sharded device output
-            # per request instead would dispatch a device program per
-            # slice — more dispatches than the unbatched path it replaces.
+            # ONE device->host fetch per batch; per-request rows are then
+            # sliced on host. Slicing the sharded device output per
+            # request instead would dispatch a device program per slice —
+            # more dispatches than the unbatched path it replaces.
             out = jax.tree.map(np.asarray, jax.block_until_ready(out))
         except Exception as exc:
             self.metrics.record_error()

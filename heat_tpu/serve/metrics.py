@@ -150,10 +150,13 @@ def runtime_stats() -> dict:
     depth = 0
     cache_stats = {"hits": 0, "misses": 0, "compiles": 0, "entries": 0}
     n_exec = 0
+    caches = {}  # dedupe by identity: executors may SHARE a ProgramCache
     for ex in _executor.live_executors():
         n_exec += 1
         depth += ex.queue_depth
-        for k, v in ex.program_cache.stats().items():
+        caches[id(ex.program_cache)] = ex.program_cache
+    for cache in caches.values():
+        for k, v in cache.stats().items():
             cache_stats[k] += v
     counters = _pm.counters()
     return {

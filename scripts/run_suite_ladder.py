@@ -37,6 +37,7 @@ def _env(n: int) -> dict:
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["HEAT_TPU_TEST_DEVICES"] = str(n)
+    env["HEAT_TPU_RUN_SLOW"] = "1"  # the ladder runs the soak tests too
     return env
 
 

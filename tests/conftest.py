@@ -54,6 +54,28 @@ if jax.default_backend() != "cpu":
 # run_suite_ladder.py persists it next to abort_traceback.
 _LADDER_STATS = os.environ.get("HEAT_TPU_LADDER_STATS", "")
 
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long soak tests excluded from tier-1; run with "
+        "HEAT_TPU_RUN_SLOW=1 (the suite ladder sets it)")
+
+
+def pytest_collection_modifyitems(config, items):
+    # tier-1 stays bounded: the plain suite skips soak tests; the ladder's
+    # full runs opt in via HEAT_TPU_RUN_SLOW=1 ("0"/"false" stay off, same
+    # convention as HEAT_TPU_NATIVE)
+    if os.environ.get("HEAT_TPU_RUN_SLOW", "") not in ("", "0", "false",
+                                                       "False"):
+        return
+    skip = pytest.mark.skip(reason="slow soak; set HEAT_TPU_RUN_SLOW=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
 
 def pytest_runtest_teardown(item, nextitem):
     if not _LADDER_STATS:

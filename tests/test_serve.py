@@ -255,6 +255,28 @@ class TestServeCorrectness:
         assert snap["fallback_single"] >= 1
         ex.close()
 
+    def test_memory_cap_single_rounds_to_mesh_divisible(self):
+        """ServeConfig(min_rows=mesh) builds Pow2Buckets(multiple_of=1);
+        the over-cap exact-shape fallback must round to min_rows anyway —
+        a raw row count would hand the sharded program an indivisible
+        batch axis and fail the future with an XLA sharding error."""
+        comm = _comm()
+        if comm.size == 1:
+            pytest.skip("needs a sharded mesh to exercise divisibility")
+        metrics = ServeMetrics()
+        cap = bucket_nbytes(comm.size, (D_FEAT,), np.float32)
+        ex = ServingExecutor(
+            _elemwise_fn(comm),
+            ServeConfig(min_rows=comm.size, max_bucket_bytes=cap),
+            cache_token=comm.cache_key, metrics=metrics,
+            program_cache=_SHARED_CACHE)
+        x = np.ones((4 * comm.size + 1, D_FEAT), np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(ex.predict(x, timeout=120)),
+            x * np.float32(2.0) + np.float32(1.0))
+        assert metrics.snapshot()["fallback_single"] == 1
+        ex.close()
+
 
 # ---------------------------------------------------------------------- #
 # the steady-state zero-recompile proof + throughput criterion           #
@@ -284,6 +306,30 @@ class TestServeSteadyState:
             f"steady-state traffic recompiled: {steady} vs warmup {warm}")
         assert steady["compiles"] == warm["compiles"]
         assert steady["hits"] > warm["hits"]
+        ex.close()
+
+    def test_default_warmup_covers_coalesced_traffic(self):
+        """No-args warmup must derive its ladder from the POLICY's
+        min_rows (adapters set the floor there, not on the config), so
+        coalesced steady traffic of min_rows-sized requests recompiles
+        nothing."""
+        comm = _comm()
+        cache = ProgramCache(name="warm-default")
+        ex = ServingExecutor(
+            _elemwise_fn(comm),
+            ServeConfig(max_batch=4, max_wait_ms=50.0,
+                        bucket_rows=_policy(comm)),
+            cache_token=comm.cache_key, metrics=ServeMetrics(),
+            program_cache=cache)
+        ex.warmup((D_FEAT,), np.float32)  # default rows
+        warm_misses = cache.stats()["misses"]
+        ex.pause()  # force max coalescing: 4 requests x size rows
+        futs = [ex.submit(np.ones((comm.size, D_FEAT), np.float32))
+                for _ in range(4)]
+        ex.resume()
+        for f in futs:
+            f.result(120)
+        assert cache.stats()["misses"] == warm_misses, cache.stats()
         ex.close()
 
     def test_batched_throughput_at_least_3x_sequential(self):
@@ -401,6 +447,108 @@ class TestServeRobustness:
             ex.predict(np.ones((1, D_FEAT), np.float32), timeout=30)
         assert metrics.snapshot()["errors"] == 1
         ex.close()
+
+    def test_coalesced_overflow_of_bounded_policy_resplits(self):
+        """A bounded ladder (FixedBuckets / Pow2Buckets(max_rows)) can
+        reject the COALESCED row total even when every member request fits
+        alone. That must re-split into the largest sub-batches the ladder
+        admits — not kill the worker, strand the futures, or quietly
+        revert to one-request-per-program dispatch."""
+        comm = _comm()
+        metrics = ServeMetrics()
+        top = 2 * comm.size
+        ex = _executor(_elemwise_fn(comm), comm, metrics=metrics,
+                       bucket_rows=FixedBuckets([top]),
+                       max_batch=8, max_wait_ms=50.0)
+        ex.pause()
+        futs = [ex.submit(np.ones((comm.size, D_FEAT), np.float32))
+                for _ in range(4)]  # 4 * size rows > top bucket 2 * size
+        ex.resume()
+        for f in futs:
+            np.testing.assert_array_equal(
+                np.asarray(f.result(60)),
+                np.full((comm.size, D_FEAT), 3.0, np.float32))
+        assert ex._worker.is_alive()
+        # 4 requests of size rows fit the 2*size top bucket two at a time:
+        # exactly 2 program runs, still batched
+        assert metrics.snapshot()["batches"] == 2, metrics.snapshot()
+        ex.close()
+
+    def test_policy_rejecting_single_request_fails_its_future_only(self):
+        comm = _comm()
+        metrics = ServeMetrics()
+        ex = _executor(_elemwise_fn(comm), comm, metrics=metrics,
+                       bucket_rows=FixedBuckets([2 * comm.size]))
+        bad = ex.submit(np.ones((3 * comm.size, D_FEAT), np.float32))
+        with pytest.raises(ValueError, match="exceeds"):
+            bad.result(30)
+        assert metrics.snapshot()["errors"] == 1
+        # the worker survived the client error and keeps serving
+        np.testing.assert_array_equal(
+            np.asarray(ex.predict(
+                np.ones((comm.size, D_FEAT), np.float32), timeout=60)),
+            np.full((comm.size, D_FEAT), 3.0, np.float32))
+        ex.close()
+
+    def test_close_from_future_done_callback(self):
+        """Future done-callbacks run on the worker thread; one that closes
+        the executor must not crash on self-join."""
+        ex = ServingExecutor(lambda x: x + np.float32(1.0),
+                             ServeConfig(batching=False),
+                             metrics=ServeMetrics())
+        errors = []
+
+        def shut_down(_f):
+            try:
+                ex.close(drain=False)
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+
+        fut = ex.submit(np.ones((1, D_FEAT), np.float32))
+        fut.add_done_callback(shut_down)
+        fut.result(30)
+        deadline = time.monotonic() + 10
+        while not ex.closed and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert ex.closed and not errors, errors
+        with pytest.raises(ServeClosed):
+            ex.submit(np.ones((1, D_FEAT), np.float32))
+
+    def test_client_cancel_does_not_poison_batch(self):
+        """A client cancelling its queued future must not fail the
+        batch-mates it would have coalesced with: the worker claims each
+        request via set_running_or_notify_cancel before running it."""
+        comm = _comm()
+        ex = _executor(_elemwise_fn(comm), comm, max_batch=8,
+                       max_wait_ms=50.0)
+        ex.warmup((D_FEAT,), np.float32, rows=(comm.size,))
+        ex.pause()
+        f1 = ex.submit(np.ones((comm.size, D_FEAT), np.float32))
+        f2 = ex.submit(np.ones((comm.size, D_FEAT), np.float32))
+        assert f1.cancel()  # still queued: cancellable
+        ex.resume()
+        np.testing.assert_array_equal(
+            np.asarray(f2.result(60)),
+            np.full((comm.size, D_FEAT), 3.0, np.float32))
+        assert f1.cancelled()
+        ex.close()
+
+    def test_close_reentrant_from_done_callback_no_deadlock(self):
+        """close(drain=False) fails queued futures; a done-callback that
+        re-enters close() must not deadlock (futures are failed outside
+        the executor lock)."""
+        ex = ServingExecutor(lambda x: x, ServeConfig(),
+                             metrics=ServeMetrics())
+        ex.pause()
+        fut = ex.submit(np.ones((1, D_FEAT), np.float32))
+        fut.add_done_callback(lambda _f: ex.close())
+        closer = threading.Thread(target=lambda: ex.close(drain=False),
+                                  daemon=True)
+        closer.start()
+        closer.join(15)
+        assert not closer.is_alive(), "close(drain=False) deadlocked"
+        with pytest.raises(ServeClosed):
+            fut.result(0)
 
 
 # ---------------------------------------------------------------------- #
@@ -559,6 +707,20 @@ class TestRuntimeStats:
             assert k in s, k
         assert s["requests"] == 1
         ex.close()
+
+    def test_shared_program_cache_counted_once(self):
+        """ServingExecutor's docstring recommends sharing one ProgramCache
+        across executors; runtime_stats must dedupe it, not multiply its
+        counters by the executor count."""
+        comm = _comm()
+        a = _executor(_elemwise_fn(comm), comm)
+        a.predict(np.ones((comm.size, D_FEAT), np.float32), timeout=60)
+        one = ht.runtime_stats()["serve"]["program_cache"]
+        b = _executor(_elemwise_fn(comm), comm)  # same _SHARED_CACHE
+        two = ht.runtime_stats()["serve"]["program_cache"]
+        assert one == two, (one, two)
+        a.close()
+        b.close()
 
 
 @pytest.mark.slow
