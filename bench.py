@@ -433,6 +433,124 @@ def _measure_main(n: int) -> None:
             sys.stderr.write(f"bench: transformer figure failed: {exc}\n")
 
 
+def _fusion_bench_main() -> None:
+    """``--fusion-bench`` child: measure the lazy op-chain fusion engine on
+    the 4-device CPU mesh this process was launched onto (a dispatch-
+    overhead figure, pinned to the virtual CPU mesh like the serve stage).
+
+    Two workloads, each timed eager (``HEAT_TPU_FUSION`` off) vs fused:
+
+    * a 16-op elementwise chain on a split-0 ``(n, 64)`` f32 array — the
+      ISSUE's headline shape: 16 dispatches + 15 materialized
+      intermediates eager, ONE cached program fused;
+    * a kmeans-style mixed chain (binary ops against a replicated row,
+      scalar rescales, unary transcendentals) ending in a split-axis
+      reduction — the flush-at-reduction production pattern.
+
+    Prints ONE JSON line with both speedups and the fusion program-cache
+    stats proving the steady state runs zero recompiles.
+    """
+    import jax
+
+    import heat_tpu as ht
+    from heat_tpu.core import fusion
+
+    comm = ht.get_comm()
+    n, d = 1 << 15, D_FEATS
+    rng = np.random.default_rng(0)
+    xd = rng.standard_normal((n, d)).astype(np.float32)
+    wd = rng.standard_normal((n, d)).astype(np.float32)
+    rowd = rng.standard_normal((d,)).astype(np.float32)
+    x = ht.array(xd, split=0)
+    w = ht.array(wd, split=0)
+    row = ht.array(rowd)
+
+    def chain16(a):
+        # 16 ht-level ops, arithmetic/memory-bound mix (2 transcendentals):
+        # eager reads+writes the full array per op; fused reads the inputs
+        # once and writes once — the traffic elimination IS the speedup
+        # (an all-transcendental chain is compute-bound either way)
+        t = a * 0.5
+        t = t + w
+        t = t - 0.25
+        t = t * a
+        t = abs(t)
+        t = t + row
+        t = t * 1.25
+        t = ht.sqrt(t + 2.0)
+        t = t - w
+        t = t * 0.75
+        t = t + a
+        t = ht.tanh(t)
+        t = t * t
+        t = t - 0.125
+        t = t + 0.5
+        t = t * 2.0
+        return t
+
+    def kmeans_mixed(a):
+        # the Lloyd-style pre-assignment normalize: center against a
+        # replicated row, rescale, clamp tails, then a split-axis reduce
+        t = (a - row) * 0.75
+        t = t * t + t
+        t = ht.tanh(t / 2.0)
+        t = abs(t) + 0.125
+        return t.sum(axis=0)
+
+    def timed(build, reps: int) -> float:
+        out = build(x)  # compile + warm (cache miss lands here)
+        jax.block_until_ready(out.larray)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = build(x)
+            jax.block_until_ready(out.larray)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    record = {"fusion_devices": comm.size, "fusion_n": n}
+    for label, build, reps in (("chain16", chain16, 30),
+                               ("kmeans_mixed", kmeans_mixed, 30)):
+        with fusion.override(False):
+            t_eager = min(timed(build, reps) for _ in range(2))
+        with fusion.override(True):
+            t_fused = min(timed(build, reps) for _ in range(2))
+        record[f"fusion_{label}_eager_ms"] = round(t_eager, 3)
+        record[f"fusion_{label}_fused_ms"] = round(t_fused, 3)
+        record[f"fusion_{label}_speedup"] = round(t_eager / t_fused, 2)
+    with fusion.override(True):
+        cstats0 = fusion.program_cache().stats()
+        for _ in range(5):
+            jax.block_until_ready(chain16(x).larray)
+        cstats = fusion.program_cache().stats()
+    record["fusion_steady_misses"] = cstats["misses"] - cstats0["misses"]
+    record["fusion_program_cache"] = cstats
+    record["fusion_ops_per_flush"] = fusion.stats()["ops_per_flush"]
+    print(json.dumps(record), flush=True)
+
+
+def _fusion_stage(timeout: float = 420.0):
+    """Fail-soft fusion-speedup stage on a 4-device CPU mesh; returns the
+    fusion_* field dict or an ``{"fusion_error": ...}`` marker — the
+    headline record survives either way (same contract as the serve and
+    resplit stages)."""
+    from __graft_entry__ import _cpu_env
+
+    me = os.path.abspath(__file__)
+    try:
+        out = subprocess.run(
+            [sys.executable, me, "--fusion-bench"], env=_cpu_env(4),
+            timeout=timeout, capture_output=True, text=True)
+        line = next((l for l in reversed(out.stdout.splitlines())
+                     if l.startswith("{")), None)
+        if out.returncode == 0 and line is not None:
+            return json.loads(line)
+        tail = (out.stderr or out.stdout or "").strip().splitlines()[-3:]
+        return {"fusion_error": f"rc={out.returncode} " + " | ".join(tail)}
+    except subprocess.TimeoutExpired:
+        return {"fusion_error": f"fusion stage exceeded {timeout:.0f}s"}
+    except Exception as exc:
+        return {"fusion_error": repr(exc)}
+
+
 def _serve_bench_main() -> None:
     """``--serve-bench`` child: measure the serving executor on the
     4-device CPU mesh this process was launched onto (the serving stage is
@@ -697,6 +815,9 @@ def main() -> None:
     if len(sys.argv) >= 2 and sys.argv[1] == "--serve-bench":
         _serve_bench_main()
         return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--fusion-bench":
+        _fusion_bench_main()
+        return
 
     me = os.path.abspath(__file__)
     from __graft_entry__ import _cpu_env
@@ -760,9 +881,12 @@ def main() -> None:
             try:
                 rec = json.loads(line)
                 rec.update(_serve_stage())
+                # fusion-engine speedup stage (fail-soft, live records
+                # only, same 4-device CPU mesh): eager vs fused op chains
+                rec.update(_fusion_stage())
                 line = json.dumps(rec)
             except Exception as exc:
-                sys.stderr.write(f"bench: serve stage skipped: {exc}\n")
+                sys.stderr.write(f"bench: serve/fusion stage skipped: {exc}\n")
             print(line)
             return
         if label != "cpu" and out.returncode == 5:

@@ -62,8 +62,11 @@ def runtime_stats() -> dict:
     percentiles, queue depth, batch occupancy, shed count, program-cache
     stats), the resharding plan cache (``"resharding"`` is exactly
     :func:`heat_tpu.core.resharding.plan_cache_stats` — the supported alias
-    for it), the op-engine alignment counter, and every process-wide
-    counter. See :mod:`heat_tpu.serve.metrics`."""
+    for it), the op-engine alignment counter and fusion-engine figures
+    (``["op_engine"]["fusion"]`` is exactly
+    :func:`heat_tpu.core.fusion.stats`: flushes, fused ops, ops-per-flush,
+    program-cache hit/miss/compile — see ``doc/fusion.md``), and every
+    process-wide counter. See :mod:`heat_tpu.serve.metrics`."""
     from .serve.metrics import runtime_stats as _rs
 
     return _rs()

@@ -55,6 +55,8 @@ from .linalg import *
 from . import linalg
 from .pallas_kernels import pallas_enabled, set_pallas
 from . import pallas_kernels
+from . import fusion
+from .fusion import enabled as fusion_enabled, set_enabled as set_fusion
 
 
 def __getattr__(name):

@@ -92,6 +92,14 @@ def __binary_op(
     if not isinstance(t1, DNDarray) or not isinstance(t2, DNDarray):
         x = t1 if isinstance(t1, DNDarray) else t2
         other = t2 if isinstance(t1, DNDarray) else t1
+        if out is None and where is None:
+            from . import fusion
+
+            lazy = fusion.record_binary(operation, t1, t2, fn_kwargs,
+                                        None, None, x.gshape, x.split,
+                                        device, comm)
+            if lazy is not None:
+                return lazy
         res = operation(t1.larray if isinstance(t1, DNDarray) else t1,
                         t2.larray if isinstance(t2, DNDarray) else t2, **fn_kwargs)
         result = DNDarray(
@@ -138,24 +146,39 @@ def __binary_op(
     else:
         out_split = None
 
-    p1, p2 = t1.larray, t2.larray
-
     # physical alignment: a replicated operand whose axis matches the split
-    # axis length must be padded to the physical length
+    # axis length must be padded to the physical length (computed from
+    # metadata first, so the deferred path can record the pad as a node)
+    pad1 = pad2 = None
     if out_split is not None:
-        comm_ = comm
-        phys_len = comm_.padded_size(out_shape[out_split])
+        phys_len = comm.padded_size(out_shape[out_split])
         logical_len = out_shape[out_split]
         if phys_len != logical_len:
-            for name, (t, p) in (("1", (t1, p1)), ("2", (t2, p2))):
+            for name, t in (("1", t1), ("2", t2)):
                 ax = out_split - (ndim_out - t.ndim)
-                if ax >= 0 and t.shape[ax] == logical_len and p.shape[ax] == logical_len:
-                    cfg = [(0, phys_len - logical_len if i == ax else 0) for i in range(t.ndim)]
-                    p = jnp.pad(p, cfg)
+                if ax >= 0 and t.shape[ax] == logical_len \
+                        and t._phys_shape()[ax] == logical_len:
+                    cfg = tuple(
+                        (0, phys_len - logical_len if i == ax else 0)
+                        for i in range(t.ndim))
                     if name == "1":
-                        p1 = p
+                        pad1 = cfg
                     else:
-                        p2 = p
+                        pad2 = cfg
+
+    if out is None and where is None:
+        from . import fusion
+
+        lazy = fusion.record_binary(operation, t1, t2, fn_kwargs, pad1, pad2,
+                                    out_shape, out_split, device, comm)
+        if lazy is not None:
+            return lazy
+
+    p1, p2 = t1.larray, t2.larray
+    if pad1 is not None:
+        p1 = jnp.pad(p1, list(pad1))
+    if pad2 is not None:
+        p2 = jnp.pad(p2, list(pad2))
 
     res = operation(p1, p2, **fn_kwargs)
     result = DNDarray(
@@ -165,20 +188,55 @@ def __binary_op(
 
 
 def _finalize(result: DNDarray, out: Optional[DNDarray], where=None) -> DNDarray:
-    """Apply ``where=``/``out=`` semantics and return."""
+    """Apply ``where=``/``out=`` semantics and return.
+
+    Every distribution alignment here rides the explicit reshard planner
+    and is counted in ``op_engine.align_resplits`` — the ``out=``/``where=``
+    sites were the op engine's only uncounted resplits.
+    """
     if where is not None:
         if out is None:
             raise ValueError("'where' requires 'out' to be specified")
-        w = where.larray if isinstance(where, DNDarray) else jnp.asarray(where)
-        aligned = result.resplit(out.split) if result.split != out.split else result
+        w = _align_where_mask(where, out)
+        if result.split != out.split:
+            _count_align_resplit()
+            aligned = result.resplit(out.split)
+        else:
+            aligned = result
         out.larray = jnp.where(w, aligned.larray.astype(out.dtype.jax_type()), out.larray)
         return out
     if out is not None:
+        if out.split != result.split:
+            _count_align_resplit()  # sanitize_out resplits out in place
         sanitation.sanitize_out(out, result.shape, result.split, result.device)
         aligned = result.resplit(out.split) if result.split != out.split else result
         out.larray = aligned.larray.astype(out.dtype.jax_type())
         return out
     return result
+
+
+def _align_where_mask(where, out: DNDarray):
+    """The ``where=`` mask as a physical array aligned with ``out``'s
+    layout. A DNDarray mask whose split differs from ``out.split`` is
+    resplit first (it was previously consumed in ITS OWN layout — wrong
+    selections on uneven shapes and hidden XLA reshards otherwise); raw
+    array masks spanning a padded split axis are padded with False so
+    ``out`` keeps its own (don't-care) padding content."""
+    if isinstance(where, DNDarray):
+        if where.gshape == tuple(out.gshape):
+            if where.split != out.split:
+                _count_align_resplit()
+                where = where.resplit(out.split)
+            return where.larray
+        w = where._logical()  # broadcast-shaped mask: replicate it
+    else:
+        w = jnp.asarray(where)
+    if out.split is not None and out.pad:
+        ax = out.split - (out.ndim - w.ndim)
+        if ax >= 0 and w.shape[ax] == out.gshape[out.split]:
+            cfg = [(0, out.pad if i == ax else 0) for i in range(w.ndim)]
+            w = jnp.pad(w, cfg)  # False: padding keeps out's values
+    return w
 
 
 def __local_op(
@@ -191,9 +249,17 @@ def __local_op(
     """Pure elementwise operation (reference ``_operations.py:282-353``).
 
     Zero communication; runs on the physical array (padding computes garbage
-    that stays in padding).
+    that stays in padding). Without an ``out=`` buffer the op is *recorded*
+    instead of dispatched (:mod:`.fusion`): the whole chain compiles as one
+    program at the next materialization point.
     """
     sanitation.sanitize_in(x)
+    if out is None:
+        from . import fusion
+
+        lazy = fusion.record_unary(operation, x, kwargs)
+        if lazy is not None:
+            return lazy
     res = operation(x.larray, **kwargs)
     result = DNDarray(
         res, x.gshape, types.canonical_heat_type(res.dtype), x.split, x.device, x.comm
@@ -270,6 +336,15 @@ def __cum_op(
     axis = sanitize_axis(x.shape, axis)
     if axis is None:
         raise NotImplementedError("cumulative over flattened array: call flatten() first")
+    if out is None:
+        from . import fusion
+
+        # split-preserving scans (axis != split) record into the tape; a
+        # scan across the split axis materializes first so the neutral-
+        # element padding fill stays exactly the eager program
+        lazy = fusion.record_cum(x, partial_op, axis, dtype)
+        if lazy is not None:
+            return lazy
     physical = x.filled(neutral) if (x.split == axis and x.pad) else x.larray
     res = partial_op(physical, axis=axis)
     if dtype is not None:

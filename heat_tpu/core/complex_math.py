@@ -12,7 +12,7 @@ __all__ = ["angle", "conj", "conjugate", "imag", "real"]
 
 def angle(x: DNDarray, deg: bool = False, out=None) -> DNDarray:
     """Element-wise argument of a complex number (reference ``complex_math.py:18``)."""
-    return _operations._local_op(lambda a: jnp.angle(a, deg=deg), x, out)
+    return _operations._local_op(jnp.angle, x, out, deg=deg)
 
 
 def conjugate(x: DNDarray, out=None) -> DNDarray:

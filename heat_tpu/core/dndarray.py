@@ -106,6 +106,7 @@ class DNDarray:
     """
 
     def __init__(self, array, gshape, dtype, split, device, comm, balanced: bool = True):
+        self._lazy_node = None  # pending fusion-tape node (core/fusion.py)
         self.__parray = array
         self.__gshape = tuple(int(s) for s in gshape)
         self.__dtype = dtype
@@ -150,11 +151,41 @@ class DNDarray:
         parray = jax.device_put(arr, comm.sharding(arr.ndim, place_split))
         return DNDarray(parray, gshape, dtype, split, device, comm)
 
+    @classmethod
+    def _lazy(cls, node, gshape, dtype, split, device, comm) -> "DNDarray":
+        """A deferred DNDarray owning a pending fusion-tape node; its
+        physical array materializes on first ``larray`` access (the fused
+        chain compiles as one program — :mod:`heat_tpu.core.fusion`)."""
+        arr = cls(None, gshape, dtype, split, device, comm)
+        arr._lazy_node = node
+        return arr
+
+    def _set_materialized(self, array) -> None:
+        """Fusion flush write-back: install the evaluated physical array.
+
+        Order matters for concurrent readers: ``__parray`` must be set
+        BEFORE the lazy flag clears, or a racing ``larray`` getter could
+        see the flag down and return a still-None physical array."""
+        self.__parray = array
+        self._lazy_node = None
+
+    def _phys_or_none(self):
+        """The concrete physical array, or None while a chain is pending
+        (fusion reads this to build leaf handles without flushing)."""
+        return None if self._lazy_node is not None else self.__parray
+
+    def _phys_shape(self) -> Tuple[int, ...]:
+        """Physical (padded) shape — metadata only, never flushes."""
+        node = self._lazy_node
+        if node is not None:
+            return tuple(node.aval.shape)
+        return tuple(self.__parray.shape)
+
     def _logical(self):
         """The logical (unpadded) global array. May trigger a device slice."""
         if self.pad == 0:
-            return self.__parray
-        return self.__parray[tuple(slice(0, g) for g in self.__gshape)]
+            return self.larray
+        return self.larray[tuple(slice(0, g) for g in self.__gshape)]
 
     # ------------------------------------------------------------------ #
     # padding discipline                                                 #
@@ -164,28 +195,31 @@ class DNDarray:
         """Number of padded positions along the split axis (0 if none)."""
         if self.__split is None:
             return 0
-        return self.__parray.shape[self.__split] - self.__gshape[self.__split]
+        return self._phys_shape()[self.__split] - self.__gshape[self.__split]
 
     def filled(self, fill_value):
         """Physical array with padding overwritten by ``fill_value``.
 
         The mandatory pre-step for any op that reads across the split axis
         (reduce with its neutral element, sort with ±inf, matmul with 0).
-        XLA fuses the select into the consumer.
+        XLA fuses the select into the consumer. A materialization point:
+        any pending fused chain flushes here, so the neutral-element select
+        always reads the evaluated physical array.
         """
+        p = self.larray
         if self.pad == 0:
-            return self.__parray
+            return p
         k = self.__split
         n = self.__gshape[k]
-        iota = jax.lax.broadcasted_iota(jnp.int32, self.__parray.shape, k)
-        return jnp.where(iota < n, self.__parray, jnp.asarray(fill_value, self.__parray.dtype))
+        iota = jax.lax.broadcasted_iota(jnp.int32, p.shape, k)
+        return jnp.where(iota < n, p, jnp.asarray(fill_value, p.dtype))
 
     def valid_mask(self):
         """Boolean physical-shaped mask, True on logical positions."""
         if self.__split is None:
-            return jnp.ones(self.__parray.shape, dtype=jnp.bool_)
+            return jnp.ones(self._phys_shape(), dtype=jnp.bool_)
         k = self.__split
-        iota = jax.lax.broadcasted_iota(jnp.int32, self.__parray.shape, k)
+        iota = jax.lax.broadcasted_iota(jnp.int32, self._phys_shape(), k)
         return iota < self.__gshape[k]
 
     # ------------------------------------------------------------------ #
@@ -193,11 +227,25 @@ class DNDarray:
     # ------------------------------------------------------------------ #
     @property
     def larray(self):
-        """The physical backing ``jax.Array`` (global; shards addressable)."""
+        """The physical backing ``jax.Array`` (global; shards addressable).
+
+        THE materialization point: if a fused op chain is pending on this
+        array, accessing ``larray`` flushes it — the whole chain compiles
+        and runs as one cached XLA program (:mod:`heat_tpu.core.fusion`).
+        Every consumer of physical data (reductions, resplits, indexing,
+        ``numpy()``, printing, ``item()``) funnels through here."""
+        if self._lazy_node is not None:
+            from . import fusion
+
+            fusion.materialize(self)
         return self.__parray
 
     @larray.setter
     def larray(self, array):
+        if self._lazy_node is not None:
+            from . import fusion
+
+            fusion.cancel(self)
         self.__parray = array
 
     @property
@@ -327,7 +375,7 @@ class DNDarray:
         if axis == self.__split:
             return self
         self.__parray = _reshard_physical(
-            self.__parray, self.__gshape, self.__split, axis, self.__comm
+            self.larray, self.__gshape, self.__split, axis, self.__comm
         )
         self.__split = axis
         return self
@@ -338,9 +386,9 @@ class DNDarray:
             axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return DNDarray(
-                self.__parray, self.__gshape, self.__dtype, self.__split, self.__device, self.__comm
+                self.larray, self.__gshape, self.__dtype, self.__split, self.__device, self.__comm
             )
-        parray = _reshard_physical(self.__parray, self.__gshape, self.__split, axis, self.__comm)
+        parray = _reshard_physical(self.larray, self.__gshape, self.__split, axis, self.__comm)
         return DNDarray(parray, self.__gshape, self.__dtype, axis, self.__device, self.__comm)
 
     def redistribute_(self, lshape_map=None, target_map=None) -> None:
@@ -375,7 +423,8 @@ class DNDarray:
         k = self.__split
         comm = self.__comm
         n = comm.size
-        chunk = self.__parray.shape[k] // n
+        p = self.larray
+        chunk = p.shape[k] // n
         if halo_size > chunk:
             raise ValueError(f"halo_size {halo_size} exceeds chunk size {chunk}")
         from ._compat import shard_map
@@ -393,7 +442,7 @@ class DNDarray:
 
         fn = shard_map(body, mesh=comm.mesh, in_specs=spec,
                        out_specs=(spec, spec))
-        return jax.jit(fn)(self.__parray)
+        return jax.jit(fn)(p)
 
     def array_with_halos(self, halo_size: int) -> jax.Array:
         """Physical array where every shard is extended by neighbor edges.
@@ -404,7 +453,7 @@ class DNDarray:
         """
         parts = self._halo_exchange(halo_size)
         if parts is None:
-            return self.__parray
+            return self.larray
         from_prev, from_next = parts
         k = self.__split
         comm = self.__comm
@@ -414,7 +463,7 @@ class DNDarray:
         fn = shard_map(
             lambda p, x, nx: jnp.concatenate([p, x, nx], axis=k),
             mesh=comm.mesh, in_specs=(spec, spec, spec), out_specs=spec)
-        return jax.jit(fn)(from_prev, self.__parray, from_next)
+        return jax.jit(fn)(from_prev, self.larray, from_next)
 
     def get_halo(self, halo_size: int) -> None:
         """Computes and caches the per-direction halo arrays (reference
@@ -443,9 +492,18 @@ class DNDarray:
     # conversion                                                         #
     # ------------------------------------------------------------------ #
     def astype(self, dtype, copy: bool = True) -> "DNDarray":
-        """Cast to ``dtype`` (reference ``:447``)."""
+        """Cast to ``dtype`` (reference ``:447``). The out-of-place form is
+        recorded into the fusion tape (a cast is elementwise); the in-place
+        form keeps the eager flush — rebinding another array's identity
+        mid-tape is not worth the bookkeeping."""
         dtype = types.canonical_heat_type(dtype)
-        casted = self.__parray.astype(dtype.jax_type())
+        if copy:
+            from . import fusion
+
+            lazy = fusion.record_astype(self, dtype)
+            if lazy is not None:
+                return lazy
+        casted = self.larray.astype(dtype.jax_type())
         if copy:
             return DNDarray(
                 casted, self.__gshape, dtype, self.__split, self.__device, self.__comm

@@ -1,0 +1,760 @@
+"""Fused lazy op-chain engine: trace ``ht.*`` chains into one cached program.
+
+Eagerly, every elementwise ``ht.*`` op is its own XLA dispatch: a 16-op
+chain costs 16 program launches and 15 materialized intermediates with
+zero cross-op fusion — exactly the op-by-op overhead the HeAT reference
+accepts on MPI+torch but that XLA is built to eliminate. This module makes
+the op engine *deferred* instead: ``__local_op`` / ``__binary_op`` (and
+the split-preserving ``__cum_op``) record :class:`_Node` entries into a
+per-array expression DAG, and the first **materialization point** flushes
+the whole chain as ONE jitted program.
+
+Materialization points (flush triggers)
+---------------------------------------
+Everything in the codebase reads the physical array through
+``DNDarray.larray``, so the property is the single choke point: reductions
+(``filled``/``larray``), resplits and split-changing ops, ``out=`` /
+``where=`` (the op engine falls back to eager there), ``.numpy()`` /
+``__array__`` / ``item()`` / printing, comparisons used in control flow
+(``__bool__``), and the tape-depth cap (``HEAT_TPU_FUSION_MAX_OPS``,
+default 32). Padding discipline survives by construction: recorded nodes
+never read across the split axis — any op that would (reduction, cum over
+the split axis, alignment resplit) materializes its inputs first, so
+collective placement stays exactly where the explicit resharding planner
+(arXiv:2112.01075) put it, and fused programs for split-preserving chains
+lower with ZERO collectives (audited in ``tests/test_fusion.py``).
+
+Program identity and caching
+----------------------------
+A flush compiles at most once per *chain signature*: a structural key over
+(comm cache key, per-leaf ``(shape, dtype, weak, sharding)``, the node
+list ``(op, arg slots, static kwargs)``, output slots, donation slots),
+served from a generalized :class:`~heat_tpu.utils.program_cache.ProgramCache`
+(``fusion.program_hits`` / ``_misses`` / ``_compiles`` counters). Python
+scalars enter the program as 0-d *arguments* (weak-typed, value-cached) —
+never as baked constants — so XLA cannot constant-fold them differently
+from the eager dispatch (e.g. div-by-const → reciprocal-multiply), and one
+program serves every scalar value.
+
+Donation
+--------
+Leaves whose owning DNDarray is dead and whose buffer the tape provably
+holds the only references to (exact ``sys.getrefcount`` accounting) are
+donated to XLA, so ``x = ht.exp(x * 2)``-style rebinding chains reuse the
+input buffer. Interior nodes never materialize at all unless another live
+array shares them.
+
+Numerics
+--------
+Fused results are bitwise-equal to eager for integer/bool dtypes and for
+float chains without a multiply feeding directly into an add/sub. Where
+such pairs fuse, XLA's backend contracts them into an FMA — a *more*
+accurate single rounding that can differ from eager (and NumPy) by 1 ulp.
+``tests/test_fusion.py`` pins both properties; ``doc/fusion.md`` documents
+the contract.
+
+Opt-out: ``HEAT_TPU_FUSION=0`` (or :func:`set_enabled` at runtime).
+Counters: ``op_engine.fusion_flushes``, ``op_engine.fusion_ops`` (their
+ratio is the ops-per-flush figure in ``ht.runtime_stats()``), plus the
+program-cache hit/miss/compile set.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import sys
+import threading
+import weakref
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "enabled",
+    "set_enabled",
+    "override",
+    "materialize",
+    "cancel",
+    "record_unary",
+    "record_binary",
+    "record_cum",
+    "program_cache",
+    "stats",
+    "reset",
+    "capture_hlo",
+    "last_hlo",
+]
+
+
+def _env_on(name: str, default: str = "1") -> bool:
+    return os.environ.get(name, default) not in ("0", "false", "False")
+
+
+_ENABLED = _env_on("HEAT_TPU_FUSION")
+_MAX_OPS = int(os.environ.get("HEAT_TPU_FUSION_MAX_OPS", "32"))
+# chains shorter than this replay op-by-op at flush (XLA's per-op cache,
+# shared across ALL chains) instead of compiling a per-signature program:
+# a test-suite-shaped workload materializes thousands of DISTINCT 1-3 op
+# chains once each, where per-chain executables are pure compile-time loss
+_MIN_OPS = int(os.environ.get("HEAT_TPU_FUSION_MIN_OPS", "4"))
+_DONATE = _env_on("HEAT_TPU_FUSION_DONATE")
+
+_PROGRAMS = None  # lazy singleton (utils imports back into core)
+
+# result-aval memo: (fn, kwargs_key, arg descriptors) -> ShapeDtypeStruct,
+# or None for "declined" (non-array result, un-eval-shapeable op)
+_AVAL_CACHE: Dict[Tuple, Any] = {}
+_AVAL_CACHE_CAP = 8192
+_UNSET = object()
+
+# value-keyed 0-d leaves for python/numpy scalars, so repeat chains with
+# the same scalar hit the same program AND the same buffer
+_SCALAR_CACHE: Dict[Tuple, Any] = {}
+_SCALAR_CACHE_CAP = 512
+
+_capture_hlo = False
+_last_hlo: Optional[str] = None
+
+
+def program_cache():
+    """The fusion :class:`~heat_tpu.utils.program_cache.ProgramCache`."""
+    global _PROGRAMS
+    if _PROGRAMS is None:
+        from ..utils.program_cache import ProgramCache
+
+        # fusion's key space is open (leaf shapes x chain signatures), so
+        # the cache is capped — unbounded pinned executables are the exact
+        # accumulated-executable pathology this engine exists to reduce
+        _PROGRAMS = ProgramCache(
+            name="fusion", aot=False,
+            max_entries=int(os.environ.get(
+                "HEAT_TPU_FUSION_MAX_PROGRAMS", "1024")))
+    return _PROGRAMS
+
+
+def _metrics():
+    from ..utils import metrics
+
+    return metrics
+
+
+# ---------------------------------------------------------------------- #
+# switches                                                               #
+# ---------------------------------------------------------------------- #
+def enabled() -> bool:
+    """Whether op recording is on (``HEAT_TPU_FUSION``, default on)."""
+    return _ENABLED
+
+
+def set_enabled(flag: bool) -> bool:
+    """Toggle recording; returns the previous setting. Pending tapes stay
+    valid — they flush on their next materialization either way."""
+    global _ENABLED
+    prev = _ENABLED
+    _ENABLED = bool(flag)
+    return prev
+
+
+@contextlib.contextmanager
+def override(flag: bool):
+    """Context manager form of :func:`set_enabled` (used by the eager-vs-
+    fused property tests and the bench A/B)."""
+    prev = set_enabled(flag)
+    try:
+        yield
+    finally:
+        set_enabled(prev)
+
+
+def capture_hlo(flag: bool) -> None:
+    """Debug switch: compile flush programs ahead-of-time and keep the
+    optimized-HLO text of the most recent compile for :func:`last_hlo`
+    (the collective audit in ``tests/test_fusion.py``). Only *compiles*
+    capture — reset :func:`program_cache` first to force one."""
+    global _capture_hlo
+    _capture_hlo = bool(flag)
+
+
+def last_hlo() -> Optional[str]:
+    return _last_hlo
+
+
+# ---------------------------------------------------------------------- #
+# the expression DAG                                                     #
+# ---------------------------------------------------------------------- #
+class _Leaf:
+    """A concrete physical array entering a chain, plus a weakref to the
+    DNDarray that owned it at record time (None for scalar constants) —
+    the donation analysis input."""
+
+    __slots__ = ("array", "owner")
+
+    def __init__(self, array, owner=None):
+        self.array = array
+        self.owner = owner
+
+
+class _Node:
+    """One recorded op. ``args`` are ``_Node`` / ``_Leaf`` handles;
+    ``kwargs`` are static (hashability enforced at record time). ``value``
+    is set once a flush evaluates the node (it then acts as a leaf for any
+    later chain that still references it)."""
+
+    __slots__ = ("fn", "args", "kwargs", "kwargs_key", "aval", "depth",
+                 "owner", "ext_refs", "value", "__weakref__")
+
+    def __init__(self, fn, args, kwargs, kwargs_key, aval, depth):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.kwargs_key = kwargs_key
+        self.aval = aval
+        self.depth = depth
+        self.owner = None       # weakref.ref(DNDarray) once wrapped
+        self.ext_refs = 0       # times used as an argument of another node
+        self.value = None       # concrete result once evaluated
+
+
+def _key_val(v):
+    """Type-aware hashable identity for one static kwarg value, or None to
+    decline. Plain ``(k, v)`` tuples would alias values that compare equal
+    across types (``0 == 0.0 == False``) and let one call's cached aval or
+    compiled program serve another call's different dtype — floats key by
+    ``repr`` (distinguishes ``-0.0`` and NaN, like the scalar-leaf cache)
+    and everything carries its type name."""
+    if isinstance(v, (np.ndarray, jnp.ndarray)):
+        return None
+    if isinstance(v, (list, tuple)):
+        parts = tuple(_key_val(x) for x in v)
+        return None if any(p is None for p in parts) else ("tuple", parts)
+    if isinstance(v, (float, complex, np.floating, np.complexfloating)):
+        return (type(v).__name__, repr(v))
+    try:
+        hash(v)
+    except TypeError:
+        return None
+    return (type(v).__name__, v)
+
+
+def _kwargs_key(kwargs: dict):
+    """Hashable identity for static kwargs, or None to decline recording
+    (array-valued kwargs must stay eager — baking them as constants would
+    both bloat the key space and change numerics)."""
+    if not kwargs:
+        return ()
+    items = []
+    for k in sorted(kwargs):
+        vk = _key_val(kwargs[k])
+        if vk is None:
+            return None
+        items.append((k, vk))
+    return tuple(items)
+
+
+def _scalar_leaf(s) -> Optional[_Leaf]:
+    """A 0-d leaf for a python/numpy scalar operand, value-cached.
+
+    ``jnp.asarray`` preserves NumPy-style weak typing for python scalars,
+    so passing the leaf as a program *argument* reproduces eager promotion
+    exactly ((f32 array) * 0.5 stays f32). ``repr`` keys the cache so
+    ``-0.0``/``0.0`` and NaN never alias."""
+    key = (type(s).__name__, repr(s))
+    leaf = _SCALAR_CACHE.get(key)
+    if leaf is None:
+        try:
+            arr = jnp.asarray(s)
+        except Exception:
+            return None
+        if len(_SCALAR_CACHE) >= _SCALAR_CACHE_CAP:
+            _SCALAR_CACHE.clear()
+        leaf = _Leaf(arr, None)
+        _SCALAR_CACHE[key] = leaf
+    return leaf
+
+
+def _handle_of(x) -> Optional[object]:
+    """The symbolic handle for a DNDarray operand: its pending node, or a
+    leaf over its concrete physical array. None declines recording (jax
+    tracers must not be captured into a cross-turn tape)."""
+    node = x._lazy_node
+    if node is not None:
+        if node.value is not None:
+            return _Leaf(node.value, node.owner)
+        return node
+    arr = x._phys_or_none()
+    if arr is None or isinstance(arr, jax.core.Tracer):
+        return None
+    return _Leaf(arr, weakref.ref(x))
+
+
+def _descr(h) -> tuple:
+    """Aval descriptor of a handle, for the eval-shape memo key."""
+    if isinstance(h, _Node):
+        return (tuple(h.aval.shape), str(h.aval.dtype), False)
+    a = h.array
+    return (tuple(a.shape), str(a.dtype), bool(a.aval.weak_type))
+
+
+def _proxy(h):
+    """What :func:`jax.eval_shape` sees for a handle: pending nodes by
+    abstract aval, leaves by their concrete array (weak types ride along)."""
+    if isinstance(h, _Node):
+        return jax.ShapeDtypeStruct(tuple(h.aval.shape), h.aval.dtype)
+    return h.array
+
+
+def _result_aval(fn, kwargs, kwargs_key, handles):
+    """Memoized ``eval_shape`` of one op application; None declines (op not
+    abstractly traceable, or result is not a single array)."""
+    key = (fn, kwargs_key, tuple(_descr(h) for h in handles))
+    aval = _AVAL_CACHE.get(key, _UNSET)
+    if aval is not _UNSET:
+        return aval
+    try:
+        aval = jax.eval_shape(lambda *a: fn(*a, **kwargs),
+                              *[_proxy(h) for h in handles])
+        if not isinstance(aval, jax.ShapeDtypeStruct):
+            aval = None
+    except Exception:
+        aval = None
+    if len(_AVAL_CACHE) >= _AVAL_CACHE_CAP:
+        _AVAL_CACHE.clear()
+    _AVAL_CACHE[key] = aval
+    return aval
+
+
+def _depth_of(handles) -> int:
+    return 1 + max((h.depth for h in handles if isinstance(h, _Node)),
+                   default=0)
+
+
+def _stable_fn(fn) -> bool:
+    """Only module-level callables may be recorded: a lambda / closure /
+    ``functools.partial`` built per call has a fresh identity every time,
+    so every chain containing one would compile a brand-new executable per
+    invocation and pin it forever in the program cache — unbounded
+    compile-time and memory growth (the exact executable-count pathology
+    this engine exists to reduce). Those ops dispatch eagerly instead."""
+    if isinstance(fn, functools.partial):
+        return False
+    if getattr(fn, "__name__", "") == "<lambda>":
+        return False
+    return "<locals>" not in getattr(fn, "__qualname__", "")
+
+
+def _make_node(fn, kwargs, handles, expected_shape) -> Optional[_Node]:
+    """Record one op over ``handles``; enforces the tape-depth cap (flush
+    the deep inputs, then record over their values) and validates the
+    abstract result against the expected physical shape — any mismatch
+    declines, and the caller's eager path reproduces historic behavior."""
+    if not _stable_fn(fn):
+        return None
+    kwargs_key = _kwargs_key(kwargs)
+    if kwargs_key is None:
+        return None
+    aval = _result_aval(fn, kwargs, kwargs_key, handles)
+    if aval is None or tuple(aval.shape) != tuple(expected_shape):
+        return None
+    if _depth_of(handles) > _MAX_OPS:
+        handles = tuple(_flushed_handle(h) for h in handles)
+    node = _Node(fn, tuple(handles), dict(kwargs), kwargs_key, aval,
+                 _depth_of(handles))
+    with _FLUSH_LOCK:
+        # ext_refs feeds the flush-time shared-node output promotion; an
+        # unsynchronized += could lose an increment under concurrent
+        # recording off one shared pending node and strand its value
+        for h in handles:
+            if isinstance(h, _Node):
+                h.ext_refs += 1
+    return node
+
+
+def _flushed_handle(h):
+    """Depth-cap helper: evaluate a pending node and hand back its value
+    as a leaf (the chain splits into two programs at the cap)."""
+    if isinstance(h, _Node) and h.value is None:
+        _flush(h)
+    if isinstance(h, _Node):
+        return _Leaf(h.value, h.owner)
+    return h
+
+
+def _wrap(node: _Node, gshape, split, device, comm):
+    """A lazy DNDarray owning ``node``."""
+    from . import types
+    from .dndarray import DNDarray
+
+    arr = DNDarray._lazy(node, gshape, types.canonical_heat_type(aval_dtype(node)),
+                         split, device, comm)
+    node.owner = weakref.ref(arr)
+    return arr
+
+
+def aval_dtype(node: _Node):
+    return node.aval.dtype
+
+
+# ---------------------------------------------------------------------- #
+# record entry points (called from the op engine)                        #
+# ---------------------------------------------------------------------- #
+def record_unary(operation, x, kwargs) -> Optional[object]:
+    """Lazy form of ``__local_op`` (no ``out=``): shape-preserving
+    elementwise op on the physical array."""
+    if not _ENABLED:
+        return None
+    h = _handle_of(x)
+    if h is None:
+        return None
+    node = _make_node(operation, kwargs, (h,), x._phys_shape())
+    if node is None:
+        return None
+    return _wrap(node, x.gshape, x.split, x.device, x.comm)
+
+
+def _pad_op(a, cfg):
+    """Module-level (stable identity for program keys) physical pad of a
+    replicated operand onto the padded split-axis length."""
+    return jnp.pad(a, list(cfg))
+
+
+def record_binary(operation, t1, t2, fn_kwargs, pad1, pad2,
+                  out_shape, out_split, device, comm) -> Optional[object]:
+    """Lazy form of ``__binary_op``'s compute tail (no ``out=``/``where=``).
+
+    Called AFTER distribution alignment — any needed resplit already ran
+    (and materialized its operand), so both handles are layout-compatible
+    and the recorded op never crosses the split axis. ``pad1``/``pad2``
+    are the replicated-operand pad configs the eager path would apply;
+    they become nodes of their own."""
+    from .dndarray import DNDarray
+
+    if not _ENABLED:
+        return None
+
+    def handle(t, pad_cfg):
+        if isinstance(t, DNDarray):
+            h = _handle_of(t)
+        else:
+            h = _scalar_leaf(t)
+        if h is None or pad_cfg is None:
+            return h
+        hp = _make_node(_pad_op, {"cfg": tuple(tuple(p) for p in pad_cfg)},
+                        (h,), _padded_shape(h, pad_cfg))
+        return hp
+
+    h1 = handle(t1, pad1)
+    h2 = handle(t2, pad2)
+    if h1 is None or h2 is None:
+        return None
+    expected = tuple(comm.padded_size(s) if i == out_split else int(s)
+                     for i, s in enumerate(out_shape))
+    node = _make_node(operation, fn_kwargs, (h1, h2), expected)
+    if node is None:
+        return None
+    return _wrap(node, out_shape, out_split, device, comm)
+
+
+def _padded_shape(h, cfg):
+    base = h.aval.shape if isinstance(h, _Node) else h.array.shape
+    return tuple(int(s) + int(cfg[i][0]) + int(cfg[i][1])
+                 for i, s in enumerate(base))
+
+
+def _astype_op(a, dtype):
+    return a.astype(dtype)
+
+
+def record_astype(x, heat_dtype) -> Optional[object]:
+    """Lazy form of ``DNDarray.astype(copy=True)``: a dtype conversion is
+    elementwise, so it records like any unary op — this keeps predicate
+    chains fusible through ``ht.where``'s bool cast instead of forcing a
+    flush at every ``astype`` boundary."""
+    if not _ENABLED:
+        return None
+    h = _handle_of(x)
+    if h is None:
+        return None
+    node = _make_node(_astype_op, {"dtype": jnp.dtype(heat_dtype.jax_type())},
+                      (h,), x._phys_shape())
+    if node is None:
+        return None
+    return _wrap(node, x.gshape, x.split, x.device, x.comm)
+
+
+def record_cum(x, partial_op, axis, dtype) -> Optional[object]:
+    """Lazy form of ``__cum_op`` for scans that do NOT read across the
+    split axis (``axis != split``) — the split-crossing case materializes
+    first so the neutral-element padding discipline stays eager."""
+    if not _ENABLED:
+        return None
+    if x.split is not None and axis == x.split:
+        return None
+    h = _handle_of(x)
+    if h is None:
+        return None
+    node = _make_node(partial_op, {"axis": axis}, (h,), x._phys_shape())
+    if node is None:
+        return None
+    if dtype is not None:
+        from . import types
+
+        jdt = types.canonical_heat_type(dtype).jax_type()
+        node2 = _make_node(_astype_op, {"dtype": jnp.dtype(jdt)}, (node,),
+                           x._phys_shape())
+        if node2 is None:
+            return None
+        node = node2
+    return _wrap(node, x.gshape, x.split, x.device, x.comm)
+
+
+# ---------------------------------------------------------------------- #
+# flush                                                                  #
+# ---------------------------------------------------------------------- #
+# Serializes flush against flush: two threads materializing overlapping
+# DAGs would otherwise race plan construction against the post-run
+# ``node.args = ()`` release (the eager engine's immutable __parray reads
+# had no such hazard). Flushes are host-side bookkeeping around one
+# program call, so serializing them costs nothing on the XLA:CPU backend
+# (dispatch is serialized there anyway) and little elsewhere. RLock:
+# a depth-cap flush can nest inside a record that nested inside a flush-
+# adjacent path.
+_FLUSH_LOCK = threading.RLock()
+
+
+def materialize(arr) -> None:
+    """Evaluate ``arr``'s pending chain (the ``DNDarray.larray`` hook)."""
+    node = arr._lazy_node
+    if node is None:
+        return
+    with _FLUSH_LOCK:
+        if node.value is None:
+            _flush(node)
+        arr._set_materialized(node.value)
+
+
+def cancel(arr) -> None:
+    """Detach ``arr`` from its pending node (its ``larray`` is being
+    overwritten): the node stays evaluable for any chain that references
+    it, but no longer writes back into ``arr``."""
+    node = arr._lazy_node
+    if node is not None:
+        node.owner = None
+        arr._lazy_node = None
+
+
+def _topo(root: _Node):
+    """Iterative post-order over the pending sub-DAG reachable from
+    ``root`` (evaluated nodes act as leaves). Returns the node list and a
+    per-node in-DAG parent-reference count."""
+    order = []
+    state: Dict[int, int] = {}  # id -> 0 visiting / 1 done
+    in_refs: Dict[int, int] = {}
+    stack = [(root, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            state[id(node)] = 1
+            order.append(node)
+            continue
+        if state.get(id(node)) is not None:
+            continue
+        state[id(node)] = 0
+        stack.append((node, True))
+        for h in node.args:
+            if isinstance(h, _Node) and h.value is None:
+                in_refs[id(h)] = in_refs.get(id(h), 0) + 1
+                if state.get(id(h)) is None:
+                    stack.append((h, False))
+    return order, in_refs
+
+
+def _donatable(leaves, occurs) -> Tuple[int, ...]:
+    """Leaf slots whose buffer the tape provably holds the only remaining
+    references to: the owning DNDarray is gone and ``sys.getrefcount``
+    matches the tape's own reference bookkeeping exactly (list entry +
+    loop variable + getrefcount argument + in-tape ``_Leaf`` holders).
+    Anything else — a live owner, another pending tape, a user variable —
+    shows up as an extra reference and vetoes donation."""
+    if not _DONATE:
+        return ()
+    out = []
+    for j, a in enumerate(leaves):
+        if a.ndim == 0:
+            continue  # cached scalar leaves are shared by design
+        if sys.getrefcount(a) == occurs[j] + 3:
+            out.append(j)
+    return tuple(out)
+
+
+def _flush(root: _Node) -> None:
+    """Compile-and-run the pending chain under ``root`` as ONE program.
+
+    Outputs are the root plus every interior node some live DNDarray or
+    other pending chain still needs; everything else stays a fused
+    temporary inside XLA. The program is cached by structural signature;
+    donation slots are part of the key.
+
+    Chains below ``HEAT_TPU_FUSION_MIN_OPS`` replay inline instead (eager
+    per-op dispatch through XLA's shared op cache): compiling one
+    executable per 1-3-op signature costs more than it saves, and the
+    inline path is bitwise-eager by construction. ``capture_hlo`` forces
+    compilation so audits can look at short chains too."""
+    with _FLUSH_LOCK:
+        _flush_locked(root)
+
+
+def _flush_locked(root: _Node) -> None:
+    order, in_refs = _topo(root)
+
+    if len(order) < _MIN_OPS and not _capture_hlo:
+        _flush_inline(order)
+        return
+
+    leaves = []        # unique concrete arrays, first-encounter order
+    leaf_slot = {}     # id(array) -> slot
+    leaf_occurs = []   # in-tape _Leaf/value holders per slot
+    leaf_owner_dead = []
+    plan = []          # (fn, codes, kwargs) per node
+    sig_nodes = []
+    index = {}
+
+    for pos, node in enumerate(order):
+        index[id(node)] = pos
+        codes = []
+        for h in node.args:
+            if isinstance(h, _Node) and h.value is None:
+                codes.append((0, index[id(h)]))
+                continue
+            if isinstance(h, _Node):
+                arr, owner, from_node = h.value, h.owner, True
+            else:
+                arr, owner, from_node = h.array, h.owner, False
+            slot = leaf_slot.get(id(arr))
+            if slot is None:
+                slot = len(leaves)
+                leaf_slot[id(arr)] = slot
+                leaves.append(arr)
+                leaf_occurs.append(0)
+                leaf_owner_dead.append(True)
+            leaf_occurs[slot] += 1
+            # a value still pinned inside a node may be referenced by other
+            # pending chains through that node — never donate those
+            if from_node or owner is None or owner() is not None:
+                leaf_owner_dead[slot] = False
+            codes.append((1, slot))
+        plan.append((node.fn, tuple(codes), node.kwargs))
+        sig_nodes.append((node.fn, tuple(codes), node.kwargs_key))
+
+    out_idx = []
+    root_pos = index[id(root)]
+    for pos, node in enumerate(order):
+        live_owner = node.owner is not None and node.owner() is not None
+        shared = node.ext_refs > in_refs.get(id(node), 0)
+        if pos == root_pos or live_owner or shared:
+            out_idx.append(pos)
+    out_idx = tuple(out_idx)
+
+    donate = tuple(j for j in _donatable(leaves, leaf_occurs)
+                   if leaf_owner_dead[j])
+
+    # mesh identity rides in through the per-leaf sharding strings (axis
+    # layout + device kind); ``jax.jit`` itself re-lowers per concrete
+    # input sharding, so a signature collision across distinct device sets
+    # degrades to an internal recompile, never a wrong program
+    leaf_descrs = tuple(
+        (tuple(a.shape), str(a.dtype), bool(a.aval.weak_type),
+         str(a.sharding))
+        for a in leaves)
+    key = (leaf_descrs, tuple(sig_nodes), out_idx, donate)
+
+    def build():
+        def replay(*leaf_vals):
+            vals = []
+            for fn, codes, kwargs in plan:
+                args = [vals[i] if tag == 0 else leaf_vals[i]
+                        for tag, i in codes]
+                vals.append(fn(*args, **kwargs))
+            return tuple(vals[i] for i in out_idx)
+
+        jitted = jax.jit(replay, donate_argnums=donate)
+        if _capture_hlo:
+            global _last_hlo
+            try:
+                compiled = jitted.lower(*leaves).compile()
+                _last_hlo = compiled.as_text()
+                return compiled
+            except Exception:
+                pass
+        return jitted
+
+    program = program_cache().get_custom(key, build)
+    results = program(*leaves)
+
+    m = _metrics()
+    m.inc("op_engine.fusion_flushes")
+    m.inc("op_engine.fusion_ops", len(order))
+
+    for pos, res in zip(out_idx, results):
+        node = order[pos]
+        node.value = res
+        owner = node.owner() if node.owner is not None else None
+        if owner is not None:
+            owner._set_materialized(res)
+    # evaluated interior nodes can never be demanded again (every external
+    # holder was promoted to an output) — release their inputs promptly
+    for node in order:
+        node.args = ()
+        node.kwargs = {}
+
+
+def _flush_inline(order) -> None:
+    """Evaluate a short chain op-by-op (children first — ``order`` is
+    post-order): each dispatch reuses XLA's per-op executable cache, which
+    every other chain in the process shares. Values land on every node, so
+    later chains referencing them see leaves."""
+    for node in order:
+        args = [h.value if isinstance(h, _Node) else h.array
+                for h in node.args]
+        node.value = node.fn(*args, **node.kwargs)
+        owner = node.owner() if node.owner is not None else None
+        if owner is not None:
+            owner._set_materialized(node.value)
+    m = _metrics()
+    m.inc("op_engine.fusion_flushes")
+    m.inc("op_engine.fusion_ops", len(order))
+    m.inc("op_engine.fusion_inline_flushes")
+    for node in order:
+        node.args = ()
+        node.kwargs = {}
+
+
+# ---------------------------------------------------------------------- #
+# observability                                                          #
+# ---------------------------------------------------------------------- #
+def stats() -> dict:
+    """Fusion engine snapshot (folded into ``ht.runtime_stats()``)."""
+    c = _metrics().counters()
+    flushes = int(c.get("op_engine.fusion_flushes", 0))
+    ops = int(c.get("op_engine.fusion_ops", 0))
+    return {
+        "enabled": _ENABLED,
+        "flushes": flushes,
+        "inline_flushes": int(c.get("op_engine.fusion_inline_flushes", 0)),
+        "fused_ops": ops,
+        "ops_per_flush": round(ops / flushes, 3) if flushes else 0.0,
+        "max_ops": _MAX_OPS,
+        "min_ops": _MIN_OPS,
+        "program_cache": program_cache().stats(),
+    }
+
+
+def reset() -> None:
+    """Drop cached programs and memoized avals (tests)."""
+    program_cache().reset()
+    _AVAL_CACHE.clear()
+    _SCALAR_CACHE.clear()

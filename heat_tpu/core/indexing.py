@@ -97,6 +97,16 @@ def nonzero(x: DNDarray) -> DNDarray:
     return DNDarray.from_logical(stacked, split, x.device, x.comm)
 
 
+def _pick_true(c_, x_):
+    """``cond ? x : 0`` — module-level so the fusion engine can key it."""
+    return jnp.where(c_, x_, 0)
+
+
+def _pick_false(c_, y_):
+    """``cond ? 0 : y`` — module-level so the fusion engine can key it."""
+    return jnp.where(c_, 0, y_)
+
+
 def where(cond, x=None, y=None) -> DNDarray:
     """Ternary select / nonzero (reference ``indexing.py:91``)."""
     if x is None and y is None:
@@ -110,8 +120,8 @@ def where(cond, x=None, y=None) -> DNDarray:
 
     # cond*x + (1-cond)*y with proper promotion, via the binary op engine
     c = cond.astype(types.canonical_heat_type(jnp.bool_))
-    picked_x = _operations._binary_op(lambda c_, x_: jnp.where(c_, x_, 0), c, x)
-    picked_y = _operations._binary_op(lambda c_, y_: jnp.where(c_, 0, y_), c, y)
+    picked_x = _operations._binary_op(_pick_true, c, x)
+    picked_y = _operations._binary_op(_pick_false, c, y)
     return arithmetics.add(picked_x, picked_y)
 
 

@@ -52,7 +52,11 @@ def clip(x: DNDarray, min=None, max=None, out=None) -> DNDarray:
         raise ValueError("either min or max must be set")
     mn = min.larray if isinstance(min, DNDarray) else min
     mx = max.larray if isinstance(max, DNDarray) else max
-    return _operations._local_op(lambda a: jnp.clip(a, mn, mx), x, out)
+    # static kwargs on the module-level op keep scalar-bound clips
+    # recordable by the fusion engine (a per-call lambda never could:
+    # fresh identity per call = one compiled program per invocation);
+    # array bounds make the kwargs unhashable and dispatch eagerly
+    return _operations._local_op(jnp.clip, x, out, min=mn, max=mx)
 
 
 def copysign(t1, t2) -> DNDarray:
@@ -91,7 +95,7 @@ def modf(x: DNDarray, out=None) -> tuple:
 
 def round(x: DNDarray, decimals: int = 0, out=None, dtype=None) -> DNDarray:  # noqa: A001
     """Round to ``decimals`` (reference ``:340``)."""
-    res = _operations._local_op(lambda a: jnp.round(a, decimals), x, out)
+    res = _operations._local_op(jnp.round, x, out, decimals=decimals)
     if dtype is not None:
         res = res.astype(types.canonical_heat_type(dtype), copy=False)
     return res

@@ -138,11 +138,15 @@ def runtime_stats() -> dict:
     :func:`heat_tpu.core.resharding.plan_cache_stats` (aliased through, not
     copied-and-drifted); ``"serve"`` aggregates every live executor's queue
     depth and program cache on top of the shared metrics registry;
-    ``"counters"`` is the full process-wide counter map (includes
-    ``op_engine.align_resplits``, ``resharding.plan_hits`` / ``_misses``,
-    ``serve.*``).
+    ``"op_engine"`` carries the alignment counter plus the fusion engine's
+    figures (``"fusion"`` is exactly :func:`heat_tpu.core.fusion.stats`:
+    enabled flag, flush count, fused-op count, their ops-per-flush ratio,
+    and the fusion program cache); ``"counters"`` is the full process-wide
+    counter map (includes ``op_engine.align_resplits``,
+    ``op_engine.fusion_flushes`` / ``fusion_ops``, ``resharding.plan_hits``
+    / ``_misses``, ``serve.*``, ``fusion.program_*``).
     """
-    from ..core import resharding
+    from ..core import fusion, resharding
     from ..utils import metrics as _pm
 
     from . import executor as _executor
@@ -164,7 +168,8 @@ def runtime_stats() -> dict:
             queue_depth=depth, executors=n_exec, program_cache=cache_stats),
         "resharding": resharding.plan_cache_stats(),
         "op_engine": {
-            "align_resplits": int(counters.get("op_engine.align_resplits", 0))
+            "align_resplits": int(counters.get("op_engine.align_resplits", 0)),
+            "fusion": fusion.stats(),
         },
         "counters": counters,
     }

@@ -7,101 +7,28 @@ counters, and a hard observable for the steady-state guarantee that
 traffic triggers **zero recompiles** after warmup (asserted in
 ``tests/test_serve.py``, same spirit as ``RESPLIT_AUDIT.json``).
 
-Programs are ahead-of-time compiled (``jit(fn).lower(aval).compile()``) so
-the *compile* happens at cache-miss time — during warmup — and never
-inside a latency-sensitive request. Callables that cannot lower from
-abstract values alone fall back to the plain ``jax.jit`` wrapper (XLA's
-own shape-keyed cache then provides the same reuse; the counters still
-track bucket-level misses).
-
-Counters are mirrored into the process-wide registry
-(:mod:`heat_tpu.utils.metrics`: ``serve.program_hits`` /
-``serve.program_misses`` / ``serve.program_compiles``) so
-``ht.runtime_stats()`` sees every cache in one snapshot.
+The implementation was generalized into
+:mod:`heat_tpu.utils.program_cache` when the op-chain fusion engine
+(:mod:`heat_tpu.core.fusion`) needed the same contract; this module keeps
+every historical ``heat_tpu.serve.program_cache`` import path working AND
+pins the mirrored-counter namespace to ``serve.program_hits`` /
+``_misses`` / ``_compiles`` regardless of the cache's display name — the
+adapters build executors with per-model cache names ("transformer", the
+estimator class), and the ladder's per-test ``serve_program_compiles``
+log line (NEXT.md §2b correlation) must keep counting all of them under
+one family, as it always has.
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Any, Callable, Dict, Tuple
-
-import jax
-
-from ..utils import metrics as _metrics
+from ..utils.program_cache import ProgramCache as _ProgramCache
 
 __all__ = ["ProgramCache"]
 
 
-class ProgramCache:
-    """Shape-keyed cache of compiled serving programs."""
+class ProgramCache(_ProgramCache):
+    """Serving-path program cache: display name is per-model, counters
+    always aggregate under ``serve.program_*``."""
 
     def __init__(self, name: str = "serve", aot: bool = True):
-        self.name = name
-        self.aot = aot
-        self._programs: Dict[Tuple, Callable] = {}
-        self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-        self.compiles = 0
-
-    def get(self, fn: Callable, shape: Tuple[int, ...], dtype,
-            token: Any = ()) -> Callable:
-        """The compiled program for ``fn`` at input aval ``(shape, dtype)``.
-
-        ``token`` folds any extra identity into the key — executors pass
-        the mesh/communicator cache key, so the same callable served over
-        two meshes gets two programs.
-        """
-        key = (fn, tuple(int(s) for s in shape), str(dtype), token)
-        with self._lock:
-            prog = self._programs.get(key)
-            if prog is not None:
-                self.hits += 1
-                _metrics.inc("serve.program_hits")
-                return prog
-            self.misses += 1
-            _metrics.inc("serve.program_misses")
-        # compile OUTSIDE the lock: a multi-second XLA compile must not
-        # serialize unrelated lookups. A rare double-compile of the same
-        # key is benign (last writer wins; counters record both).
-        prog = self._compile(fn, shape, dtype)
-        with self._lock:
-            self._programs[key] = prog
-            self.compiles += 1
-        _metrics.inc("serve.program_compiles")
-        return prog
-
-    def _compile(self, fn, shape, dtype) -> Callable:
-        jitted = jax.jit(fn)
-        if self.aot:
-            try:
-                aval = jax.ShapeDtypeStruct(tuple(shape), dtype)
-                return jitted.lower(aval).compile()
-            except Exception:
-                # not lowerable from abstract avals (e.g. value-dependent
-                # python in fn) — the jit wrapper still shape-caches
-                pass
-        return jitted
-
-    def stats(self) -> dict:
-        """Plain-dict counters (folded into metrics snapshots)."""
-        with self._lock:
-            return {"hits": self.hits, "misses": self.misses,
-                    "compiles": self.compiles,
-                    "entries": len(self._programs)}
-
-    def reset(self) -> None:
-        with self._lock:
-            self._programs.clear()
-            self.hits = 0
-            self.misses = 0
-            self.compiles = 0
-
-    def __len__(self) -> int:
-        with self._lock:
-            return len(self._programs)
-
-    def __repr__(self) -> str:
-        s = self.stats()
-        return (f"ProgramCache({self.name!r}, entries={s['entries']}, "
-                f"hits={s['hits']}, misses={s['misses']})")
+        super().__init__(name=name, aot=aot, counter_prefix="serve")

@@ -1,0 +1,137 @@
+"""Shape-keyed cache of compiled XLA programs (process-wide pattern).
+
+Lifted out of ``heat_tpu/serve/program_cache.py`` (PR 2) once a second
+subsystem needed it: the serving executor caches one AOT program per
+``(callable, bucket shape, dtype, mesh)`` and the op-chain fusion engine
+(:mod:`heat_tpu.core.fusion`) caches one jitted program per chain
+signature. Both want the same contract — a bounded key space, explicit
+hit/miss/compile counters mirrored into the process-wide metrics registry
+(``<name>.program_hits`` / ``_misses`` / ``_compiles``), and the
+steady-state guarantee that repeat traffic triggers **zero recompiles**
+(asserted in ``tests/test_serve.py`` and ``tests/test_fusion.py``).
+
+Two entry points:
+
+* :meth:`ProgramCache.get` — the serving form: ahead-of-time compile
+  ``fn`` at one input aval (``jit(fn).lower(aval).compile()``), falling
+  back to the plain ``jax.jit`` wrapper for callables that cannot lower
+  from abstract values alone.
+* :meth:`ProgramCache.get_custom` — the general form: the caller brings
+  an arbitrary hashable key and a ``build()`` that returns the compiled
+  callable; the cache contributes lookup, locking and counters. The
+  fusion engine uses this (its key is a structural chain signature, and
+  its build step threads donation through ``jax.jit``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+
+from . import metrics as _metrics
+
+__all__ = ["ProgramCache"]
+
+
+class ProgramCache:
+    """Keyed cache of compiled programs with hit/miss/compile counters."""
+
+    def __init__(self, name: str = "programs", aot: bool = True,
+                 counter_prefix: str = None, max_entries: int = None):
+        self.name = name
+        self.aot = aot
+        # mirrored-counter namespace: defaults to the cache's own name, but
+        # a subsystem that aggregates many named caches under one counter
+        # family can pin it (the serving executors pin "serve" so
+        # ``serve.program_*`` counts every adapter's cache, as documented)
+        self.counter_prefix = counter_prefix or name
+        # entry cap for callers with an OPEN key space (the fusion engine:
+        # leaf shapes x chain signatures). None = unbounded, correct when
+        # the key space is finite by construction (the serve bucket
+        # ladder). Crossing the cap clears the table (coarse, like the
+        # aval memo) — counters survive, re-compiles are counted honestly.
+        self.max_entries = max_entries
+        self._programs: Dict[Tuple, Callable] = {}
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.compiles = 0
+
+    # ------------------------------------------------------------------ #
+    # generic form                                                       #
+    # ------------------------------------------------------------------ #
+    def get_custom(self, key, build: Callable[[], Callable]) -> Callable:
+        """The program stored under ``key``, building it on first miss.
+
+        ``build`` runs OUTSIDE the lock: a multi-second XLA compile must
+        not serialize unrelated lookups. A rare double-build of the same
+        key is benign (last writer wins; counters record both).
+        """
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                self.hits += 1
+                _metrics.inc(f"{self.counter_prefix}.program_hits")
+                return prog
+            self.misses += 1
+            _metrics.inc(f"{self.counter_prefix}.program_misses")
+        prog = build()
+        with self._lock:
+            if self.max_entries is not None and \
+                    len(self._programs) >= self.max_entries:
+                self._programs.clear()
+            self._programs[key] = prog
+            self.compiles += 1
+        _metrics.inc(f"{self.counter_prefix}.program_compiles")
+        return prog
+
+    # ------------------------------------------------------------------ #
+    # serving form (one input aval, AOT)                                 #
+    # ------------------------------------------------------------------ #
+    def get(self, fn: Callable, shape: Tuple[int, ...], dtype,
+            token: Any = ()) -> Callable:
+        """The compiled program for ``fn`` at input aval ``(shape, dtype)``.
+
+        ``token`` folds any extra identity into the key — executors pass
+        the mesh/communicator cache key, so the same callable served over
+        two meshes gets two programs.
+        """
+        key = (fn, tuple(int(s) for s in shape), str(dtype), token)
+        return self.get_custom(key, lambda: self._compile(fn, shape, dtype))
+
+    def _compile(self, fn, shape, dtype) -> Callable:
+        jitted = jax.jit(fn)
+        if self.aot:
+            try:
+                aval = jax.ShapeDtypeStruct(tuple(shape), dtype)
+                return jitted.lower(aval).compile()
+            except Exception:
+                # not lowerable from abstract avals (e.g. value-dependent
+                # python in fn) — the jit wrapper still shape-caches
+                pass
+        return jitted
+
+    def stats(self) -> dict:
+        """Plain-dict counters (folded into metrics snapshots)."""
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "compiles": self.compiles,
+                    "entries": len(self._programs)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._programs.clear()
+            self.hits = 0
+            self.misses = 0
+            self.compiles = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._programs)
+
+    def __repr__(self) -> str:
+        s = self.stats()
+        return (f"ProgramCache({self.name!r}, entries={s['entries']}, "
+                f"hits={s['hits']}, misses={s['misses']})")

@@ -117,6 +117,46 @@ def run_suite(n: int, timeout: float) -> dict:
     return rec
 
 
+# fast, numerically-loaded subset for the fusion on/off A/B: the op-engine
+# surface where deferred evaluation could drift from eager semantics
+_FUSION_AB_TESTS = [
+    "tests/test_operations.py", "tests/test_arithmetics.py",
+    "tests/test_fuzz_chains.py", "tests/test_rounding_exp_trig.py",
+    "tests/test_fusion.py",
+]
+
+
+def run_fusion_ab(n: int, timeout: float) -> dict:
+    """One suite leg with ``HEAT_TPU_FUSION=0`` vs ``1`` on a fast subset:
+    any test that passes eager but fails fused (or vice versa) is semantic
+    drift the fused engine introduced — exit-gating, like the serve smoke."""
+    legs = {}
+    for flag in ("0", "1"):
+        env = _env(n)
+        env["HEAT_TPU_FUSION"] = flag
+        t0 = time.time()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-m", "pytest", *_FUSION_AB_TESTS, "-q"],
+                env=env, capture_output=True, text=True, timeout=timeout,
+                cwd=_REPO)
+        except subprocess.TimeoutExpired:
+            legs[flag] = {"error": f"exceeded {timeout:.0f}s"}
+            continue
+        rec = {"rc": out.returncode, "wall_s": round(time.time() - t0, 1)}
+        m = _SUMMARY_RE.search(out.stdout)
+        if m:
+            failed, passed, skipped, errors, dur = m.groups()
+            rec.update(passed=int(passed), failed=int(failed or 0),
+                       skipped=int(skipped or 0), errors=int(errors or 0))
+        if out.returncode != 0:
+            rec["tail"] = out.stdout.strip().splitlines()[-15:]
+        legs[flag] = rec
+    return {"eager": legs.get("0"), "fused": legs.get("1"),
+            "agree": bool(legs.get("0", {}).get("rc") == 0
+                          and legs.get("1", {}).get("rc") == 0)}
+
+
 def run_examples(n: int, timeout: float) -> list:
     """Smoke-run every examples/ script end-to-end on an n-device mesh."""
     results = []
@@ -161,6 +201,13 @@ def main():
     ap.add_argument("--examples-timeout", type=float, default=600.0)
     ap.add_argument("--no-resplit-audit", action="store_true",
                     help="skip the collective_audit --resplit bounds check")
+    ap.add_argument("--fusion-ab", dest="fusion_ab", action="store_true",
+                    default=True,
+                    help="run the HEAT_TPU_FUSION=0 vs 1 A/B subset "
+                         "(default on)")
+    ap.add_argument("--no-fusion-ab", dest="fusion_ab", action="store_false",
+                    help="skip the fusion on/off semantic-drift A/B")
+    ap.add_argument("--fusion-ab-timeout", type=float, default=900.0)
     ap.add_argument("--serve-smoke", dest="serve_smoke", action="store_true",
                     default=True, help="run the serving smoke (default on)")
     ap.add_argument("--no-serve-smoke", dest="serve_smoke",
@@ -221,6 +268,16 @@ def main():
             serve_bad = True
         print(json.dumps({"serve_smoke_ok": not serve_bad}), flush=True)
 
+    fusion_bad = False
+    if args.fusion_ab and not args.examples_only:
+        # semantic-drift gate: the same fast, numerically-loaded subset
+        # must pass with the fused engine ON and OFF (4-device mesh)
+        print("=== fusion on/off A/B (4 devices) ===", flush=True)
+        ab = run_fusion_ab(4, args.fusion_ab_timeout)
+        artifact["fusion_ab"] = ab
+        fusion_bad = not ab.get("agree", False)
+        print(json.dumps({"fusion_ab_ok": not fusion_bad}), flush=True)
+
     audit_bad = False
     if not (args.no_resplit_audit or args.examples_only):
         # re-check the reshard planner's collective bounds every round:
@@ -252,7 +309,7 @@ def main():
     print(f"wrote {args.out}")
     bad = ([r for r in ladder if r.get("rc") != 0]
            + [r for r in ex if r.get("rc") != 0])
-    sys.exit(1 if bad or audit_bad or serve_bad else 0)
+    sys.exit(1 if bad or audit_bad or serve_bad or fusion_bad else 0)
 
 
 if __name__ == "__main__":

@@ -92,6 +92,15 @@ def pytest_runtest_teardown(item, nextitem):
             "plan_misses": int(c.get("resharding.plan_misses", 0)),
             "serve_program_compiles": int(c.get("serve.program_compiles", 0)),
             "align_resplits": int(c.get("op_engine.align_resplits", 0)),
+            # fusion engine: flush volume + program-cache growth ride next
+            # to the executable counters (NEXT.md §2b — fusion should LOWER
+            # the accumulated executable count; log it so the SIGABRT
+            # correlation data improves)
+            "fusion_flushes": int(c.get("op_engine.fusion_flushes", 0)),
+            "fusion_ops": int(c.get("op_engine.fusion_ops", 0)),
+            "fusion_program_compiles": int(
+                c.get("fusion.program_compiles", 0)),
+            "fusion_program_hits": int(c.get("fusion.program_hits", 0)),
         }
         with open(_LADDER_STATS, "a") as f:
             f.write(json.dumps(rec) + "\n")

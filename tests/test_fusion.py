@@ -1,0 +1,453 @@
+"""Fused lazy op-chain engine proofs (``heat_tpu/core/fusion.py``).
+
+Three pillars:
+
+* **Semantics** — a property sweep asserting fused == eager across splits
+  (None/0/1), dtypes (f32/bf16/int32), uneven gshapes, and chains ending
+  in split-axis reductions. Equality is BITWISE except for float chains
+  where XLA contracts a multiply feeding an add into an FMA (a single,
+  *more accurate* rounding the per-op dispatch cannot express — the
+  documented 1-ulp contract, ``doc/fusion.md``); those chains are pinned
+  at 2-ulp tolerance and every non-FMA chain stays bitwise.
+* **Flush discipline** — each materialization point (reduction, resplit,
+  ``numpy()``, printing, control-flow comparison, ``out=``/``where=``,
+  split-axis cum, tape-depth cap) flushes exactly once, counters asserted.
+* **The HLO/dispatch audit** — a fused split-preserving chain lowers to
+  ONE executable with ZERO collectives; a flush boundary that includes a
+  resplit adds exactly the reshard planner's collectives (one all-to-all
+  for split→split) and nothing else.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core import fusion, resharding
+from heat_tpu.utils import metrics as _metrics
+from heat_tpu.utils.hlo_audit import collective_stats
+
+from utils import all_splits
+
+
+def _counter(name):
+    return int(_metrics.counters().get(name, 0))
+
+
+def _flushes():
+    return _counter("op_engine.fusion_flushes")
+
+
+# --------------------------------------------------------------------- #
+# property sweep: fused == eager                                        #
+# --------------------------------------------------------------------- #
+# (label, chain, fma_prone): fma_prone marks chains containing a float
+# multiply whose result feeds an add/sub inside one flush — the only
+# construct where the fused program may differ from eager (by one FMA
+# rounding). Everything else must be bitwise.
+_CHAINS = [
+    ("unary_stack", lambda x: ht.tanh(ht.sin(x) * 0.5), False),
+    ("scalar_mix", lambda x: (ht.exp(x * 0.1) / 1.5) - 0.25, False),
+    ("self_binary", lambda x: ht.sqrt(abs(x * x) + 1.0), True),
+    ("mul_add_pair", lambda x: x * x + x, True),
+    ("long_unary", lambda x: ht.cos(ht.tanh(ht.sin(abs(x) + 1.0))), False),
+]
+
+_REDUCED = [
+    ("sum_split", lambda x: (ht.sin(x) + 1.0).sum(axis=0)),
+    ("max_split", lambda x: (x * 2.0 - 0.5).max(axis=0)),
+    ("sum_all", lambda x: (abs(x) + 0.5).sum()),
+]
+
+
+def _run(fn, data, split, enabled):
+    with fusion.override(enabled):
+        x = ht.array(data, split=split)
+        out = fn(x)
+        if enabled and isinstance(out, ht.DNDarray):
+            # results must still be pending when fusion recorded the chain
+            # end (reductions flush mid-chain by design)
+            pass
+        return out.numpy()
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int32"])
+@pytest.mark.parametrize("label,fn,fma", _CHAINS)
+def test_fused_equals_eager(label, fn, fma, dtype):
+    rng = np.random.default_rng(7)
+    shape = (13, 5)  # uneven along every split at any device count > 1
+    if dtype == "int32":
+        data = rng.integers(-40, 40, shape).astype(np.int32)
+        fn_ = lambda x: (x * 3 + 1) - (x * 2)  # int chain: exact always
+        fma = False
+    else:
+        data = rng.standard_normal(shape).astype(
+            jnp.bfloat16 if dtype == "bfloat16" else np.float32)
+        fn_ = fn
+    for split in all_splits(len(shape)):
+        eager = _run(fn_, data, split, False)
+        fused = _run(fn_, data, split, True)
+        assert eager.dtype == fused.dtype and eager.shape == fused.shape
+        if not fma:
+            assert np.array_equal(
+                np.asarray(eager, np.float64), np.asarray(fused, np.float64),
+            ), f"{label} split={split} {dtype} not bitwise"
+        else:
+            # FMA contraction: one rounding instead of two — pin to 2 ulp
+            e64 = np.asarray(eager, np.float64)
+            f64 = np.asarray(fused, np.float64)
+            eps = np.finfo(np.asarray(eager).dtype).eps if dtype != "bfloat16" \
+                else float(jnp.finfo(jnp.bfloat16).eps)
+            np.testing.assert_allclose(
+                f64, e64, rtol=2 * eps, atol=2 * eps,
+                err_msg=f"{label} split={split} {dtype} beyond FMA tolerance")
+
+
+@pytest.mark.parametrize("label,fn", _REDUCED)
+def test_chain_into_split_reduction(label, fn):
+    """Chains ENDING in split-axis reductions: the reduction flushes the
+    chain, then applies the neutral-element padding fill on the evaluated
+    physical array — padding discipline survives fusion bitwise."""
+    rng = np.random.default_rng(11)
+    for shape in [(11, 3), (8, 4), (29,)]:
+        data = rng.standard_normal(shape).astype(np.float32)
+        for split in all_splits(len(shape)):
+            eager = _run(fn, data, split, False)
+            fused = _run(fn, data, split, True)
+            assert np.array_equal(eager, fused), \
+                f"{label} shape={shape} split={split} not bitwise"
+
+
+def test_uneven_bf16_binary_mixed_splits():
+    """Cross-split binary alignment inside a chain: the alignment resplit
+    materializes the operand (a planner program), and the surviving
+    elementwise tail still fuses — results equal eager bitwise."""
+    rng = np.random.default_rng(3)
+    data_a = rng.standard_normal((10, 6)).astype(np.float32)
+    data_b = rng.standard_normal((10, 6)).astype(np.float32)
+
+    def chain(a, b):
+        return ht.tanh(a + b) * 2.0
+
+    with fusion.override(False):
+        eager = chain(ht.array(data_a, split=0), ht.array(data_b, split=1)).numpy()
+    with fusion.override(True):
+        fused = chain(ht.array(data_a, split=0), ht.array(data_b, split=1)).numpy()
+    assert np.array_equal(eager, fused)
+
+
+def test_replicated_operand_pad_in_chain():
+    """A replicated row-vector operand against a split-0 matrix whose
+    split axis is padded: the physical pad is recorded as a chain node and
+    the fused result matches eager bitwise."""
+    rng = np.random.default_rng(5)
+    m = rng.standard_normal((7, 4)).astype(np.float32)   # 7 uneven on 2/4/8
+    row = rng.standard_normal((4,)).astype(np.float32)
+    col = rng.standard_normal((7, 1)).astype(np.float32)
+
+    def chain(x):
+        y = x + ht.array(row)            # replicated, no pad needed
+        z = y * ht.array(col, split=0)   # split-0 col vec, padded axis
+        return ht.tanh(z)
+
+    with fusion.override(False):
+        eager = chain(ht.array(m, split=0)).numpy()
+    with fusion.override(True):
+        fused = chain(ht.array(m, split=0)).numpy()
+    assert np.array_equal(eager, fused)
+
+
+# --------------------------------------------------------------------- #
+# flush-trigger matrix                                                  #
+# --------------------------------------------------------------------- #
+def _pending_chain():
+    x = ht.array(np.linspace(0.5, 2.0, 12, dtype=np.float32).reshape(6, 2),
+                 split=0)
+    y = ht.sin(x) * 2.0 + 0.25
+    assert y._lazy_node is not None, "chain should be pending"
+    return x, y
+
+
+@pytest.mark.parametrize("trigger,act", [
+    ("numpy", lambda x, y: y.numpy()),
+    ("print", lambda x, y: str(y)),
+    ("reduce", lambda x, y: y.sum().numpy()),
+    ("resplit", lambda x, y: y.resplit(None).larray),
+    ("bool_compare", lambda x, y: bool((y.sum() > -1e9).item())),
+    ("out_kwarg", lambda x, y: ht.add(y, 1.0, out=ht.zeros_like(x))),
+    ("cum_split_axis", lambda x, y: ht.cumsum(y, 0).larray),
+    ("item_scalar", lambda x, y: float(y[0, 0])),
+])
+def test_flush_trigger_matrix(trigger, act):
+    """Each materialization point flushes the pending chain exactly once;
+    re-materializing is free (no second flush)."""
+    with fusion.override(True):
+        x, y = _pending_chain()
+        before = _flushes()
+        act(x, y)
+        mid = _flushes()
+        assert mid - before >= 1, f"{trigger} did not flush"
+        chain_flushes = mid - before
+        # the chain itself must have flushed as ONE program; triggers may
+        # legitimately add flushes for arrays THEY create (e.g. out=)
+        assert y._lazy_node is None or y._lazy_node.value is not None
+        y.larray  # already materialized: no further flush for y
+        assert _flushes() == mid or trigger in ("out_kwarg",), \
+            f"{trigger} reflushed a materialized chain"
+        assert chain_flushes <= 2
+
+
+def test_tape_depth_cap_flushes_once():
+    """A chain longer than HEAT_TPU_FUSION_MAX_OPS splits into exactly two
+    programs: one auto-flush at the cap, one at materialization."""
+    with fusion.override(True):
+        x = ht.array(np.ones((8, 2), dtype=np.float32), split=0)
+        before = _flushes()
+        y = x
+        for _ in range(fusion.stats()["max_ops"] + 2):
+            y = y * 1.0
+        mid = _flushes()
+        assert mid - before == 1, "depth cap should force one early flush"
+        y.numpy()
+        assert _flushes() - mid == 1
+
+
+def test_shared_subchain_single_evaluation():
+    """A node shared by two live chains is promoted to a program output on
+    the first flush and reused (not recomputed) by the second."""
+    with fusion.override(True):
+        x = ht.array(np.arange(8, dtype=np.float32), split=0)
+        base = ht.exp(x * 0.01)          # shared subchain
+        a = base + 1.0
+        b = base * 3.0
+        before_ops = _counter("op_engine.fusion_ops")
+        a.numpy()
+        mid_ops = _counter("op_engine.fusion_ops")
+        b.numpy()
+        end_ops = _counter("op_engine.fusion_ops")
+        # flushing a evaluated {mul, exp, add} = 3 ops; b then only {mul}
+        assert mid_ops - before_ops == 3
+        assert end_ops - mid_ops == 1
+        np.testing.assert_allclose(
+            b.numpy(),
+            np.exp(np.arange(8, dtype=np.float32) * np.float32(0.01)) *
+            np.float32(3.0), rtol=1e-6)
+
+
+def test_where_out_distributed_alignment():
+    """Satellite regression: ``where=`` masks that are DNDarrays with a
+    DIFFERENT split than ``out`` must select correctly (uneven gshape so
+    the physical layouts genuinely disagree), and the alignment is counted
+    in ``op_engine.align_resplits``."""
+    n, m = 7, 6  # 7 is uneven on every multi-device mesh
+    rng = np.random.default_rng(9)
+    a = rng.standard_normal((n, m)).astype(np.float32)
+    b = rng.standard_normal((n, m)).astype(np.float32)
+    mask = rng.integers(0, 2, (n, m)).astype(bool)
+    expected = np.where(mask, a + b, 0.0).astype(np.float32)
+
+    for mask_split, out_split in [(0, 1), (1, 0), (0, None), (None, 0)]:
+        before = _counter("op_engine.align_resplits")
+        out = ht.zeros((n, m), dtype=ht.float32, split=out_split)
+        ht.add(ht.array(a, split=0), ht.array(b, split=0), out=out,
+               where=ht.array(mask, split=mask_split))
+        got = out.numpy()
+        assert np.array_equal(got, expected), \
+            f"where mask split={mask_split} out split={out_split}"
+        if mask_split != out_split:
+            assert _counter("op_engine.align_resplits") > before, \
+                "mask alignment resplit not counted"
+
+
+def test_out_alignment_counted():
+    before = _counter("op_engine.align_resplits")
+    x = ht.array(np.ones((6, 4), dtype=np.float32), split=0)
+    out = ht.zeros((6, 4), dtype=ht.float32, split=1)
+    ht.add(x, x, out=out)
+    assert _counter("op_engine.align_resplits") > before
+    assert np.array_equal(out.numpy(), np.full((6, 4), 2.0, np.float32))
+
+
+# --------------------------------------------------------------------- #
+# HLO / dispatch audit                                                  #
+# --------------------------------------------------------------------- #
+def test_fused_chain_one_executable_zero_collectives():
+    """A split-preserving fused chain lowers to ONE executable whose
+    optimized HLO contains ZERO collectives — fusion must never introduce
+    communication the explicit planner did not place."""
+    fusion.reset()
+    fusion.capture_hlo(True)
+    try:
+        with fusion.override(True):
+            x = ht.array(np.linspace(0, 1, 26, dtype=np.float32).reshape(13, 2),
+                         split=0)
+            compiles0 = fusion.program_cache().stats()["compiles"]
+            flushes0 = _flushes()
+            y = ht.tanh(ht.exp(ht.sin(x) * 0.5 + 0.1) / 1.5) - 0.25
+            y.numpy()
+            stats = fusion.program_cache().stats()
+            assert _flushes() - flushes0 == 1, "chain must flush once"
+            assert stats["compiles"] - compiles0 == 1, \
+                "chain must lower to ONE executable"
+            hlo = fusion.last_hlo()
+            assert hlo is not None
+            assert collective_stats(hlo) == {}, \
+                f"fused chain emitted collectives: {collective_stats(hlo)}"
+    finally:
+        fusion.capture_hlo(False)
+
+
+def test_flush_boundary_with_resplit_exact_planner_collectives():
+    """A chain consumed by a resplit: the chain flushes as one
+    zero-collective program, and the data motion is exactly the planner's
+    (split→split = ONE all-to-all, audited from the planner's own HLO)."""
+    if ht.get_comm().size == 1:
+        pytest.skip("needs a multi-device mesh")
+    fusion.reset()
+    fusion.capture_hlo(True)
+    try:
+        with fusion.override(True):
+            x = ht.array(np.arange(48, dtype=np.float32).reshape(12, 4),
+                         split=0)
+            y = ht.sin(x) * 2.0 + 1.0
+            assert y._lazy_node is not None
+            z = y.resplit(1)  # materialization point + planner program
+            chain_hlo = fusion.last_hlo()
+            assert chain_hlo is not None
+            assert collective_stats(chain_hlo) == {}
+            assert resharding.plan_kind(y.gshape, 0, 1, y.comm) == "all_to_all"
+            fn = resharding.planned_reshard_fn(
+                y.larray.shape, jnp.dtype(jnp.float32), y.gshape, 0, 1, y.comm)
+            stats = collective_stats(fn.lower(y.larray).compile().as_text())
+            kinds = set(stats)
+            assert kinds == {"all-to-all"}, f"planner emitted {stats}"
+            assert stats["all-to-all"]["count"] == 1
+            with fusion.override(False):
+                x2 = ht.array(np.arange(48, dtype=np.float32).reshape(12, 4),
+                              split=0)
+                eager = (ht.sin(x2) * 2.0 + 1.0).resplit(1).numpy()
+            np.testing.assert_array_equal(z.numpy(), eager)
+    finally:
+        fusion.capture_hlo(False)
+
+
+def test_program_cache_steady_state_zero_recompiles():
+    """Repeat chains hit the fusion program cache: after the first flush,
+    the same chain signature triggers zero new compiles."""
+    with fusion.override(True):
+        data = np.random.default_rng(0).standard_normal((16, 4)).astype(np.float32)
+        x = ht.array(data, split=0)
+        chain = lambda a: ht.tanh(a * 0.5 + 1.0) - 0.25  # >= MIN_OPS ops
+        chain(x).numpy()  # warm
+        compiles0 = fusion.program_cache().stats()["compiles"]
+        hits0 = fusion.program_cache().stats()["hits"]
+        for _ in range(4):
+            chain(x).numpy()
+        s = fusion.program_cache().stats()
+        assert s["compiles"] == compiles0, "steady-state recompile"
+        assert s["hits"] >= hits0 + 4
+
+
+# --------------------------------------------------------------------- #
+# donation analysis                                                     #
+# --------------------------------------------------------------------- #
+def test_donation_analysis_only_dead_leaves():
+    """The donation analysis must veto every leaf that anything outside
+    the tape still references, and (when enabled) claim rebinding chains
+    whose input is provably dead."""
+    from heat_tpu.core.fusion import _donatable, _Leaf  # noqa: F401
+
+    a = jnp.ones((64,), jnp.float32)
+    keep = a  # second external reference
+    leaves = [a]
+    assert _donatable(leaves, [1]) == (), "referenced leaf must not donate"
+    del keep
+    # now: `a` local + leaves entry + occurs bookkeeping -> still alive
+    assert _donatable(leaves, [1]) == ()
+
+
+def test_rebinding_chain_correct_after_flush():
+    """x = f(x) rebinding chains (the donation fast path) stay correct:
+    the flushed result matches eager even though the original buffer was
+    eligible for donation."""
+    data = np.random.default_rng(1).standard_normal((32, 4)).astype(np.float32)
+    with fusion.override(False):
+        e = ht.array(data, split=0)
+        for _ in range(4):
+            e = ht.tanh(e * 0.9)
+        eager = e.numpy()
+    with fusion.override(True):
+        x = ht.array(data, split=0)
+        for _ in range(4):
+            x = ht.tanh(x * 0.9)  # drops every prior reference
+        fused = x.numpy()
+    assert np.array_equal(eager, fused)
+
+
+def test_short_chain_inline_replay_bitwise_no_programs():
+    """Chains below HEAT_TPU_FUSION_MIN_OPS replay op-by-op at flush: no
+    per-chain executable is compiled (XLA's shared op cache serves them)
+    and the result is bitwise-eager even for FMA-prone op pairs."""
+    rng = np.random.default_rng(2)
+    data = rng.standard_normal((9, 5)).astype(np.float32)
+    with fusion.override(False):
+        eager = (ht.array(data, split=0) * ht.array(data, split=0)
+                 + ht.array(data, split=0)).numpy()
+    compiles0 = fusion.program_cache().stats()["compiles"]
+    inline0 = _counter("op_engine.fusion_inline_flushes")
+    with fusion.override(True):
+        x = ht.array(data, split=0)
+        y = x * x + x  # 2 ops < MIN_OPS, and the FMA-prone pair
+        assert y._lazy_node is not None
+        fused = y.numpy()
+    assert fusion.program_cache().stats()["compiles"] == compiles0, \
+        "short chain must not compile a per-signature program"
+    assert _counter("op_engine.fusion_inline_flushes") == inline0 + 1
+    assert np.array_equal(eager, fused), "inline replay must be bitwise-eager"
+
+
+def test_kwargs_key_type_aware_no_dtype_aliasing():
+    """Regression: ``0`` / ``0.0`` / ``False`` compare (and hash) equal in
+    python, so a naive kwargs key would let ht.clip(x, 0.0, 10.0) seed a
+    cache entry that ht.clip(x, 0, 10) then reuses — returning floats for
+    an int array (or, on short chains, a DNDarray whose dtype metadata
+    disagrees with its buffer). Keys must be type-aware."""
+    data = np.array([1, 3, 5, 7], np.int32)
+    with fusion.override(True):
+        x = ht.array(data, split=0)
+        # long chain (compiled path): float bounds first, then int bounds
+        f_float = ht.sqrt(ht.clip(x * 1 + 0, 0.0, 10.0) * 1.0)
+        f_float.numpy()
+        r_int = ht.clip(x * 1 + 0, 0, 10) * 1
+        assert r_int.dtype == ht.int32 or str(r_int.dtype).startswith("int"), \
+            f"int clip aliased to float program: {r_int.dtype}"
+        out = r_int.numpy()
+        assert out.dtype.kind == "i", out.dtype
+        assert np.array_equal(out, data)
+        # short chain (inline path): metadata must match the buffer
+        s_float = ht.clip(x, 0.0, 10.0)
+        s_float.numpy()
+        s_int = ht.clip(x, 0, 10)
+        assert np.asarray(s_int.numpy()).dtype.kind == "i"
+        assert str(s_int.dtype.jax_type()) == str(np.asarray(s_int.numpy()).dtype), \
+            "dtype metadata disagrees with buffer"
+
+
+def test_fusion_opt_out_env(monkeypatch):
+    """HEAT_TPU_FUSION=0 semantics via set_enabled: no recording, chains
+    behave exactly as the eager engine."""
+    with fusion.override(False):
+        x = ht.array(np.ones((4, 2), np.float32), split=0)
+        y = ht.sin(x) * 2.0
+        assert y._lazy_node is None
+
+
+def test_runtime_stats_exposes_fusion():
+    s = ht.runtime_stats()
+    f = s["op_engine"]["fusion"]
+    assert set(f) >= {"enabled", "flushes", "fused_ops", "ops_per_flush",
+                      "program_cache"}
+    assert f["program_cache"]["misses"] >= 0
+    assert s["counters"].get("op_engine.fusion_flushes", 0) == f["flushes"]
