@@ -438,16 +438,22 @@ def _fusion_bench_main() -> None:
     the 4-device CPU mesh this process was launched onto (a dispatch-
     overhead figure, pinned to the virtual CPU mesh like the serve stage).
 
-    Two workloads, each timed eager (``HEAT_TPU_FUSION`` off) vs fused:
+    Three workloads, each timed eager (``HEAT_TPU_FUSION`` off) vs fused:
 
     * a 16-op elementwise chain on a split-0 ``(n, 64)`` f32 array — the
       ISSUE's headline shape: 16 dispatches + 15 materialized
       intermediates eager, ONE cached program fused;
     * a kmeans-style mixed chain (binary ops against a replicated row,
       scalar rescales, unary transcendentals) ending in a split-axis
-      reduction — the flush-at-reduction production pattern.
+      reduction — since PR 4 the reduction fuses INTO the program;
+    * a reduction-terminated chain proper (``fusion_reduce_chain_*``):
+      center → square → rescale → split-axis ``sum`` → normalize, i.e.
+      the ``ht.mean((x-mu)**2)`` moment shape — eager pays the elementwise
+      programs plus a separate reduce program and a full-size HBM
+      intermediate; fused it is ONE program whose elementwise values never
+      leave registers before the shard-local reduce.
 
-    Prints ONE JSON line with both speedups and the fusion program-cache
+    Prints ONE JSON line with the speedups and the fusion program-cache
     stats proving the steady state runs zero recompiles.
     """
     import jax
@@ -497,6 +503,16 @@ def _fusion_bench_main() -> None:
         t = abs(t) + 0.125
         return t.sum(axis=0)
 
+    def reduce_chain(a):
+        # the ht.mean((x-mu)**2) moment shape: elementwise chain whose ONLY
+        # consumer is a split-axis reduction — the tape folds the mask,
+        # the shard-local reduce and the one psum into the same program
+        t = (a - row) * 0.5
+        t = t * t
+        t = t + 1.0
+        t = t * w
+        return t.sum(axis=0) * (1.0 / n)
+
     def timed(build, reps: int) -> float:
         out = build(x)  # compile + warm (cache miss lands here)
         jax.block_until_ready(out.larray)
@@ -508,7 +524,8 @@ def _fusion_bench_main() -> None:
 
     record = {"fusion_devices": comm.size, "fusion_n": n}
     for label, build, reps in (("chain16", chain16, 30),
-                               ("kmeans_mixed", kmeans_mixed, 30)):
+                               ("kmeans_mixed", kmeans_mixed, 30),
+                               ("reduce_chain", reduce_chain, 30)):
         with fusion.override(False):
             t_eager = min(timed(build, reps) for _ in range(2))
         with fusion.override(True):
@@ -520,10 +537,12 @@ def _fusion_bench_main() -> None:
         cstats0 = fusion.program_cache().stats()
         for _ in range(5):
             jax.block_until_ready(chain16(x).larray)
+            jax.block_until_ready(reduce_chain(x).larray)
         cstats = fusion.program_cache().stats()
     record["fusion_steady_misses"] = cstats["misses"] - cstats0["misses"]
     record["fusion_program_cache"] = cstats
     record["fusion_ops_per_flush"] = fusion.stats()["ops_per_flush"]
+    record["fusion_reduce_flushes"] = fusion.stats()["reduce_flushes"]
     print(json.dumps(record), flush=True)
 
 

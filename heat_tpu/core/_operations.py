@@ -284,27 +284,38 @@ def __reduce_op(
     ``psum`` over the mesh. The only extra step is neutral-element masking of
     the canonical padding (the reference's empty-shard neutral fill,
     ``:402-411``, plays the same role).
+
+    Without an ``out=`` buffer the reduction is *recorded* onto the fusion
+    tape (:func:`heat_tpu.core.fusion.record_reduce`): the whole
+    elementwise chain feeding it — mask, shard-local reduce and the one
+    collective included — compiles as a single program at the next
+    materialization point, and the full-size elementwise intermediate
+    never reaches HBM.
     """
     sanitation.sanitize_in(x)
     axis = sanitize_axis(x.shape, axis)
     axes = tuple(range(x.ndim)) if axis is None else ((axis,) if isinstance(axis, int) else axis)
 
     touches_split = x.split is not None and (axis is None or x.split in axes)
-    physical = x.filled(neutral) if touches_split and x.pad else x.larray
-
-    res = partial_op(physical, axis=(None if axis is None else axes), keepdims=keepdims, **kwargs)
-
-    if x.split is None:
+    if x.split is None or touches_split:
         out_split = None
-    elif touches_split:
-        out_split = None
+    elif keepdims:
+        out_split = x.split
     else:
-        if keepdims:
-            out_split = x.split
-        else:
-            out_split = x.split - sum(1 for a in axes if a < x.split)
-
+        out_split = x.split - sum(1 for a in axes if a < x.split)
     gshape = _reduced_shape(x.shape, axes if axis is not None else None, keepdims)
+
+    if out is None:
+        from . import fusion
+
+        lazy = fusion.record_reduce(x, partial_op, neutral, axis, axes,
+                                    keepdims, touches_split, gshape,
+                                    out_split, kwargs)
+        if lazy is not None:
+            return lazy
+
+    physical = x.filled(neutral) if touches_split and x.pad else x.larray
+    res = partial_op(physical, axis=(None if axis is None else axes), keepdims=keepdims, **kwargs)
     result = DNDarray(
         res, gshape, types.canonical_heat_type(res.dtype), out_split, x.device, x.comm
     )

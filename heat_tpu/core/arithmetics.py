@@ -302,7 +302,9 @@ power = pow
 
 def prod(a: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:
     """Product reduction (reference ``:902``): local product + ``psum``-style
-    all-multiply when the split axis is reduced."""
+    all-multiply when the split axis is reduced. Records onto the fusion
+    tape (no ``pprod`` primitive exists, so the flush compiles the chain
+    as one GSPMD program rather than an explicit shard_map collective)."""
     if keepdim is not None:  # reference/torch keyword name
         keepdims = keepdim
     return _operations._reduce_op(a, jnp.prod, 1, axis=axis, out=out, keepdims=keepdims)
@@ -325,7 +327,10 @@ subtract = sub
 def sum(a: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:  # noqa: A001
     """Sum reduction (reference ``:946``): the canonical local-reduce +
     ``Allreduce`` stack of the reference (``_operations.py:440-445``) becomes
-    one XLA program with a ``psum`` over the mesh."""
+    one XLA program with a ``psum`` over the mesh — and the whole
+    elementwise chain feeding it fuses into that same program
+    (:func:`heat_tpu.core.fusion.record_reduce`), independent sums sharing
+    one packed all-reduce."""
     if keepdim is not None:  # reference/torch keyword name
         keepdims = keepdim
     return _operations._reduce_op(a, jnp.sum, 0, axis=axis, out=out, keepdims=keepdims)

@@ -200,11 +200,15 @@ class DNDarray:
     def filled(self, fill_value):
         """Physical array with padding overwritten by ``fill_value``.
 
-        The mandatory pre-step for any op that reads across the split axis
-        (reduce with its neutral element, sort with ±inf, matmul with 0).
-        XLA fuses the select into the consumer. A materialization point:
-        any pending fused chain flushes here, so the neutral-element select
-        always reads the evaluated physical array.
+        The mandatory pre-step for any *eager* op that reads across the
+        split axis (sort with ±inf, matmul with 0, reductions running with
+        ``out=`` or under ``HEAT_TPU_FUSION_REDUCE=0``). Recorded
+        reductions carry the same select as a tape **mask node** instead
+        (:func:`heat_tpu.core.fusion.record_reduce`), so the fill fuses
+        into the one flush program. XLA fuses the select into the
+        consumer. A materialization point: any pending fused chain flushes
+        here, so the neutral-element select always reads the evaluated
+        physical array.
         """
         p = self.larray
         if self.pad == 0:
@@ -524,9 +528,16 @@ class DNDarray:
         return self.numpy().tolist()
 
     def item(self):
-        """Scalar extraction, global sync point (reference ``:520-544``)."""
+        """Scalar extraction, global sync point (reference ``:520-544``).
+
+        The common producer is now a recorded reduction: a 0-d pending
+        result flushes its whole chain here as one program (mask +
+        shard-local reduce + collective included) and fetches a scalar —
+        no logical-view slicing on the hot path."""
         if self.size != 1:
             raise ValueError("only one-element DNDarrays can be converted to scalars")
+        if self.ndim == 0:
+            return self.larray.item()  # 0-d carries no padding to strip
         return self._logical().reshape(()).item()
 
     def __bool__(self) -> bool:

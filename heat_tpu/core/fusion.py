@@ -12,17 +12,39 @@ the whole chain as ONE jitted program.
 Materialization points (flush triggers)
 ---------------------------------------
 Everything in the codebase reads the physical array through
-``DNDarray.larray``, so the property is the single choke point: reductions
-(``filled``/``larray``), resplits and split-changing ops, ``out=`` /
-``where=`` (the op engine falls back to eager there), ``.numpy()`` /
-``__array__`` / ``item()`` / printing, comparisons used in control flow
-(``__bool__``), and the tape-depth cap (``HEAT_TPU_FUSION_MAX_OPS``,
-default 32). Padding discipline survives by construction: recorded nodes
-never read across the split axis — any op that would (reduction, cum over
-the split axis, alignment resplit) materializes its inputs first, so
+``DNDarray.larray``, so the property is the single choke point: resplits
+and split-changing ops, ``out=`` / ``where=`` (the op engine falls back to
+eager there), ``.numpy()`` / ``__array__`` / ``item()`` / printing,
+comparisons used in control flow (``__bool__``), and the tape-depth cap
+(``HEAT_TPU_FUSION_MAX_OPS``, default 32). Padding discipline survives by
+construction: recorded nodes never read across the split axis *blindly* —
+a reduction records a neutral-element **mask node** over the canonical
+padding first (the tape form of ``DNDarray.filled``), a cum over the
+split axis or an alignment resplit materializes its inputs first, so
 collective placement stays exactly where the explicit resharding planner
 (arXiv:2112.01075) put it, and fused programs for split-preserving chains
 lower with ZERO collectives (audited in ``tests/test_fusion.py``).
+
+Reduction nodes (terminal collectives on the tape)
+--------------------------------------------------
+``__reduce_op`` (sum/prod/max/min/any/all and the mean/var/std/norm
+family built on them) records a **reduce node** instead of forcing
+``filled()``-materialization. A flush whose DAG contains a reduce node
+over the split axis compiles the whole chain as ONE ``shard_map`` program
+— elementwise chain on shard-local blocks, neutral-element pad masking
+(global-index iota, reusing the pad bookkeeping so uneven gshapes stay
+correct), shard-local reduce, then one ``lax.psum``/``pmax``/``pmin``.
+Mutually independent same-kind reductions in one DAG (weighted average's
+``sum(x*w)``/``sum(w)``, single-pass var's ``sum(x²)``/``sum(x)``) are
+packed into ONE flattened collective per phase, so XLA emits exactly one
+(tuple-fused) all-reduce and the O(n) elementwise intermediate never
+exists. Heat itself merges split-axis reductions into a single MPI
+Allreduce (arXiv:2007.13552); folding the combiner into the collective is
+where the traffic win lives (arXiv:2004.09362). Reduce tapes the
+translator cannot prove shard_map-safe (unregistered combiner such as
+``prod``, exotic operand layouts) still fuse as one ``jax.jit`` program
+with GSPMD-placed collectives — never eagerly. Opt-out:
+``HEAT_TPU_FUSION_REDUCE=0`` restores the eager ``filled()`` flush.
 
 Program identity and caching
 ----------------------------
@@ -83,6 +105,8 @@ __all__ = [
     "record_unary",
     "record_binary",
     "record_cum",
+    "record_reduce",
+    "register_reduce_collective",
     "program_cache",
     "stats",
     "reset",
@@ -103,6 +127,10 @@ _MAX_OPS = int(os.environ.get("HEAT_TPU_FUSION_MAX_OPS", "32"))
 # chains once each, where per-chain executables are pure compile-time loss
 _MIN_OPS = int(os.environ.get("HEAT_TPU_FUSION_MIN_OPS", "4"))
 _DONATE = _env_on("HEAT_TPU_FUSION_DONATE")
+# escape hatch for the reduction-node extension alone: with 0, reductions
+# flush their input tape and dispatch eagerly (the pre-reduction-fusion
+# behavior), while elementwise recording stays on
+_REDUCE = _env_on("HEAT_TPU_FUSION_REDUCE")
 
 _PROGRAMS = None  # lazy singleton (utils imports back into core)
 
@@ -190,23 +218,34 @@ def last_hlo() -> Optional[str]:
 class _Leaf:
     """A concrete physical array entering a chain, plus a weakref to the
     DNDarray that owned it at record time (None for scalar constants) —
-    the donation analysis input."""
+    the donation analysis input. ``split`` is the owner's split axis at
+    record time (the shard_map translator's layout source of truth)."""
 
-    __slots__ = ("array", "owner")
+    __slots__ = ("array", "owner", "split")
 
-    def __init__(self, array, owner=None):
+    def __init__(self, array, owner=None, split=None):
         self.array = array
         self.owner = owner
+        self.split = split
 
 
 class _Node:
     """One recorded op. ``args`` are ``_Node`` / ``_Leaf`` handles;
     ``kwargs`` are static (hashability enforced at record time). ``value``
     is set once a flush evaluates the node (it then acts as a leaf for any
-    later chain that still references it)."""
+    later chain that still references it).
+
+    ``kind``/``split``/``rmeta``/``comm`` drive the shard_map translation
+    of reduce-containing tapes: ``kind`` is ``"ew"`` (elementwise/cum/
+    astype), ``"pad"`` (replicated-operand physical pad), ``"mask"``
+    (neutral-element padding fill), or ``"reduce"``; ``split`` is the
+    physical split axis of the node's VALUE; ``rmeta`` holds the reduce
+    metadata (collective kind, whether the split axis is reduced, the
+    input split); ``comm`` is set on reduce nodes only."""
 
     __slots__ = ("fn", "args", "kwargs", "kwargs_key", "aval", "depth",
-                 "owner", "ext_refs", "value", "__weakref__")
+                 "owner", "ext_refs", "value", "kind", "split", "rmeta",
+                 "comm", "__weakref__")
 
     def __init__(self, fn, args, kwargs, kwargs_key, aval, depth):
         self.fn = fn
@@ -218,6 +257,30 @@ class _Node:
         self.owner = None       # weakref.ref(DNDarray) once wrapped
         self.ext_refs = 0       # times used as an argument of another node
         self.value = None       # concrete result once evaluated
+        self.kind = "ew"
+        self.split = None
+        self.rmeta = None
+        self.comm = None
+
+
+# partial_op -> collective kind ("psum"/"pmax"/"pmin"); a registered None
+# means "no collective primitive exists" (prod): the tape still records,
+# and the flush compiles ONE jax.jit program whose collective GSPMD places
+_COLLECTIVE: Dict[Any, Optional[str]] = {}
+
+
+def register_reduce_collective(fn, kind: Optional[str]) -> None:
+    """Declare the mesh collective that combines ``fn``'s shard-local
+    partials (``"psum"``/``"pmax"``/``"pmin"``, or None for ops without a
+    collective primitive). Ops modules register their partial reducers at
+    import (``jnp.sum`` etc. are pre-registered below)."""
+    _COLLECTIVE[fn] = kind
+
+
+register_reduce_collective(jnp.sum, "psum")
+register_reduce_collective(jnp.max, "pmax")
+register_reduce_collective(jnp.min, "pmin")
+register_reduce_collective(jnp.prod, None)  # no pprod primitive: GSPMD path
 
 
 def _key_val(v):
@@ -284,12 +347,12 @@ def _handle_of(x) -> Optional[object]:
     node = x._lazy_node
     if node is not None:
         if node.value is not None:
-            return _Leaf(node.value, node.owner)
+            return _Leaf(node.value, node.owner, node.split)
         return node
     arr = x._phys_or_none()
     if arr is None or isinstance(arr, jax.core.Tracer):
         return None
-    return _Leaf(arr, weakref.ref(x))
+    return _Leaf(arr, weakref.ref(x), x.split)
 
 
 def _descr(h) -> tuple:
@@ -380,7 +443,7 @@ def _flushed_handle(h):
     if isinstance(h, _Node) and h.value is None:
         _flush(h)
     if isinstance(h, _Node):
-        return _Leaf(h.value, h.owner)
+        return _Leaf(h.value, h.owner, h.split)
     return h
 
 
@@ -413,6 +476,7 @@ def record_unary(operation, x, kwargs) -> Optional[object]:
     node = _make_node(operation, kwargs, (h,), x._phys_shape())
     if node is None:
         return None
+    node.split = x.split
     return _wrap(node, x.gshape, x.split, x.device, x.comm)
 
 
@@ -445,6 +509,13 @@ def record_binary(operation, t1, t2, fn_kwargs, pad1, pad2,
             return h
         hp = _make_node(_pad_op, {"cfg": tuple(tuple(p) for p in pad_cfg)},
                         (h,), _padded_shape(h, pad_cfg))
+        if hp is not None:
+            # the padded operand aligns with the split operand: to the
+            # shard_map translator its value is sharded along the axis the
+            # pad extended (pad-to-physical, then slice the local block)
+            hp.kind = "pad"
+            hp.split = next(i for i, p in enumerate(pad_cfg)
+                            if tuple(p) != (0, 0))
         return hp
 
     h1 = handle(t1, pad1)
@@ -456,6 +527,7 @@ def record_binary(operation, t1, t2, fn_kwargs, pad1, pad2,
     node = _make_node(operation, fn_kwargs, (h1, h2), expected)
     if node is None:
         return None
+    node.split = out_split
     return _wrap(node, out_shape, out_split, device, comm)
 
 
@@ -483,6 +555,7 @@ def record_astype(x, heat_dtype) -> Optional[object]:
                       (h,), x._phys_shape())
     if node is None:
         return None
+    node.split = x.split
     return _wrap(node, x.gshape, x.split, x.device, x.comm)
 
 
@@ -500,6 +573,7 @@ def record_cum(x, partial_op, axis, dtype) -> Optional[object]:
     node = _make_node(partial_op, {"axis": axis}, (h,), x._phys_shape())
     if node is None:
         return None
+    node.split = x.split
     if dtype is not None:
         from . import types
 
@@ -508,8 +582,63 @@ def record_cum(x, partial_op, axis, dtype) -> Optional[object]:
                            x._phys_shape())
         if node2 is None:
             return None
+        node2.split = x.split
         node = node2
     return _wrap(node, x.gshape, x.split, x.device, x.comm)
+
+
+def _mask_pad(a, axis, n, fill):
+    """Module-level (stable identity) neutral-element fill of the padding
+    beyond logical length ``n`` along ``axis`` — the tape form of
+    ``DNDarray.filled``. Global semantics: the shard_map translator swaps
+    in a per-shard version whose iota carries the block's global offset."""
+    iota = jax.lax.broadcasted_iota(jnp.int32, a.shape, axis)
+    return jnp.where(iota < n, a, jnp.asarray(fill, a.dtype))
+
+
+def record_reduce(x, partial_op, neutral, axis, axes, keepdims,
+                  touches_split, gshape, out_split, kwargs) -> Optional[object]:
+    """Lazy form of ``__reduce_op`` (no ``out=``): a neutral-element mask
+    node over the canonical padding (when the reduction reads across a
+    padded split axis) followed by a terminal reduce node. The flush
+    compiles elementwise chain → mask → shard-local reduce → one grouped
+    collective as ONE program (:func:`_plan_sm`)."""
+    if not _ENABLED or not _REDUCE:
+        return None
+    h = _handle_of(x)
+    if h is None:
+        return None
+    phys_in = x._phys_shape()
+    if touches_split and x.pad:
+        try:
+            hash(neutral)
+        except TypeError:
+            return None
+        h = _make_node(_mask_pad,
+                       {"axis": int(x.split), "n": int(x.gshape[x.split]),
+                        "fill": neutral}, (h,), phys_in)
+        if h is None:
+            return None
+        h.kind = "mask"
+        h.split = x.split
+    rkw = dict(kwargs)
+    rkw["axis"] = None if axis is None else axes
+    rkw["keepdims"] = keepdims
+    if axis is None:
+        expected = (1,) * len(phys_in) if keepdims else ()
+    elif keepdims:
+        expected = tuple(1 if i in axes else s for i, s in enumerate(phys_in))
+    else:
+        expected = tuple(s for i, s in enumerate(phys_in) if i not in axes)
+    node = _make_node(partial_op, rkw, (h,), expected)
+    if node is None:
+        return None
+    node.kind = "reduce"
+    node.split = out_split
+    node.rmeta = {"collective": _COLLECTIVE.get(partial_op),
+                  "touches": bool(touches_split), "in_split": x.split}
+    node.comm = x.comm
+    return _wrap(node, gshape, out_split, x.device, x.comm)
 
 
 # ---------------------------------------------------------------------- #
@@ -610,13 +739,15 @@ def _flush(root: _Node) -> None:
 
 def _flush_locked(root: _Node) -> None:
     order, in_refs = _topo(root)
+    has_reduce = any(n.kind == "reduce" for n in order)
 
     if len(order) < _MIN_OPS and not _capture_hlo:
-        _flush_inline(order)
+        _flush_inline(order, has_reduce)
         return
 
     leaves = []        # unique concrete arrays, first-encounter order
     leaf_slot = {}     # id(array) -> slot
+    leaf_splits = []   # recorded split axis per slot (shard_map in_specs)
     leaf_occurs = []   # in-tape _Leaf/value holders per slot
     leaf_owner_dead = []
     plan = []          # (fn, codes, kwargs) per node
@@ -631,14 +762,15 @@ def _flush_locked(root: _Node) -> None:
                 codes.append((0, index[id(h)]))
                 continue
             if isinstance(h, _Node):
-                arr, owner, from_node = h.value, h.owner, True
+                arr, owner, split, from_node = h.value, h.owner, h.split, True
             else:
-                arr, owner, from_node = h.array, h.owner, False
+                arr, owner, split, from_node = h.array, h.owner, h.split, False
             slot = leaf_slot.get(id(arr))
             if slot is None:
                 slot = len(leaves)
                 leaf_slot[id(arr)] = slot
                 leaves.append(arr)
+                leaf_splits.append(split)
                 leaf_occurs.append(0)
                 leaf_owner_dead.append(True)
             leaf_occurs[slot] += 1
@@ -659,29 +791,56 @@ def _flush_locked(root: _Node) -> None:
             out_idx.append(pos)
     out_idx = tuple(out_idx)
 
-    donate = tuple(j for j in _donatable(leaves, leaf_occurs)
-                   if leaf_owner_dead[j])
+    touching = [n for n in order
+                if n.kind == "reduce" and n.rmeta["touches"]]
+    comm = touching[0].comm if touching else None
+    sm = None
+    if touching:
+        sm = _plan_sm(order, plan, leaves, leaf_splits, out_idx, comm)
+    if has_reduce:
+        # reduce-carrying tapes compile without donation (documented
+        # contract, doc/fusion.md): the program is shard_map-shaped or
+        # collective-carrying and its outputs are reduced-size, so buffer
+        # reuse buys nothing — and donated inputs would complicate the
+        # packed-collective body for zero win
+        donate = ()
+    else:
+        donate = tuple(j for j in _donatable(leaves, leaf_occurs)
+                       if leaf_owner_dead[j])
 
     # mesh identity rides in through the per-leaf sharding strings (axis
     # layout + device kind); ``jax.jit`` itself re-lowers per concrete
     # input sharding, so a signature collision across distinct device sets
-    # degrades to an internal recompile, never a wrong program
+    # degrades to an internal recompile, never a wrong program. The
+    # recorded split axes join the key because they pick the shard_map
+    # in_specs; the reduce mode and comm identity key the collective form.
     leaf_descrs = tuple(
         (tuple(a.shape), str(a.dtype), bool(a.aval.weak_type),
-         str(a.sharding))
-        for a in leaves)
+         str(a.sharding), leaf_splits[j])
+        for j, a in enumerate(leaves))
     key = (leaf_descrs, tuple(sig_nodes), out_idx, donate)
+    if touching:
+        key = key + (("sm" if sm is not None else "gspmd"), comm.cache_key)
 
     def build():
-        def replay(*leaf_vals):
-            vals = []
-            for fn, codes, kwargs in plan:
-                args = [vals[i] if tag == 0 else leaf_vals[i]
-                        for tag, i in codes]
-                vals.append(fn(*args, **kwargs))
-            return tuple(vals[i] for i in out_idx)
+        if sm is not None:
+            replay = _sm_body(plan, sm, out_idx, comm)
+            from ._compat import shard_map
 
-        jitted = jax.jit(replay, donate_argnums=donate)
+            sched, instrs, phases, in_specs, out_specs = sm
+            fn = shard_map(replay, mesh=comm.mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+            jitted = jax.jit(fn)
+        else:
+            def replay(*leaf_vals):
+                vals = []
+                for fn, codes, kwargs in plan:
+                    args = [vals[i] if tag == 0 else leaf_vals[i]
+                            for tag, i in codes]
+                    vals.append(fn(*args, **kwargs))
+                return tuple(vals[i] for i in out_idx)
+
+            jitted = jax.jit(replay, donate_argnums=donate)
         if _capture_hlo:
             global _last_hlo
             try:
@@ -698,6 +857,8 @@ def _flush_locked(root: _Node) -> None:
     m = _metrics()
     m.inc("op_engine.fusion_flushes")
     m.inc("op_engine.fusion_ops", len(order))
+    if has_reduce:
+        m.inc("op_engine.fusion_reduce_flushes")
 
     for pos, res in zip(out_idx, results):
         node = order[pos]
@@ -712,11 +873,190 @@ def _flush_locked(root: _Node) -> None:
         node.kwargs = {}
 
 
-def _flush_inline(order) -> None:
+# collective kind -> jax.lax combiner over the mesh axis
+_COLL_FNS = {"psum": jax.lax.psum, "pmax": jax.lax.pmax,
+             "pmin": jax.lax.pmin}
+
+
+def _plan_sm(order, plan, leaves, leaf_splits, out_idx, comm):
+    """Translate a reduce-carrying tape into a shard_map execution plan, or
+    None when the tape is not provably block-safe (the caller then
+    compiles the global replay under plain ``jax.jit`` and GSPMD places
+    the collectives — still one program, just not hand-placed).
+
+    The plan tracks each value's layout state (split axis or replicated),
+    schedules nodes into **phases** so that mutually independent split-axis
+    reductions land in the same phase (one packed collective per
+    ``(phase, kind, dtype)`` — the fused tuple all-reduce), and notes where
+    a replicated operand must be sliced to the local block.
+
+    Returns ``(sched, instrs, phases, in_specs, out_specs)``.
+    """
+    size = comm.size
+    states = []   # split axis of each produced value (None = replicated)
+    instrs = []   # per node: ("ew", blocks) | ("pad", ax) | ("mask",)
+                  #           | ("reduce", collective-or-None)
+    phases = []   # emission phase per node (barrier between phases)
+
+    def state_of(tag, i):
+        return states[i] if tag == 0 else leaf_splits[i]
+
+    def shape_of(tag, i):
+        return (tuple(order[i].aval.shape) if tag == 0
+                else tuple(leaves[i].shape))
+
+    for pos, node in enumerate(order):
+        _, codes, kwargs = plan[pos]
+        phase = 0
+        for tag, i in codes:
+            if tag == 0:
+                p = phases[i]
+                inner = order[i]
+                if inner.kind == "reduce" and inner.rmeta["touches"]:
+                    p += 1  # consumes a combined value: next phase
+                phase = max(phase, p)
+        if node.kind == "reduce":
+            m = node.rmeta
+            (tag, i), = codes
+            if m["touches"]:
+                if m["collective"] is None or node.comm is not comm:
+                    return None
+                if state_of(tag, i) != m["in_split"]:
+                    return None
+            elif state_of(tag, i) != m["in_split"]:
+                return None
+            instrs.append(("reduce", m["collective"] if m["touches"] else None))
+        elif node.kind == "mask":
+            (tag, i), = codes
+            if state_of(tag, i) != kwargs["axis"] or node.split != kwargs["axis"]:
+                return None
+            instrs.append(("mask",))
+        elif node.kind == "pad":
+            (tag, i), = codes
+            if state_of(tag, i) is not None or node.split is None:
+                return None
+            instrs.append(("pad", node.split))
+        else:
+            k = node.split
+            nshape = tuple(node.aval.shape)
+            blocks = []
+            for ci, (tag, i) in enumerate(codes):
+                s = state_of(tag, i)
+                oshape = shape_of(tag, i)
+                offset = len(nshape) - len(oshape)
+                if s is None:
+                    if k is not None:
+                        ax = k - offset
+                        if ax >= 0 and oshape[ax] == nshape[k] \
+                                and nshape[k] != 1:
+                            blocks.append((ci, ax))
+                elif k is None or s + offset != k or oshape[s] != nshape[k]:
+                    return None  # layout the block model cannot express
+            instrs.append(("ew", tuple(blocks)))
+        states.append(node.split)
+        phases.append(phase)
+
+    for a, s in zip(leaves, leaf_splits):
+        if s is None:
+            continue
+        if a.ndim <= s or a.shape[s] == 0 or a.shape[s] % size != 0:
+            return None
+        if getattr(getattr(a, "sharding", None), "mesh", None) != comm.mesh:
+            return None  # foreign-mesh leaf: let GSPMD sort the layout out
+
+    # stable phase-major topological schedule: same-phase touching reduces
+    # become one packed collective at the phase barrier
+    sched = sorted(range(len(order)), key=lambda p: (phases[p], p))
+    in_specs = tuple(comm.spec(a.ndim, s)
+                     for a, s in zip(leaves, leaf_splits))
+    out_specs = tuple(comm.spec(len(order[p].aval.shape), states[p])
+                      for p in out_idx)
+    return sched, instrs, phases, in_specs, out_specs
+
+
+def _sm_body(plan, sm, out_idx, comm):
+    """The shard_map replay body for a :func:`_plan_sm` plan: every value
+    is a shard-local block (replicated values are full arrays), reduce
+    partials accumulate per phase and combine in ONE flattened collective
+    per ``(kind, dtype)`` at each phase barrier."""
+    sched, instrs, phases, _, _ = sm
+    axn = comm.axis_name
+    size = comm.size
+
+    def body(*leaf_vals):
+        vals = [None] * len(plan)
+        pend = {}  # pos -> collective kind (partials awaiting combine)
+
+        def emit_all():
+            groups: Dict[Tuple, list] = {}
+            for pos2, kind in pend.items():
+                groups.setdefault((kind, jnp.dtype(vals[pos2].dtype)),
+                                  []).append(pos2)
+            pend.clear()
+            for (kind, _dt), members in groups.items():
+                coll = _COLL_FNS[kind]
+                if len(members) == 1:
+                    p2 = members[0]
+                    vals[p2] = coll(vals[p2], axn)
+                    continue
+                packed = jnp.concatenate([vals[p2].reshape(-1)
+                                          for p2 in members])
+                combined = coll(packed, axn)
+                off = 0
+                for p2 in members:
+                    shp = vals[p2].shape
+                    n = 1
+                    for s in shp:
+                        n *= s
+                    vals[p2] = combined[off:off + n].reshape(shp)
+                    off += n
+
+        def block(a, ax):
+            chunk = a.shape[ax] // size
+            return jax.lax.dynamic_slice_in_dim(
+                a, jax.lax.axis_index(axn) * chunk, chunk, axis=ax)
+
+        cur = 0
+        for pos in sched:
+            if phases[pos] != cur:
+                emit_all()
+                cur = phases[pos]
+            fn, codes, kwargs = plan[pos]
+            args = [vals[i] if tag == 0 else leaf_vals[i]
+                    for tag, i in codes]
+            ins = instrs[pos]
+            op = ins[0]
+            if op == "ew":
+                for ci, ax in ins[1]:
+                    args[ci] = block(args[ci], ax)
+                vals[pos] = fn(*args, **kwargs)
+            elif op == "pad":
+                vals[pos] = block(fn(*args, **kwargs), ins[1])
+            elif op == "mask":
+                a = args[0]
+                kax = kwargs["axis"]
+                start = jax.lax.axis_index(axn) * a.shape[kax]
+                iota = jax.lax.broadcasted_iota(jnp.int32, a.shape, kax) \
+                    + start
+                vals[pos] = jnp.where(iota < kwargs["n"], a,
+                                      jnp.asarray(kwargs["fill"], a.dtype))
+            else:  # reduce: shard-local partial, combined at the barrier
+                vals[pos] = fn(*args, **kwargs)
+                if ins[1] is not None:
+                    pend[pos] = ins[1]
+        emit_all()
+        return tuple(vals[i] for i in out_idx)
+
+    return body
+
+
+def _flush_inline(order, has_reduce: bool = False) -> None:
     """Evaluate a short chain op-by-op (children first — ``order`` is
     post-order): each dispatch reuses XLA's per-op executable cache, which
     every other chain in the process shares. Values land on every node, so
-    later chains referencing them see leaves."""
+    later chains referencing them see leaves. Reduce and mask nodes carry
+    global semantics, so the eager dispatch (GSPMD collective placement)
+    is exactly the pre-recording behavior."""
     for node in order:
         args = [h.value if isinstance(h, _Node) else h.array
                 for h in node.args]
@@ -728,6 +1068,8 @@ def _flush_inline(order) -> None:
     m.inc("op_engine.fusion_flushes")
     m.inc("op_engine.fusion_ops", len(order))
     m.inc("op_engine.fusion_inline_flushes")
+    if has_reduce:
+        m.inc("op_engine.fusion_reduce_flushes")
     for node in order:
         node.args = ()
         node.kwargs = {}
@@ -743,8 +1085,10 @@ def stats() -> dict:
     ops = int(c.get("op_engine.fusion_ops", 0))
     return {
         "enabled": _ENABLED,
+        "reduce_enabled": _REDUCE,
         "flushes": flushes,
         "inline_flushes": int(c.get("op_engine.fusion_inline_flushes", 0)),
+        "reduce_flushes": int(c.get("op_engine.fusion_reduce_flushes", 0)),
         "fused_ops": ops,
         "ops_per_flush": round(ops / flushes, 3) if flushes else 0.0,
         "max_ops": _MAX_OPS,

@@ -32,6 +32,29 @@ __all__ = [
 ]
 
 
+def _all_op(a, axis=None, keepdims=False):
+    """Module-level partial reducer (stable identity: it must be
+    recordable onto the fusion tape, where a per-call lambda would compile
+    one pinned executable per invocation)."""
+    return jnp.all(a != 0, axis=axis, keepdims=keepdims)
+
+
+def _any_op(a, axis=None, keepdims=False):
+    return jnp.any(a != 0, axis=axis, keepdims=keepdims)
+
+
+def _register_collectives() -> None:
+    # shard-local all/any partials combine with pmin/pmax over bool — the
+    # reference's Allreduce(LAND/LOR) as one grouped mesh collective
+    from . import fusion
+
+    fusion.register_reduce_collective(_all_op, "pmin")
+    fusion.register_reduce_collective(_any_op, "pmax")
+
+
+_register_collectives()
+
+
 def all(x: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDarray:  # noqa: A001
     """Test whether all elements evaluate True (reference ``logical.py:38``):
     local reduce + ``Allreduce(LAND)`` in the reference, one fused reduce
@@ -39,8 +62,7 @@ def all(x: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDar
     if keepdim is not None:  # reference/torch keyword name
         keepdims = keepdim
     return _operations._reduce_op(
-        x, lambda a, axis=None, keepdims=False: jnp.all(a != 0, axis=axis, keepdims=keepdims),
-        1, axis=axis, out=out, keepdims=keepdims,
+        x, _all_op, 1, axis=axis, out=out, keepdims=keepdims,
     )
 
 
@@ -55,8 +77,7 @@ def any(x: DNDarray, axis=None, out=None, keepdims=False, keepdim=None) -> DNDar
     if keepdim is not None:  # reference/torch keyword name
         keepdims = keepdim
     return _operations._reduce_op(
-        x, lambda a, axis=None, keepdims=False: jnp.any(a != 0, axis=axis, keepdims=keepdims),
-        0, axis=axis, out=out, keepdims=keepdims,
+        x, _any_op, 0, axis=axis, out=out, keepdims=keepdims,
     )
 
 

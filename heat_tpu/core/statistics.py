@@ -147,16 +147,26 @@ def average(x: DNDarray, axis=None, weights=None, returned=False):
         axis = sanitize_axis(x.shape, axis)
         if not isinstance(axis, int):
             raise NotImplementedError("weighted average over multiple axes is not supported")
-        if weights.ndim == 1 and weights.shape[0] == x.shape[axis]:
+        if weights.shape == x.shape:
+            w = weights
+        elif weights.ndim != 1 or weights.shape[0] != x.shape[axis]:
+            # numpy's exact contract (2.x wording): unequal shapes are
+            # legal ONLY for 1-D weights along the reduced axis
+            raise ValueError(
+                "Shape of weights must be consistent with shape of x "
+                "along specified axis.")
+        else:
+            # classic 1-D weights along the reduced axis
             shape = [1] * x.ndim
             shape[axis] = x.shape[axis]
             w = weights.reshape(tuple(shape))
-        elif weights.shape == x.shape:
-            w = weights
-        else:
-            raise ValueError("Length of weights not compatible with specified axis.")
         num = arithmetics.sum(arithmetics.mul(x, w), axis=axis)
-        den = arithmetics.sum(w, axis=axis) if w.shape == x.shape else arithmetics.sum(weights)
+        # denominator: the aligned ``w`` summed along ``axis`` (numpy's
+        # scl). Same elements as the old raw-``weights`` fallback, but the
+        # axis-shaped form keeps ``returned=True`` broadcasting uniform
+        # and records onto the SAME fusion tape as ``num`` — one flush,
+        # one packed all-reduce for the pair
+        den = arithmetics.sum(w, axis=axis)
     zero = bool((den == 0).any().item()) if isinstance(den, DNDarray) else den == 0
     if zero:
         raise ZeroDivisionError("Weights sum to zero, can't be normalized")
@@ -538,15 +548,14 @@ def skew(x: DNDarray, axis=None, unbiased: bool = True) -> DNDarray:
     return g
 
 
+def _ipow_op(a, k):
+    return a ** k
+
+
 def _central_moment(x: DNDarray, k: int, axis):
-    mu = mean(x, axis)
-    if axis is not None:
-        ax = sanitize_axis(x.shape, axis)
-        shape = list(x.shape)
-        shape[ax] = 1
-        mu = mu.reshape(tuple(shape))
+    mu = _mean_keepdims(x, axis)
     centered = arithmetics.sub(x, mu)
-    powed = _operations._local_op(lambda a: a ** k, centered)
+    powed = _operations._local_op(_ipow_op, centered, k=k)
     return mean(powed, axis)
 
 
@@ -1078,9 +1087,24 @@ def std(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
     return exponential.sqrt(var(x, axis, ddof=ddof, **kwargs))
 
 
+def _mean_keepdims(x: DNDarray, axis) -> DNDarray:
+    """Mean with the reduced axes kept as size-1 — a *recorded* reduction
+    (keepdims sum + scalar div) instead of the eager sum → ``reshape``
+    round-trip, so var/std/skew/kurtosis stay on ONE fusion tape and both
+    of their reductions compile into a single program with a grouped
+    collective. Values are identical to ``mean(x, axis).reshape(...)``
+    (same sum, same divisor, no data motion)."""
+    if axis is None:
+        return mean(x, None)
+    n = int(np.prod([x.shape[a] for a in _axes(x, axis)]))
+    s = arithmetics.sum(x, axis=axis, keepdims=True)
+    return arithmetics.div(s, float(n) if n else 1.0)
+
+
 def var(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
     """Variance (reference ``statistics.py:1979``): two-pass masked global
-    moments instead of per-rank moment merging."""
+    moments instead of per-rank moment merging. Both passes record onto
+    the fusion tape, so ``ht.var(x)`` materializes as one program."""
     if not isinstance(ddof, int):
         raise ValueError(f"ddof must be integer, is {type(ddof)}")
     if ddof < 0:
@@ -1088,11 +1112,7 @@ def var(x: DNDarray, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
     # heat compatibility: bessel kwarg
     if kwargs.get("bessel") is True:
         ddof = 1
-    mu = mean(x, axis)
-    if axis is not None:
-        ax = _axes(x, axis)
-        shape = tuple(1 if i in ax else s for i, s in enumerate(x.shape))
-        mu = mu.reshape(shape)
+    mu = _mean_keepdims(x, axis)
     centered = arithmetics.sub(x, mu)
     sq = _operations._local_op(jnp.square, centered)
     s = arithmetics.sum(sq, axis=axis)

@@ -20,7 +20,7 @@ import re
 from typing import Dict
 
 __all__ = ["collective_stats", "total_collective_bytes", "memory_stats",
-           "COLLECTIVES"]
+           "entry_root_shapes", "COLLECTIVES"]
 
 COLLECTIVES = (
     "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
@@ -70,6 +70,57 @@ def collective_stats(hlo: str) -> Dict[str, Dict[str, int]]:
 
 def total_collective_bytes(stats: Dict[str, Dict[str, int]]) -> int:
     return sum(v["bytes"] for v in stats.values())
+
+
+_ROOT_ASSIGN_RE = re.compile(r"^\s*ROOT\s+%?[\w.\-]+\s*=\s*")
+
+
+def _result_segment(rest: str) -> str:
+    """The result-type portion at the start of ``rest`` (text after the
+    ``=``): either one balanced parenthesized tuple type — a depth counter,
+    because TPU tiled layouts like ``f32[8]{1,0:T(8,128)}`` nest parens a
+    naive ``\\([^)]*\\)`` regex would stop at — or the single type token."""
+    if not rest.startswith("("):
+        return rest.split("(", 1)[0]
+    depth = 0
+    for i, ch in enumerate(rest):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return rest[: i + 1]
+    return rest
+
+
+def entry_root_shapes(hlo: str):
+    """``[(dtype, numel), ...]`` of the ENTRY computation's ROOT result —
+    one entry per tuple element (or a single entry for a non-tuple root).
+
+    The reduction-fusion audit uses this to assert a fused
+    reduction-terminated chain materializes ONLY reduced outputs: no
+    full-size elementwise intermediate may survive as a program output.
+    """
+    in_entry = False
+    for line in hlo.splitlines():
+        stripped = _COMMENT_RE.sub("", line)
+        if stripped.startswith("ENTRY"):
+            in_entry = True
+            continue
+        if not in_entry:
+            continue
+        m = _ROOT_ASSIGN_RE.match(stripped)
+        if m is None:
+            continue
+        out = []
+        for dt, dims in _SHAPE_RE.findall(_result_segment(stripped[m.end():])):
+            n = 1
+            for piece in dims.split(","):
+                if piece:
+                    n *= int(piece)
+            out.append((dt, n))
+        return out
+    return []
 
 
 def memory_stats(compiled) -> Dict[str, int]:

@@ -118,11 +118,19 @@ def run_suite(n: int, timeout: float) -> dict:
 
 
 # fast, numerically-loaded subset for the fusion on/off A/B: the op-engine
-# surface where deferred evaluation could drift from eager semantics
+# surface where deferred evaluation could drift from eager semantics.
+# The reduction-heavy slice (statistics + nan-reductions + the distributed
+# statistics module) exercises the PR 4 reduction-fused tapes — the per-test
+# HEAT_TPU_LADDER_STATS log carries fusion_reduce_flushes next to the
+# executable counters so the A/B shows which tests actually took the
+# collective-fused path
 _FUSION_AB_TESTS = [
     "tests/test_operations.py", "tests/test_arithmetics.py",
     "tests/test_fuzz_chains.py", "tests/test_rounding_exp_trig.py",
     "tests/test_fusion.py",
+    # reduction-heavy slice
+    "tests/test_statistics.py", "tests/test_nan_reductions.py",
+    "tests/test_statistics_distributed.py",
 ]
 
 

@@ -97,6 +97,8 @@ def pytest_runtest_teardown(item, nextitem):
             # the accumulated executable count; log it so the SIGABRT
             # correlation data improves)
             "fusion_flushes": int(c.get("op_engine.fusion_flushes", 0)),
+            "fusion_reduce_flushes": int(
+                c.get("op_engine.fusion_reduce_flushes", 0)),
             "fusion_ops": int(c.get("op_engine.fusion_ops", 0)),
             "fusion_program_compiles": int(
                 c.get("fusion.program_compiles", 0)),

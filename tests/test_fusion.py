@@ -107,17 +107,23 @@ def test_fused_equals_eager(label, fn, fma, dtype):
 
 @pytest.mark.parametrize("label,fn", _REDUCED)
 def test_chain_into_split_reduction(label, fn):
-    """Chains ENDING in split-axis reductions: the reduction flushes the
-    chain, then applies the neutral-element padding fill on the evaluated
-    physical array — padding discipline survives fusion bitwise."""
+    """Chains ENDING in split-axis reductions now fuse INTO the program
+    (mask node + shard-local reduce + one collective): results match eager
+    under the documented FMA/psum-reassociation contract — the fused
+    program may contract a float mul→add pair into an FMA the eager
+    dispatch cannot express, so float chains pin at a few-ulp allclose and
+    everything else stays exact."""
     rng = np.random.default_rng(11)
     for shape in [(11, 3), (8, 4), (29,)]:
         data = rng.standard_normal(shape).astype(np.float32)
         for split in all_splits(len(shape)):
             eager = _run(fn, data, split, False)
             fused = _run(fn, data, split, True)
-            assert np.array_equal(eager, fused), \
-                f"{label} shape={shape} split={split} not bitwise"
+            np.testing.assert_allclose(
+                np.asarray(fused, np.float64), np.asarray(eager, np.float64),
+                rtol=4 * np.finfo(np.float32).eps,
+                atol=4 * np.finfo(np.float32).eps,
+                err_msg=f"{label} shape={shape} split={split}")
 
 
 def test_uneven_bf16_binary_mixed_splits():
@@ -447,7 +453,301 @@ def test_fusion_opt_out_env(monkeypatch):
 def test_runtime_stats_exposes_fusion():
     s = ht.runtime_stats()
     f = s["op_engine"]["fusion"]
-    assert set(f) >= {"enabled", "flushes", "fused_ops", "ops_per_flush",
-                      "program_cache"}
+    assert set(f) >= {"enabled", "reduce_enabled", "flushes", "fused_ops",
+                      "ops_per_flush", "reduce_flushes", "program_cache"}
     assert f["program_cache"]["misses"] >= 0
     assert s["counters"].get("op_engine.fusion_flushes", 0) == f["flushes"]
+
+
+# --------------------------------------------------------------------- #
+# reduction-fused tapes                                                 #
+# --------------------------------------------------------------------- #
+# (label, chain): every chain ends in a reduction that is recorded onto
+# the tape — sum/max/min/prod/any/all and the mean/var family over them
+_REDUCE_CHAINS = [
+    ("sum_axis", lambda x, ax, kd: (ht.sin(x) * 0.5 + 1.0).sum(
+        axis=ax, keepdims=kd)),
+    ("max_axis", lambda x, ax, kd: (abs(x) + 0.25).max(
+        axis=ax, keepdims=kd)),
+    ("min_axis", lambda x, ax, kd: (x * 0.75 - 0.125).min(
+        axis=ax, keepdims=kd)),
+    ("prod_axis", lambda x, ax, kd: ht.prod(
+        abs(x) + 0.5, axis=ax, keepdims=kd)),
+]
+
+
+# int legs per reduction kind: the bitwise contract must cover psum, pmax,
+# pmin AND the prod GSPMD-fallback path, not just sum (values bounded so
+# the 13-element int32 product cannot overflow)
+_INT_REDUCE_CHAINS = {
+    "sum_axis": lambda x, ax, kd: (x * 3 + 1).sum(axis=ax, keepdims=kd),
+    "max_axis": lambda x, ax, kd: (x * 2 - 1).max(axis=ax, keepdims=kd),
+    "min_axis": lambda x, ax, kd: (x * 2 + 1).min(axis=ax, keepdims=kd),
+    "prod_axis": lambda x, ax, kd: ht.prod(x % 3 + 1, axis=ax,
+                                           keepdims=kd),
+}
+
+
+def _reduce_eps(dtype):
+    if dtype == "bfloat16":
+        return float(jnp.finfo(jnp.bfloat16).eps)
+    return float(np.finfo(np.float32).eps)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int32"])
+@pytest.mark.parametrize("label,fn", _REDUCE_CHAINS)
+def test_reduce_sweep_fused_equals_eager(label, fn, dtype):
+    """Property sweep for reduction-terminated chains: fused == eager
+    across splits None/0/1, axis None/0/1, keepdims on/off, uneven
+    gshapes. BITWISE for int dtypes; floats pin to the documented
+    FMA/psum-reassociation contract (the fused program evaluates the
+    identical shard-local-reduce + all-reduce decomposition, but XLA may
+    contract mul→add pairs and fuse the producer differently)."""
+    rng = np.random.default_rng(23)
+    shape = (13, 5)  # uneven along both axes at any device count > 1
+    if dtype == "int32":
+        data = rng.integers(-4, 5, shape).astype(np.int32)
+        fn_ = _INT_REDUCE_CHAINS[label]
+    else:
+        data = rng.standard_normal(shape).astype(
+            jnp.bfloat16 if dtype == "bfloat16" else np.float32)
+        fn_ = fn
+    for split in all_splits(len(shape)):
+        for ax in (None, 0, 1):
+            for kd in (False, True):
+                eager = _run(lambda t: fn_(t, ax, kd), data, split, False)
+                fused = _run(lambda t: fn_(t, ax, kd), data, split, True)
+                assert eager.dtype == fused.dtype
+                assert eager.shape == fused.shape
+                if dtype == "int32":
+                    assert np.array_equal(eager, fused), \
+                        f"{label} split={split} ax={ax} kd={kd} not bitwise"
+                else:
+                    eps = _reduce_eps(dtype)
+                    np.testing.assert_allclose(
+                        np.asarray(fused, np.float64),
+                        np.asarray(eager, np.float64),
+                        rtol=8 * eps, atol=8 * eps,
+                        err_msg=f"{label} split={split} ax={ax} kd={kd}")
+
+
+@pytest.mark.parametrize("redfn", [ht.any, ht.all])
+def test_bool_reduce_fused_equals_eager(redfn):
+    """any/all record with pmax/pmin-over-bool collectives — results are
+    bitwise (bool) across splits and axes, uneven gshape."""
+    rng = np.random.default_rng(3)
+    data = (rng.standard_normal((11, 6)) > 0.7).astype(np.float32)
+    for split in all_splits(2):
+        for ax in (None, 0, 1):
+            chain = lambda t: redfn((t * 2.0 + 0.0) > 1.0, axis=ax)
+            eager = _run(chain, data, split, False)
+            fused = _run(chain, data, split, True)
+            assert eager.dtype == fused.dtype
+            assert np.array_equal(eager, fused), f"split={split} ax={ax}"
+
+
+def test_mean_var_std_fused_equals_eager():
+    """The mean/var/std family rides recorded reductions (keepdims sums,
+    no mid-chain reshape flush): one flush per statistic, values within
+    the numerics contract."""
+    rng = np.random.default_rng(5)
+    data = rng.standard_normal((13, 5)).astype(np.float32)
+    for stat in (lambda t: ht.mean(t), lambda t: ht.var(t),
+                 lambda t: ht.std(t), lambda t: ht.var(t, axis=0),
+                 lambda t: ht.mean(t, axis=1), lambda t: ht.var(t, ddof=1)):
+        for split in all_splits(2):
+            eager = _run(stat, data, split, False)
+            fused = _run(stat, data, split, True)
+            np.testing.assert_allclose(
+                np.asarray(fused, np.float64), np.asarray(eager, np.float64),
+                rtol=1e-5, atol=1e-6, err_msg=f"split={split}")
+
+
+def test_var_single_flush_program():
+    """ht.var(x) — two dependent reductions and their elementwise glue —
+    materializes as ONE flush (one program), not a flush per pass."""
+    with fusion.override(True):
+        x = ht.array(np.random.default_rng(0).standard_normal(
+            (16, 4)).astype(np.float32), split=0)
+        before = _flushes()
+        red0 = _counter("op_engine.fusion_reduce_flushes")
+        v = ht.var(x)
+        assert v._lazy_node is not None, "var must stay pending"
+        v.item()
+        assert _flushes() - before == 1, "var must flush as ONE program"
+        assert _counter("op_engine.fusion_reduce_flushes") == red0 + 1
+
+
+def test_reduce_chain_one_executable_one_allreduce():
+    """ACCEPTANCE AUDIT: an elementwise chain ending in a split-axis
+    ``ht.sum`` compiles to ONE executable containing ONE all-reduce, and
+    the program's outputs are only the reduced values — the full-size
+    elementwise intermediate never materializes."""
+    if ht.get_comm().size == 1:
+        pytest.skip("needs a multi-device mesh")
+    from heat_tpu.utils.hlo_audit import entry_root_shapes
+
+    fusion.reset()
+    fusion.capture_hlo(True)
+    try:
+        with fusion.override(True):
+            x = ht.array(np.linspace(0, 1, 26, dtype=np.float32).reshape(13, 2),
+                         split=0)
+            compiles0 = fusion.program_cache().stats()["compiles"]
+            flushes0 = _flushes()
+            y = ht.sqrt(abs(ht.sin(x) * 0.5 + 1.0)).sum(axis=0)
+            assert y._lazy_node is not None, "reduction must record"
+            y.numpy()
+            assert _flushes() - flushes0 == 1, "chain must flush once"
+            assert fusion.program_cache().stats()["compiles"] - compiles0 \
+                == 1, "chain must lower to ONE executable"
+            hlo = fusion.last_hlo()
+            assert hlo is not None
+            cs = collective_stats(hlo)
+            assert set(cs) == {"all-reduce"}, f"collectives: {cs}"
+            assert cs["all-reduce"]["count"] == 1
+            outs = entry_root_shapes(hlo)
+            assert outs, "entry root must parse"
+            full = int(np.prod(x._phys_shape()))
+            assert max(n for _, n in outs) < full, \
+                f"full-size intermediate survived as output: {outs}"
+    finally:
+        fusion.capture_hlo(False)
+
+
+def test_two_independent_reductions_one_packed_allreduce():
+    """ACCEPTANCE AUDIT: a var-style two-reduction chain (independent
+    ``sum(t)`` and ``sum(t*t)`` over one elementwise chain) flushes as ONE
+    executable whose two shard-local partials combine in EXACTLY ONE
+    (packed/tuple-fused) all-reduce — the arXiv:2004.09362 shape."""
+    if ht.get_comm().size == 1:
+        pytest.skip("needs a multi-device mesh")
+    fusion.reset()
+    fusion.capture_hlo(True)
+    try:
+        with fusion.override(True):
+            data = np.random.default_rng(7).standard_normal(
+                (13, 5)).astype(np.float32)
+            x = ht.array(data, split=0)
+            n = float(x.size)
+            t = (x - 0.5) * 1.5
+            m1 = ht.sum(t)
+            m2 = ht.sum(t * t)
+            r = m2 / n - (m1 / n) * (m1 / n)
+            flushes0 = _flushes()
+            got = r.item()
+            assert _flushes() - flushes0 == 1, "one flush for both passes"
+            hlo = fusion.last_hlo()
+            assert hlo is not None
+            cs = collective_stats(hlo)
+            assert set(cs) == {"all-reduce"}, f"collectives: {cs}"
+            assert cs["all-reduce"]["count"] == 1, \
+                f"reductions not packed into one all-reduce: {cs}"
+            td = (data - 0.5) * 1.5
+            want = (td * td).sum() / n - (td.sum() / n) ** 2
+            assert abs(got - want) < 1e-4
+    finally:
+        fusion.capture_hlo(False)
+
+
+def test_weighted_average_reductions_packed():
+    """Weighted average: ``sum(x*w)`` and ``sum(w)`` fuse into one flush
+    with one packed all-reduce, and match numpy."""
+    if ht.get_comm().size == 1:
+        pytest.skip("needs a multi-device mesh")
+    fusion.reset()
+    fusion.capture_hlo(True)
+    try:
+        with fusion.override(True):
+            rng = np.random.default_rng(11)
+            xd = rng.standard_normal((13, 4)).astype(np.float32)
+            wd = (rng.random((13, 4)) + 0.25).astype(np.float32)
+            x = ht.array(xd, split=0)
+            w = ht.array(wd, split=0)
+            num = ht.sum(x * w)
+            den = ht.sum(w)
+            r = num / den
+            r.item()
+            hlo = fusion.last_hlo()
+            assert hlo is not None
+            cs = collective_stats(hlo)
+            assert cs.get("all-reduce", {}).get("count") == 1, cs
+            np.testing.assert_allclose(
+                r.item(), np.average(xd, weights=wd), rtol=1e-5)
+    finally:
+        fusion.capture_hlo(False)
+
+
+def test_cum_into_reduce_single_flush():
+    """Satellite regression: a non-split-axis ``__cum_op`` node feeding a
+    reduction is a legal reduction input — the pair flushes ONCE (the old
+    engine flushed the cum chain, materialized the O(n) intermediate, then
+    launched a second program for the reduce)."""
+    data = np.random.default_rng(1).standard_normal((12, 6)).astype(np.float32)
+    with fusion.override(True):
+        x = ht.array(data, split=0)
+        before = _flushes()
+        red0 = _counter("op_engine.fusion_reduce_flushes")
+        y = ht.cumsum(x * 2.0 + 1.0, 1).sum(axis=1)  # cum along non-split
+        assert y._lazy_node is not None
+        got = y.numpy()
+        assert _flushes() - before == 1, \
+            "cum → reduce must be ONE flush (was: cum flush + reduce flush)"
+        assert _counter("op_engine.fusion_reduce_flushes") == red0 + 1
+        want = np.cumsum(data * np.float32(2.0) + np.float32(1.0),
+                         axis=1).sum(axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_reduce_steady_state_zero_recompiles():
+    """Repeat reduction-terminated chains serve from the program cache —
+    zero new compiles, zero new misses after warmup."""
+    with fusion.override(True):
+        data = np.random.default_rng(0).standard_normal(
+            (16, 4)).astype(np.float32)
+        x = ht.array(data, split=0)
+
+        def chain(a):
+            return ((ht.sin(a) * 0.5 + 1.0) * a).sum(axis=0)
+
+        chain(x).numpy()  # warm
+        s0 = fusion.program_cache().stats()
+        for _ in range(4):
+            chain(x).numpy()
+        s = fusion.program_cache().stats()
+        assert s["compiles"] == s0["compiles"], "steady-state recompile"
+        assert s["misses"] == s0["misses"]
+        assert s["hits"] >= s0["hits"] + 4
+
+
+def test_reduce_opt_out_escape_hatch(monkeypatch):
+    """HEAT_TPU_FUSION_REDUCE=0 semantics: reductions flush their input
+    tape and dispatch eagerly (pre-reduction-fusion behavior) while
+    elementwise recording stays on."""
+    monkeypatch.setattr(fusion, "_REDUCE", False)
+    with fusion.override(True):
+        x = ht.array(np.ones((8, 2), np.float32), split=0)
+        y = ht.sin(x) * 2.0
+        assert y._lazy_node is not None
+        s = y.sum(axis=0)
+        assert s._lazy_node is None, "reduce must not record when gated off"
+        np.testing.assert_allclose(
+            s.numpy(), np.sin(np.ones((8, 2), np.float32)).sum(0) * 2.0,
+            rtol=1e-6)
+    assert fusion.stats()["reduce_enabled"] is False
+
+
+def test_live_partial_results_promoted_with_reduce():
+    """Live intermediates of a reduce tape (the sums a user keeps) are
+    promoted to program outputs and carry correct combined values."""
+    with fusion.override(True):
+        data = np.random.default_rng(2).standard_normal(
+            (12, 3)).astype(np.float32)
+        x = ht.array(data, split=0)
+        s1 = ht.sum(x * 2.0)
+        s2 = ht.sum((x * 2.0) * (x * 2.0))
+        r = s2 - s1
+        r.item()  # flush: s1/s2 are live -> outputs
+        np.testing.assert_allclose(s1.item(), (data * 2.0).sum(), rtol=1e-5)
+        np.testing.assert_allclose(
+            s2.item(), ((data * 2.0) ** 2).sum(), rtol=1e-4)

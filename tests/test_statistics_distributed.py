@@ -164,3 +164,55 @@ class TestHistogram:
         hist, _ = ht.histogram(x, bins=5, range=(-2.0, 2.0))
         want, _ = np.histogram(a, bins=5, range=(-2.0, 2.0))
         np.testing.assert_array_equal(np.asarray(hist.numpy()), want)
+
+
+class TestAverageWeights:
+    """Satellite regression (PR 4): ``average`` with ``axis=`` must follow
+    numpy's exact weights contract — same-shape weights, or 1-D weights
+    along the reduced axis (anything else raises like ``np.average``), and
+    the denominator is always the aligned weights summed along ``axis``
+    (the old code fell back to ``sum(weights)`` over the raw array for the
+    reshaped 1-D case). Pinned across splits."""
+
+    def test_same_shape_weights_across_splits(self):
+        a = rng.standard_normal((7, 5)).astype(np.float32)
+        w = (rng.random((7, 5)) + 0.1).astype(np.float32)
+        for axis in (0, 1):
+            want = np.average(a, axis=axis, weights=w)
+            for split in (None, 0, 1):
+                got = ht.average(ht.array(a, split=split), axis=axis,
+                                 weights=ht.array(w, split=split)).numpy()
+                np.testing.assert_allclose(
+                    got, want, rtol=1e-5, atol=1e-6,
+                    err_msg=f"axis={axis} split={split}")
+
+    def test_1d_weights_returned_counts(self):
+        a = rng.standard_normal((9, 3)).astype(np.float32)
+        w = (rng.random(3) + 0.1).astype(np.float32)
+        want, wsum = np.average(a, axis=1, weights=w, returned=True)
+        for split in (None, 0):
+            got, cnt = ht.average(ht.array(a, split=split), axis=1,
+                                  weights=ht.array(w), returned=True)
+            np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(cnt.numpy(), wsum, rtol=1e-5, atol=1e-6)
+
+    def test_non_1d_unequal_weights_raise(self):
+        # numpy parity: (n, 1) / (1, m) weights are NOT accepted, even
+        # though broadcastable (np.average 2.x raises ValueError)
+        a = rng.standard_normal((6, 4)).astype(np.float32)
+        for split in (None, 0, 1):
+            x = ht.array(a, split=split)
+            with pytest.raises(ValueError):
+                ht.average(x, axis=1,
+                           weights=ht.array(np.ones((6, 1), np.float32)))
+            with pytest.raises(ValueError):
+                ht.average(x, axis=0,
+                           weights=ht.array(np.ones((1, 4), np.float32)))
+
+    def test_wrong_length_1d_weights_raise(self):
+        a = ht.array(rng.standard_normal((6, 4)).astype(np.float32), split=0)
+        with pytest.raises(ValueError):
+            ht.average(a, axis=1, weights=ht.array(np.ones(3, np.float32)))
+        with pytest.raises(ValueError):
+            # 1-D weights matching the WRONG axis (numpy: length error)
+            ht.average(a, axis=0, weights=ht.array(np.ones(4, np.float32)))
