@@ -451,7 +451,15 @@ def _fusion_bench_main() -> None:
       the ``ht.mean((x-mu)**2)`` moment shape — eager pays the elementwise
       programs plus a separate reduce program and a full-size HBM
       intermediate; fused it is ONE program whose elementwise values never
-      leave registers before the shard-local reduce.
+      leave registers before the shard-local reduce;
+    * a GEMM + epilogue chain (``fusion_gemm_chain_*``): row-split
+      ``matmul`` → bias → activation → split-axis ``sum`` — the PR 5
+      contraction-node shape. Eager pays the zero-fill pass, the GEMM
+      dispatch AND one dispatch per epilogue op with full-size
+      intermediates; fused it is ONE shard_map program whose GEMM plan
+      carries zero collectives and whose reduce psum is the only
+      all-reduce. Sized so dispatch+traffic dominates the MXU-less CPU
+      GEMM (acceptance ≥ 1.5×).
 
     Prints ONE JSON line with the speedups and the fusion program-cache
     stats proving the steady state runs zero recompiles.
@@ -513,6 +521,21 @@ def _fusion_bench_main() -> None:
         t = t * w
         return t.sum(axis=0) * (1.0 / n)
 
+    # GEMM stage operands: smaller n so the (MXU-less) CPU GEMM itself does
+    # not drown the dispatch/traffic savings the fusion engine delivers
+    ng, dg = 1 << 14, 32
+    xg = ht.array(rng.standard_normal((ng, dg)).astype(np.float32), split=0)
+    wg = ht.array(rng.standard_normal((dg, dg)).astype(np.float32))
+    bg = ht.array(rng.standard_normal((dg,)).astype(np.float32))
+
+    def gemm_chain(_a):
+        # row-split GEMM (zero-collective plan) + bias + activation +
+        # split-axis reduce (one psum) — the serve/transformer hot shape
+        t = ht.matmul(xg, wg) + bg
+        t = ht.tanh(t * 0.5)
+        t = t * t + t
+        return t.sum(axis=0)
+
     def timed(build, reps: int) -> float:
         out = build(x)  # compile + warm (cache miss lands here)
         jax.block_until_ready(out.larray)
@@ -525,7 +548,8 @@ def _fusion_bench_main() -> None:
     record = {"fusion_devices": comm.size, "fusion_n": n}
     for label, build, reps in (("chain16", chain16, 30),
                                ("kmeans_mixed", kmeans_mixed, 30),
-                               ("reduce_chain", reduce_chain, 30)):
+                               ("reduce_chain", reduce_chain, 30),
+                               ("gemm_chain", gemm_chain, 30)):
         with fusion.override(False):
             t_eager = min(timed(build, reps) for _ in range(2))
         with fusion.override(True):
@@ -538,11 +562,13 @@ def _fusion_bench_main() -> None:
         for _ in range(5):
             jax.block_until_ready(chain16(x).larray)
             jax.block_until_ready(reduce_chain(x).larray)
+            jax.block_until_ready(gemm_chain(x).larray)
         cstats = fusion.program_cache().stats()
     record["fusion_steady_misses"] = cstats["misses"] - cstats0["misses"]
     record["fusion_program_cache"] = cstats
     record["fusion_ops_per_flush"] = fusion.stats()["ops_per_flush"]
     record["fusion_reduce_flushes"] = fusion.stats()["reduce_flushes"]
+    record["fusion_contract_flushes"] = fusion.stats()["contract_flushes"]
     print(json.dumps(record), flush=True)
 
 

@@ -40,6 +40,16 @@ def _count_align_resplit() -> None:
     metrics.inc("op_engine.align_resplits")
 
 
+def _count_zero_fill() -> None:
+    """Metrics tick for an eager zero-fill masking pass a contraction paid
+    (``op_engine.zero_fills``): GEMM operands whose buffers are already
+    canonically zero-padded (``DNDarray.pad_is_zero``) never tick this —
+    the ladder stats line shows how often GEMMs pay the masking pass."""
+    from ..utils import metrics
+
+    metrics.inc("op_engine.zero_fills")
+
+
 def _split_in_output(split: Optional[int], ndim_in: int, ndim_out: int) -> Optional[int]:
     """Map an input split axis to output coordinates after broadcasting
     (leading dimensions are prepended)."""

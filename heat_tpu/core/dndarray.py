@@ -107,6 +107,12 @@ class DNDarray:
 
     def __init__(self, array, gshape, dtype, split, device, comm, balanced: bool = True):
         self._lazy_node = None  # pending fusion-tape node (core/fusion.py)
+        # Certificate that the split-axis padding holds exact zeros
+        # (factory/planner outputs): a reference to the EXACT physical
+        # buffer the claim is true of, or None. Identity (not a bool)
+        # makes the claim race-proof — a concurrent buffer swap can never
+        # leave a stale True; the certificate simply stops matching.
+        self._pad_zero_buf = None
         self.__parray = array
         self.__gshape = tuple(int(s) for s in gshape)
         self.__dtype = dtype
@@ -149,7 +155,9 @@ class DNDarray:
             split = None
             place_split = None
         parray = jax.device_put(arr, comm.sharding(arr.ndim, place_split))
-        return DNDarray(parray, gshape, dtype, split, device, comm)
+        out = DNDarray(parray, gshape, dtype, split, device, comm)
+        out._pad_zero = True  # jnp.pad zero-fills (trivially true unpadded)
+        return out
 
     @classmethod
     def _lazy(cls, node, gshape, dtype, split, device, comm) -> "DNDarray":
@@ -197,6 +205,48 @@ class DNDarray:
             return 0
         return self._phys_shape()[self.__split] - self.__gshape[self.__split]
 
+    @property
+    def _pad_zero(self) -> builtins.bool:
+        """Whether the CURRENT physical buffer is certified zero-padded.
+        Setting True certifies the buffer installed at that moment (only
+        do this where the buffer provably just came from a zero-padding
+        producer); code that zero-filled a specific buffer should assign
+        ``_pad_zero_buf`` directly so a racing install voids the claim."""
+        return self.__parray is not None and \
+            self._pad_zero_buf is self.__parray
+
+    @_pad_zero.setter
+    def _pad_zero(self, value: builtins.bool) -> None:
+        self._pad_zero_buf = self.__parray if value else None
+
+    @property
+    def pad_is_zero(self) -> builtins.bool:
+        """True when the padded positions along the split axis are known
+        to hold exact zeros. Factories, ``from_logical`` and the reshard
+        planner all zero-pad by construction; elementwise op results leave
+        garbage there (the claim stays conservative-False). Consumers that
+        would zero-fill (``matmul``'s ``_filled0``, the fusion tape's
+        contract masks) skip the re-materialization when it is set.
+        A PENDING tape array (``__parray`` None) never certifies —
+        ``None is None`` must not read as a claim."""
+        return self.pad == 0 or (self.__parray is not None
+                                 and self._pad_zero_buf is self.__parray)
+
+    def _write_back_zero_fill(self):
+        """Zero-fill the split-axis padding, install the result and
+        certify exactly that buffer — the pay-once masking discipline
+        shared by the eager GEMM path (``linalg.basics._filled0``) and
+        the fusion tape's concrete-operand masks. Ticks
+        ``op_engine.zero_fills`` (counts the payers). Returns the
+        zero-filled physical array."""
+        from ._operations import _count_zero_fill
+
+        _count_zero_fill()
+        f = self.filled(0)
+        self.larray = f  # padding is don't-care: caching the fill is free
+        self._pad_zero_buf = f  # certify exactly f (racing install voids)
+        return f
+
     def filled(self, fill_value):
         """Physical array with padding overwritten by ``fill_value``.
 
@@ -213,6 +263,13 @@ class DNDarray:
         p = self.larray
         if self.pad == 0:
             return p
+        try:
+            # identity check against the buffer captured above: a racing
+            # install between the two reads voids the claim, never lies
+            if self._pad_zero_buf is p and builtins.bool(fill_value == 0):
+                return p  # padding already holds the requested fill
+        except Exception:
+            pass  # exotic fill values take the select path
         k = self.__split
         n = self.__gshape[k]
         iota = jax.lax.broadcasted_iota(jnp.int32, p.shape, k)
@@ -250,6 +307,9 @@ class DNDarray:
             from . import fusion
 
             fusion.cancel(self)
+        # arbitrary writes void the zero-pad certificate (and drop its
+        # strong reference to the outgoing buffer)
+        self._pad_zero_buf = None
         self.__parray = array
 
     @property
@@ -382,6 +442,7 @@ class DNDarray:
             self.larray, self.__gshape, self.__split, axis, self.__comm
         )
         self.__split = axis
+        self._pad_zero = True  # every reshard plan zero-pads the new axis
         return self
 
     def resplit(self, axis=None) -> "DNDarray":
@@ -389,11 +450,15 @@ class DNDarray:
         if axis is not None:
             axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
-            return DNDarray(
+            out = DNDarray(
                 self.larray, self.__gshape, self.__dtype, self.__split, self.__device, self.__comm
             )
+            out._pad_zero = self._pad_zero  # shares the buffer verbatim
+            return out
         parray = _reshard_physical(self.larray, self.__gshape, self.__split, axis, self.__comm)
-        return DNDarray(parray, self.__gshape, self.__dtype, axis, self.__device, self.__comm)
+        out = DNDarray(parray, self.__gshape, self.__dtype, axis, self.__device, self.__comm)
+        out._pad_zero = True  # every reshard plan zero-pads the new axis
+        return out
 
     def redistribute_(self, lshape_map=None, target_map=None) -> None:
         """Reference parity (``:1033-1237``). Arbitrary target maps are not
@@ -512,7 +577,12 @@ class DNDarray:
             return DNDarray(
                 casted, self.__gshape, dtype, self.__split, self.__device, self.__comm
             )
+        # a cast preserves zero padding (0 casts to 0 in every numeric
+        # dtype): carry the certificate onto the new buffer — and never
+        # leave it pinning the outgoing one
+        keep = self._pad_zero
         self.__parray = casted
+        self._pad_zero_buf = casted if keep else None
         self.__dtype = dtype
         return self
 
@@ -1193,6 +1263,7 @@ class DNDarray:
             logical, self.__split, self.__device, self.__comm, dtype=self.__dtype
         )
         self.__parray = new.larray
+        self._pad_zero_buf = new._pad_zero_buf  # from_logical zero-pads
         return self
 
     # ------------------------------------------------------------------ #

@@ -218,7 +218,9 @@ def arange(*args, dtype=None, split=None, device=None, comm=None) -> DNDarray:
     parray = _sharded_create(
         ("arange", float(start), float(step)), make, gshape, jdtype, split, comm
     )
-    return DNDarray(parray, gshape, types.canonical_heat_type(jdtype), split, device, comm)
+    out = DNDarray(parray, gshape, types.canonical_heat_type(jdtype), split, device, comm)
+    out._pad_zero = True  # _sharded_create's jnp.pad zero-fills the padding
+    return out
 
 
 def __factory(shape, dtype, split, device, comm, fill_tag, make) -> DNDarray:
@@ -233,7 +235,9 @@ def __factory(shape, dtype, split, device, comm, fill_tag, make) -> DNDarray:
         if len(shape) == 0:
             split = None
     parray = _sharded_create(fill_tag, lambda: make(shape, jdtype), shape, jdtype, split, comm)
-    return DNDarray(parray, shape, dtype, split, device, comm)
+    out = DNDarray(parray, shape, dtype, split, device, comm)
+    out._pad_zero = True  # _sharded_create's jnp.pad zero-fills the padding
+    return out
 
 
 def empty(shape, dtype=types.float32, split=None, device=None, comm=None, order="C") -> DNDarray:
@@ -372,6 +376,7 @@ def linspace(
         comm_,
     )
     result = DNDarray(parray, gshape, dtype, split, device, comm_)
+    result._pad_zero = True  # _sharded_create's jnp.pad zero-fills the padding
     if retstep:
         return result, step
     return result

@@ -46,6 +46,33 @@ translator cannot prove shard_map-safe (unregistered combiner such as
 with GSPMD-placed collectives — never eagerly. Opt-out:
 ``HEAT_TPU_FUSION_REDUCE=0`` restores the eager ``filled()`` flush.
 
+Contraction nodes (planned distributed GEMM on the tape)
+--------------------------------------------------------
+``linalg.matmul`` (and through it ``dot``/``outer``, plus the 2-operand
+``einsum``/``tensordot`` paths) records a **contract node** instead of
+forcing ``filled(0)``-materialization of both operand tapes. The per-
+split-case collective plan is explicit in the shard_map translation —
+the by-construction discipline the reference Heat spends ~670 lines of
+hand-scheduled Bcasts on (arXiv:2007.13552, ``basics.py:424-1095``):
+
+* ``a.split=0`` (× replicated ``b``) or ``b.split=1`` (× replicated
+  ``a``): local GEMM on blocks, output keeps the split, ZERO collectives;
+* contracted-dim sharded (``a.split=1`` / ``b.split=0`` in any
+  combination with a replicated other side): shard-local partial GEMM +
+  ``lax.psum``, PACKED into the same phase-sorted flattened collective as
+  any independent same-kind reductions on the tape (arXiv:2004.09362);
+* mixed 2-D layouts outside the block model fall back to ONE plain-jit
+  GSPMD program, exactly like non-translatable reduce tapes. Batched
+  (>2-D) matmul never records — it dispatches eagerly on shard-local
+  blocks in ``linalg.basics._matmul_batched``.
+
+Zero-fill masking of contracted-axis padding rides the tape as MASK
+nodes (skipped entirely when the operand's ``pad_is_zero`` bit proves
+the buffer is already canonically zero-padded), so ``x @ w + b`` then an
+activation then a split-axis reduction compiles as ONE cached executable
+with exactly the planner's collectives. Opt-out:
+``HEAT_TPU_FUSION_CONTRACT=0`` restores the eager ``_filled0`` GEMM.
+
 Program identity and caching
 ----------------------------
 A flush compiles at most once per *chain signature*: a structural key over
@@ -106,6 +133,8 @@ __all__ = [
     "record_binary",
     "record_cum",
     "record_reduce",
+    "record_contract",
+    "record_contract_einsum",
     "register_reduce_collective",
     "program_cache",
     "stats",
@@ -131,6 +160,10 @@ _DONATE = _env_on("HEAT_TPU_FUSION_DONATE")
 # flush their input tape and dispatch eagerly (the pre-reduction-fusion
 # behavior), while elementwise recording stays on
 _REDUCE = _env_on("HEAT_TPU_FUSION_REDUCE")
+# escape hatch for the contraction-node extension alone: with 0, GEMMs
+# dispatch eagerly on zero-filled physical arrays (the pre-contract-fusion
+# behavior), while elementwise/reduction recording stays on
+_CONTRACT = _env_on("HEAT_TPU_FUSION_CONTRACT")
 
 _PROGRAMS = None  # lazy singleton (utils imports back into core)
 
@@ -235,17 +268,21 @@ class _Node:
     is set once a flush evaluates the node (it then acts as a leaf for any
     later chain that still references it).
 
-    ``kind``/``split``/``rmeta``/``comm`` drive the shard_map translation
-    of reduce-containing tapes: ``kind`` is ``"ew"`` (elementwise/cum/
-    astype), ``"pad"`` (replicated-operand physical pad), ``"mask"``
-    (neutral-element padding fill), or ``"reduce"``; ``split`` is the
-    physical split axis of the node's VALUE; ``rmeta`` holds the reduce
-    metadata (collective kind, whether the split axis is reduced, the
-    input split); ``comm`` is set on reduce nodes only."""
+    ``kind``/``split``/``rmeta``/``cmeta``/``comm`` drive the shard_map
+    translation of reduce- and contract-containing tapes: ``kind`` is
+    ``"ew"`` (elementwise/cum/astype), ``"pad"`` (replicated-operand
+    physical pad), ``"mask"`` (neutral-element padding fill),
+    ``"reduce"``, ``"contract"`` (distributed GEMM/einsum), or ``"crop"``
+    (static slice back to canonical extents — never blockwise);
+    ``split`` is the physical split axis of the node's VALUE; ``rmeta``
+    holds the reduce metadata (collective kind, whether the split axis is
+    reduced, the input split); ``cmeta`` the contract metadata (split
+    case, collective, translatability); ``comm`` is set on reduce and
+    contract nodes only."""
 
     __slots__ = ("fn", "args", "kwargs", "kwargs_key", "aval", "depth",
                  "owner", "ext_refs", "value", "kind", "split", "rmeta",
-                 "comm", "__weakref__")
+                 "cmeta", "comm", "__weakref__")
 
     def __init__(self, fn, args, kwargs, kwargs_key, aval, depth):
         self.fn = fn
@@ -260,6 +297,7 @@ class _Node:
         self.kind = "ew"
         self.split = None
         self.rmeta = None
+        self.cmeta = None
         self.comm = None
 
 
@@ -614,13 +652,9 @@ def record_reduce(x, partial_op, neutral, axis, axes, keepdims,
             hash(neutral)
         except TypeError:
             return None
-        h = _make_node(_mask_pad,
-                       {"axis": int(x.split), "n": int(x.gshape[x.split]),
-                        "fill": neutral}, (h,), phys_in)
+        h = _mask0(h, x.split, x.gshape[x.split], phys_in, fill=neutral)
         if h is None:
             return None
-        h.kind = "mask"
-        h.split = x.split
     rkw = dict(kwargs)
     rkw["axis"] = None if axis is None else axes
     rkw["keepdims"] = keepdims
@@ -639,6 +673,251 @@ def record_reduce(x, partial_op, neutral, axis, axes, keepdims,
                   "touches": bool(touches_split), "in_split": x.split}
     node.comm = x.comm
     return _wrap(node, gshape, out_split, x.device, x.comm)
+
+
+def _hshape(h) -> Tuple[int, ...]:
+    """Physical shape of a handle (node aval or leaf array)."""
+    return tuple(h.aval.shape) if isinstance(h, _Node) else tuple(h.array.shape)
+
+
+def _crop_op(a, limits):
+    """Module-level (stable identity) static slice back to the canonical
+    physical extents — the tape form of the eager ``res[:, :m]`` crop when
+    two operand paddings cannot both stay in a contraction's output. Crop
+    nodes never translate blockwise (kind ``"crop"``): their limits span
+    the GLOBAL padded extent, which a shard-local block cannot satisfy."""
+    return jax.lax.slice(a, (0,) * len(limits), tuple(limits))
+
+
+def _einsum_op(x, y, expr):
+    """Module-level (stable identity) two-operand einsum contraction."""
+    return jnp.einsum(expr, x, y)
+
+
+def _mask0(h, axis, n, phys, fill=0) -> Optional[_Node]:
+    """Fill-mask node over the padding beyond logical length ``n`` along
+    ``axis`` — the tape form of ``DNDarray.filled``. Contractions mask
+    with the default zero (``linalg.basics._filled0``: padding must
+    contribute nothing); reductions pass their neutral element."""
+    hm = _make_node(_mask_pad, {"axis": int(axis), "n": int(n),
+                                "fill": fill}, (h,), phys)
+    if hm is None:
+        return None
+    hm.kind = "mask"
+    hm.split = int(axis)
+    return hm
+
+
+def _masked_operand(op, axis, n) -> Optional[object]:
+    """Zero-filled handle for a contraction operand whose padding holds
+    garbage. A CONCRETE operand takes the eager ``_filled0`` write-back:
+    the select runs ONCE per buffer (padding is don't-care), the
+    ``pad_is_zero`` bit is set, and every later GEMM on the same array —
+    fused or eager — skips the masking pass entirely. A pending tape
+    records a MASK node instead, fusing the mask into the chain program
+    (zero materialization barrier — the point of recording); its
+    ``op_engine.zero_fills`` tick is per flush, honestly counting each
+    fused program that carries the select."""
+    from ._operations import _count_zero_fill
+
+    if op._lazy_node is None:
+        op._write_back_zero_fill()
+        return _handle_of(op)
+    h = _handle_of(op)
+    if h is None:
+        return None
+    hm = _mask0(h, axis, n, op._phys_shape())
+    if hm is not None:
+        _count_zero_fill()
+    return hm
+
+
+def _zero_pad_node(h, cfg, operand_split) -> Optional[_Node]:
+    """Zero-pad node aligning one operand's extents onto another's padded
+    extents. A replicated operand padded along exactly one axis becomes a
+    ``"pad"`` node (the translator pads then slices the local block — the
+    contracted-split psum case with a replicated side); anything else
+    stays an ordinary node (blockwise-safe for non-split axes, and the
+    plan validator rejects the rest into the GSPMD path)."""
+    hp = _make_node(_pad_op, {"cfg": tuple(tuple(p) for p in cfg)}, (h,),
+                    _padded_shape(h, cfg))
+    if hp is None:
+        return None
+    padded_axes = [i for i, p in enumerate(cfg) if tuple(p) != (0, 0)]
+    if operand_split is None and len(padded_axes) == 1:
+        hp.kind = "pad"
+        hp.split = padded_axes[0]
+    else:
+        hp.split = operand_split
+    return hp
+
+
+def record_contract(a, b) -> Optional[object]:
+    """Lazy form of the 2-D ``matmul`` compute tail: zero-fill masks for
+    contracted-axis padding, the physical contracted-extent alignment, the
+    GEMM itself and (when two paddings cannot coexist in the output) a
+    canonical crop all become tape nodes, so ``matmul(x, w) + b`` →
+    activation → reduction is ONE flush. ``cmeta["case"]`` names the
+    split-combination plan the shard_map translator implements:
+
+    ========== ============================ ======================
+    case       layouts                      collectives
+    ========== ============================ ======================
+    local0     a.split=0, b replicated      none (output split 0)
+    local1     a replicated, b.split=1      none (output split 1)
+    psum       contracted dim sharded       one packed ``psum``
+               (a.split=1 and/or b.split=0)
+    replicated both replicated              none (local GEMM)
+    gspmd      anything else                GSPMD-placed, one
+                                            plain-jit program
+    ========== ============================ ======================
+    """
+    if not _ENABLED or not _CONTRACT:
+        return None
+    comm = a.comm
+    if b.comm is not comm or a.size == 0 or b.size == 0:
+        return None
+    n, k = (int(s) for s in a.gshape)
+    m = int(b.gshape[1])
+    sa, sb = a.split, b.split
+
+    # zero-fill the contracted-axis padding (the tape form of ``_filled0``);
+    # skipped when the buffer is already canonically zero-padded, written
+    # back once for concrete operands (repeat GEMMs are then free). Masks
+    # run BEFORE handle acquisition: a concrete write-back swaps the
+    # operand's buffer, and an aliased sibling (``matmul(x, x)``) must see
+    # the shared post-write-back buffer — and its bit — not a stale leaf
+    ha = hb = None
+    if sa == 1 and a.pad and not a.pad_is_zero:
+        ha = _masked_operand(a, 1, k)
+        if ha is None:
+            return None
+    if sb == 0 and b.pad and not b.pad_is_zero:
+        hb = _masked_operand(b, 0, k)
+        if hb is None:
+            return None
+    if ha is None:
+        ha = _handle_of(a)
+    if hb is None:
+        hb = _handle_of(b)
+    if ha is None or hb is None:
+        return None
+
+    # align the contracted dimension physically (zero rows/cols up to the
+    # sharded side's padded extent — zeros contribute nothing to the GEMM)
+    ka_phys, kb_phys = _hshape(ha)[1], _hshape(hb)[0]
+    if ka_phys < kb_phys:
+        ha = _zero_pad_node(ha, ((0, 0), (0, kb_phys - ka_phys)), sa)
+    elif kb_phys < ka_phys:
+        hb = _zero_pad_node(hb, ((0, ka_phys - kb_phys), (0, 0)), sb)
+    if ha is None or hb is None:
+        return None
+
+    out_split = 0 if sa == 0 else (1 if sb == 1 else None)
+    if sa == 0 and sb is None:
+        case = "local0"
+    elif sa is None and sb == 1:
+        case = "local1"
+    elif (sa == 1 or sb == 0) and sa in (1, None) and sb in (0, None):
+        case = "psum"
+    elif sa is None and sb is None:
+        case = "replicated"
+    else:
+        case = "gspmd"
+
+    raw = (_hshape(ha)[0], _hshape(hb)[1])
+    node = _make_node(jnp.matmul, {}, (ha, hb), raw)
+    if node is None:
+        return None
+    node.kind = "contract"
+    node.split = out_split
+    node.comm = comm
+    node.cmeta = {"case": case,
+                  "collective": "psum" if case == "psum" else None,
+                  "translatable": case != "gspmd"}
+    canonical = (comm.padded_size(n) if out_split == 0 else n,
+                 comm.padded_size(m) if out_split == 1 else m)
+    if raw != canonical:
+        # only one axis may carry canonical padding (a.split=0 × b.split=1)
+        node2 = _make_node(_crop_op, {"limits": canonical}, (node,),
+                           canonical)
+        if node2 is None:
+            return None
+        node2.kind = "crop"
+        node2.split = out_split
+        node = node2
+    # the output's padding is never claimed zero (``_pad_zero`` stays
+    # False): even zero operand padding contracted against a non-finite
+    # value yields NaN padding (0 * inf), so the bit would lie for legal
+    # data. Consumers pay at most one select per buffer (the write-back).
+    return _wrap(node, (n, m), out_split, a.device, comm)
+
+
+def record_contract_einsum(in_specs, out_part, a, b, out_split) -> Optional[object]:
+    """Lazy form of the 2-operand distributed einsum (and ``tensordot``
+    riding it): zero-fill masks, the label-extent normalization pads, the
+    contraction and the logical-output crop all become tape nodes. The
+    contraction compiles via the plain-jit GSPMD path unless both operands
+    are replicated (``matmul`` owns the block-planned split cases; einsum's
+    general layouts stay GSPMD-placed) — the win here is epilogue fusion
+    and the removal of the ``filled(0)`` materialization barrier."""
+    if not _ENABLED or not _CONTRACT:
+        return None
+    comm = a.comm
+    if b.comm is not comm or a.size == 0 or b.size == 0:
+        return None
+    handles = []
+    for op, spec in ((a, in_specs[0]), (b, in_specs[1])):
+        if op.split is not None and op.pad and not op.pad_is_zero:
+            h = _masked_operand(op, op.split, op.gshape[op.split])
+        else:
+            h = _handle_of(op)
+        if h is None:
+            return None
+        handles.append(h)
+    # one physical extent per label (a label can pair a padded split dim
+    # with an unpadded one across operands; zero-pad the shorter dims)
+    sizes: Dict[str, int] = {}
+    for h, spec in zip(handles, in_specs):
+        for ax, label in enumerate(spec):
+            sizes[label] = max(sizes.get(label, 0), _hshape(h)[ax])
+    for j, (op, spec) in enumerate(((a, in_specs[0]), (b, in_specs[1]))):
+        shape = _hshape(handles[j])
+        cfg = tuple((0, sizes[l] - shape[ax]) for ax, l in enumerate(spec))
+        if any(w for _, w in cfg):
+            handles[j] = _zero_pad_node(handles[j], cfg, op.split)
+            if handles[j] is None:
+                return None
+    expr = ",".join(in_specs) + "->" + out_part
+    raw_shape = tuple(sizes[l] for l in out_part)
+    node = _make_node(_einsum_op, {"expr": expr}, tuple(handles), raw_shape)
+    if node is None:
+        return None
+    node.kind = "contract"
+    node.split = out_split
+    node.comm = comm
+    replicated = a.split is None and b.split is None and out_split is None
+    node.cmeta = {"case": "replicated" if replicated else "gspmd",
+                  "collective": None, "translatable": replicated}
+    logical = []
+    for label in out_part:
+        for op, spec in ((a, in_specs[0]), (b, in_specs[1])):
+            if label in spec:
+                logical.append(int(op.gshape[spec.index(label)]))
+                break
+    canonical = tuple(comm.padded_size(s) if i == out_split else s
+                      for i, s in enumerate(logical))
+    if raw_shape != canonical:
+        node2 = _make_node(_crop_op, {"limits": canonical}, (node,),
+                           canonical)
+        if node2 is None:
+            return None
+        node2.kind = "crop"
+        node2.split = out_split
+        node = node2
+    # padding never claimed zero — zero-filled input padding contracted
+    # against a non-finite value is NaN (0 * inf); see record_contract
+    return _wrap(node, tuple(logical), out_split, a.device, comm)
 
 
 # ---------------------------------------------------------------------- #
@@ -740,9 +1019,10 @@ def _flush(root: _Node) -> None:
 def _flush_locked(root: _Node) -> None:
     order, in_refs = _topo(root)
     has_reduce = any(n.kind == "reduce" for n in order)
+    has_contract = any(n.kind == "contract" for n in order)
 
     if len(order) < _MIN_OPS and not _capture_hlo:
-        _flush_inline(order, has_reduce)
+        _flush_inline(order, has_reduce, has_contract)
         return
 
     leaves = []        # unique concrete arrays, first-encounter order
@@ -792,16 +1072,20 @@ def _flush_locked(root: _Node) -> None:
     out_idx = tuple(out_idx)
 
     touching = [n for n in order
-                if n.kind == "reduce" and n.rmeta["touches"]]
+                if (n.kind == "reduce" and n.rmeta["touches"])
+                or (n.kind == "contract" and n.cmeta["case"] != "replicated")]
     comm = touching[0].comm if touching else None
     sm = None
-    if touching:
+    if touching and all(n.cmeta["translatable"] for n in order
+                        if n.kind == "contract"):
+        # a gspmd-case contract anywhere on the tape dooms the plan at
+        # that node — skip the O(tape) walk and go straight to plain-jit
         sm = _plan_sm(order, plan, leaves, leaf_splits, out_idx, comm)
-    if has_reduce:
-        # reduce-carrying tapes compile without donation (documented
-        # contract, doc/fusion.md): the program is shard_map-shaped or
-        # collective-carrying and its outputs are reduced-size, so buffer
-        # reuse buys nothing — and donated inputs would complicate the
+    if has_reduce or has_contract:
+        # reduce- and contract-carrying tapes compile without donation
+        # (documented contract, doc/fusion.md): the program is
+        # shard_map-shaped or collective-carrying, so buffer reuse buys
+        # little — and donated inputs would complicate the
         # packed-collective body for zero win
         donate = ()
     else:
@@ -859,6 +1143,8 @@ def _flush_locked(root: _Node) -> None:
     m.inc("op_engine.fusion_ops", len(order))
     if has_reduce:
         m.inc("op_engine.fusion_reduce_flushes")
+    if has_contract:
+        m.inc("op_engine.fusion_contract_flushes")
 
     for pos, res in zip(out_idx, results):
         node = order[pos]
@@ -912,7 +1198,9 @@ def _plan_sm(order, plan, leaves, leaf_splits, out_idx, comm):
             if tag == 0:
                 p = phases[i]
                 inner = order[i]
-                if inner.kind == "reduce" and inner.rmeta["touches"]:
+                if (inner.kind == "reduce" and inner.rmeta["touches"]) or \
+                        (inner.kind == "contract"
+                         and inner.cmeta["collective"] is not None):
                     p += 1  # consumes a combined value: next phase
                 phase = max(phase, p)
         if node.kind == "reduce":
@@ -926,6 +1214,44 @@ def _plan_sm(order, plan, leaves, leaf_splits, out_idx, comm):
             elif state_of(tag, i) != m["in_split"]:
                 return None
             instrs.append(("reduce", m["collective"] if m["touches"] else None))
+        elif node.kind == "contract":
+            cm = node.cmeta
+            if not cm["translatable"] or node.comm is not comm:
+                return None
+            (ta, ia), (tb, ib) = codes
+            sa, sb = state_of(ta, ia), state_of(tb, ib)
+            blocks = ()
+            if cm["case"] == "psum":
+                # partial GEMM + psum. A replicated side (even contracted
+                # extent — no alignment pad node carried it to block
+                # state) is dynamic-sliced to its contracted-axis block
+                # in the body, like replicated "ew" operands; extents are
+                # aligned by construction (record_contract pads), checked
+                # here so a mismatch falls back instead of miscomputing
+                ok = (sa in (1, None) and sb in (0, None)
+                      and (sa, sb) != (None, None))
+                ka = shape_of(ta, ia)[1]
+                sl = []
+                if ok and sa is None:
+                    ok = ka == shape_of(tb, ib)[0] and ka % size == 0
+                    sl.append((0, 1))
+                if ok and sb is None:
+                    kb = shape_of(tb, ib)[0]
+                    ok = kb == ka and kb % size == 0
+                    sl.append((1, 0))
+                blocks = tuple(sl)
+            else:
+                ok = {"local0": sa == 0 and sb is None,  # block GEMM, out 0
+                      "local1": sa is None and sb == 1,  # block GEMM, out 1
+                      "replicated": sa is None and sb is None,
+                      }.get(cm["case"], False)
+            if not ok:
+                return None
+            instrs.append(("contract", cm["collective"], blocks))
+        elif node.kind == "crop":
+            # a crop's limits span the GLOBAL padded extent — no blockwise
+            # form exists (it only ever follows a gspmd-case contract)
+            return None
         elif node.kind == "mask":
             (tag, i), = codes
             if state_of(tag, i) != kwargs["axis"] or node.split != kwargs["axis"]:
@@ -1040,7 +1366,12 @@ def _sm_body(plan, sm, out_idx, comm):
                     + start
                 vals[pos] = jnp.where(iota < kwargs["n"], a,
                                       jnp.asarray(kwargs["fill"], a.dtype))
-            else:  # reduce: shard-local partial, combined at the barrier
+            else:  # reduce/contract: shard-local partial (or local GEMM on
+                # blocks), combined at the phase barrier when a collective
+                # kind is attached
+                if op == "contract":
+                    for ci, ax in ins[2]:
+                        args[ci] = block(args[ci], ax)
                 vals[pos] = fn(*args, **kwargs)
                 if ins[1] is not None:
                     pend[pos] = ins[1]
@@ -1050,7 +1381,8 @@ def _sm_body(plan, sm, out_idx, comm):
     return body
 
 
-def _flush_inline(order, has_reduce: bool = False) -> None:
+def _flush_inline(order, has_reduce: bool = False,
+                  has_contract: bool = False) -> None:
     """Evaluate a short chain op-by-op (children first — ``order`` is
     post-order): each dispatch reuses XLA's per-op executable cache, which
     every other chain in the process shares. Values land on every node, so
@@ -1070,6 +1402,8 @@ def _flush_inline(order, has_reduce: bool = False) -> None:
     m.inc("op_engine.fusion_inline_flushes")
     if has_reduce:
         m.inc("op_engine.fusion_reduce_flushes")
+    if has_contract:
+        m.inc("op_engine.fusion_contract_flushes")
     for node in order:
         node.args = ()
         node.kwargs = {}
@@ -1086,9 +1420,12 @@ def stats() -> dict:
     return {
         "enabled": _ENABLED,
         "reduce_enabled": _REDUCE,
+        "contract_enabled": _CONTRACT,
         "flushes": flushes,
         "inline_flushes": int(c.get("op_engine.fusion_inline_flushes", 0)),
         "reduce_flushes": int(c.get("op_engine.fusion_reduce_flushes", 0)),
+        "contract_flushes": int(
+            c.get("op_engine.fusion_contract_flushes", 0)),
         "fused_ops": ops,
         "ops_per_flush": round(ops / flushes, 3) if flushes else 0.0,
         "max_ops": _MAX_OPS,

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 
 from .. import arithmetics, statistics, types
 from ..dndarray import DNDarray
-from ..stride_tricks import sanitize_axis
+from ..stride_tricks import broadcast_shape, sanitize_axis
 
 __all__ = [
     "cross",
@@ -49,8 +49,17 @@ __all__ = [
 
 
 def _filled0(x: DNDarray):
-    """Physical array with zero-filled padding (safe for contractions)."""
-    return x.filled(0) if x.pad else x.larray
+    """Physical array with zero-filled padding (safe for contractions).
+
+    Fast path: a buffer already canonically zero-padded
+    (``DNDarray.pad_is_zero`` — factory, ``from_logical`` and planner
+    outputs all guarantee it) skips the re-zero entirely. Otherwise the
+    select runs ONCE per buffer: the zero-filled result is written back,
+    so repeat GEMMs on the same array stop paying the masking pass.
+    ``op_engine.zero_fills`` counts the payers."""
+    if not x.pad or x.pad_is_zero:
+        return x.larray
+    return x._write_back_zero_fill()
 
 
 def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
@@ -84,24 +93,21 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
         res = matmul(a, b.reshape((b.shape[0], 1)))
         return manipulations.squeeze(res, axis=-1)
     if a.ndim != 2 or b.ndim != 2:
-        # batched matmul (beyond the reference's 2-D-only ``basics.py:424``):
-        # contract the last two dims with NumPy broadcasting over the batch
-        # dims; GSPMD shards the batched GEMM from the operands' shardings
-        out = jnp.matmul(a._logical(), b._logical())
-        # preserve a batch-dim sharding when it maps onto the (right-aligned
-        # broadcast) output axis of the same extent; else replicate
-        split = None
-        for op in (a, b):
-            if op.split is not None and op.split < op.ndim - 2:
-                mapped = op.split + (out.ndim - op.ndim)
-                if op.shape[op.split] == out.shape[mapped]:
-                    split = mapped
-                    break
-        return DNDarray.from_logical(out, split=split, device=a.device, comm=a.comm)
+        return _matmul_batched(a, b)
     n, ka = a.shape
     kb, m = b.shape
     if ka != kb:
         raise ValueError(f"matmul shape mismatch: {a.shape} @ {b.shape}")
+
+    # record a CONTRACT node instead of dispatching: the zero-fill masks,
+    # the GEMM and its epilogue fuse into ONE program at the next
+    # materialization point, with the per-split-case collective plan
+    # explicit in the shard_map translation (core/fusion.py)
+    from .. import fusion
+
+    lazy = fusion.record_contract(a, b)
+    if lazy is not None:
+        return lazy
 
     f_a = _filled0(a)
     f_b = _filled0(b)
@@ -129,7 +135,77 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
             res = res[:n, :m]
 
     dtype = types.canonical_heat_type(res.dtype)
+    # the output's padding is NOT claimed zero: padded rows/cols are the
+    # zero-filled operand's padding pushed through the contraction, and
+    # 0 * inf = NaN — a non-finite operand value poisons the padding even
+    # though the logical result is exact. Later consumers pay at most one
+    # ``filled(0)`` select per buffer (the _filled0 write-back).
     return DNDarray(res, (n, m), dtype, out_split, a.device, a.comm)
+
+
+def _matmul_batched(a: DNDarray, b: DNDarray) -> DNDarray:
+    """Batched matmul (beyond the reference's 2-D-only ``basics.py:424``):
+    contract the last two dims with NumPy broadcasting over batch dims.
+
+    A batch-axis split that maps onto the output runs on shard-local
+    physical blocks: the previous path all-gathered BOTH operands to full
+    logical size (``_logical``) on every call even when the batch split
+    survived verbatim — a replication leak proportional to the model size
+    per GEMM. Batch padding never enters the contraction (matmul reads
+    only the last two dims), so garbage padding stays in output padding.
+    Non-mappable layouts still gather (GSPMD shards the contraction from
+    the operands' shardings); every unavoidable gather of a sharded
+    operand is counted in ``op_engine.align_resplits``.
+    """
+    from .._operations import _count_align_resplit
+
+    out_batch = broadcast_shape(a.shape[:-2], b.shape[:-2])
+    out_shape = tuple(out_batch) + (a.shape[-2], b.shape[-1])
+    ndim_out = len(out_shape)
+    split = None
+    primary = None
+    for op in (a, b):
+        if op.split is not None and op.split < op.ndim - 2:
+            mapped = op.split + (ndim_out - op.ndim)
+            if op.shape[op.split] == out_shape[mapped]:
+                split, primary = mapped, op
+                break
+    if primary is None or 0 in out_shape:
+        # no batch split survives (gathering IS the semantics here), or
+        # the result is empty — block math degenerates but the mapped
+        # split, when one exists, stays on the metadata
+        if primary is None:
+            for op in (a, b):
+                if op.split is not None and op.size > 0:
+                    _count_align_resplit()
+        res = jnp.matmul(a._logical(), b._logical())
+        return DNDarray.from_logical(res, split, a.device, a.comm)
+
+    comm = a.comm
+    phys = []
+    for op in (a, b):
+        if op is primary:
+            phys.append(op.larray)
+            continue
+        ax = split - (ndim_out - op.ndim)
+        if op.split is not None:
+            if op.split == ax and op.shape[op.split] == out_shape[split]:
+                phys.append(op.larray)  # same canonical batch layout
+                continue
+            _count_align_resplit()
+            op = op.resplit(None)
+        p = op.larray
+        if ax >= 0 and op.shape[ax] == out_shape[split]:
+            # align the replicated operand's batch extent onto the padded
+            # physical extent (content is don't-care, zeros are cheapest)
+            padn = comm.padded_size(out_shape[split]) - p.shape[ax]
+            if padn > 0:
+                cfg = [(0, padn if i == ax else 0) for i in range(p.ndim)]
+                p = jnp.pad(p, cfg)
+        phys.append(p)
+    res = jnp.matmul(phys[0], phys[1])
+    dtype = types.canonical_heat_type(res.dtype)
+    return DNDarray(res, out_shape, dtype, split, a.device, comm)
 
 
 def cross(a: DNDarray, b: DNDarray, axisa: int = -1, axisb: int = -1, axisc: int = -1, axis: int = -1) -> DNDarray:
@@ -610,11 +686,22 @@ def einsum(subscripts: str, *operands: DNDarray, out=None) -> DNDarray:
         if out_split is not None:
             break
 
+    # 2-operand expressions record onto the fusion tape (epilogue fusion,
+    # and the filled(0) materialization barrier disappears); ``out=`` and
+    # other operand counts stay eager
+    if out is None and len(operands) == 2:
+        from .. import fusion
+
+        lazy = fusion.record_contract_einsum(
+            in_specs, out_part, operands[0], operands[1], out_split)
+        if lazy is not None:
+            return lazy
+
     # normalize every label to one physical extent: a label can pair a
     # padded (split) dim with an unpadded one across operands; zero-pad the
     # shorter dims — zeros contribute nothing to sum-of-products terms and
     # padded output positions are sliced away below
-    filled = [op.filled(0) for op in operands]
+    filled = [_filled0(op) for op in operands]
     sizes: dict = {}
     for arr, spec in zip(filled, in_specs):
         for ax, label in enumerate(spec):

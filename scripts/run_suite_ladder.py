@@ -120,10 +120,12 @@ def run_suite(n: int, timeout: float) -> dict:
 # fast, numerically-loaded subset for the fusion on/off A/B: the op-engine
 # surface where deferred evaluation could drift from eager semantics.
 # The reduction-heavy slice (statistics + nan-reductions + the distributed
-# statistics module) exercises the PR 4 reduction-fused tapes — the per-test
-# HEAT_TPU_LADDER_STATS log carries fusion_reduce_flushes next to the
-# executable counters so the A/B shows which tests actually took the
-# collective-fused path
+# statistics module) exercises the PR 4 reduction-fused tapes; the
+# linalg-heavy slice (linalg + transformer) the PR 5 contraction-fused
+# tapes — the per-test HEAT_TPU_LADDER_STATS log carries
+# fusion_reduce_flushes / fusion_contract_flushes next to the executable
+# counters so the A/B shows which tests actually took the
+# collective-fused paths
 _FUSION_AB_TESTS = [
     "tests/test_operations.py", "tests/test_arithmetics.py",
     "tests/test_fuzz_chains.py", "tests/test_rounding_exp_trig.py",
@@ -131,6 +133,10 @@ _FUSION_AB_TESTS = [
     # reduction-heavy slice
     "tests/test_statistics.py", "tests/test_nan_reductions.py",
     "tests/test_statistics_distributed.py",
+    # linalg-heavy slice (contraction-fused tapes: GEMM/einsum/tensordot
+    # record_contract paths + the transformer forward that inherits them)
+    "tests/test_linalg.py", "tests/test_linalg_more.py",
+    "tests/test_linalg_gauss.py", "tests/test_transformer.py",
 ]
 
 

@@ -99,6 +99,9 @@ def pytest_runtest_teardown(item, nextitem):
             "fusion_flushes": int(c.get("op_engine.fusion_flushes", 0)),
             "fusion_reduce_flushes": int(
                 c.get("op_engine.fusion_reduce_flushes", 0)),
+            "fusion_contract_flushes": int(
+                c.get("op_engine.fusion_contract_flushes", 0)),
+            "zero_fills": int(c.get("op_engine.zero_fills", 0)),
             "fusion_ops": int(c.get("op_engine.fusion_ops", 0)),
             "fusion_program_compiles": int(
                 c.get("fusion.program_compiles", 0)),

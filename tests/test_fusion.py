@@ -737,6 +737,477 @@ def test_reduce_opt_out_escape_hatch(monkeypatch):
     assert fusion.stats()["reduce_enabled"] is False
 
 
+# --------------------------------------------------------------------- #
+# contraction-fused tapes (planned distributed GEMM)                     #
+# --------------------------------------------------------------------- #
+def _gelu_ht(x):
+    """tanh-approx gelu out of recorded ht ops (several ew nodes)."""
+    return 0.5 * x * (ht.tanh((x + 0.044715 * (x * x * x)) * 0.7978845608) + 1.0)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int32"])
+def test_gemm_split_combination_sweep(dtype):
+    """ACCEPTANCE: matmul fused == eager for every split combination
+    a.split × b.split ∈ {None,0,1}², f32/bf16/int32, even and uneven
+    gshapes. BITWISE for ints (the shard-local-partial + psum
+    decomposition is the same one GSPMD lowers eager to); floats pin to
+    the documented GEMM numerics contract (MXU/FMA contraction order
+    inside one program may differ from the per-op dispatch by a few
+    ulp)."""
+    rng = np.random.default_rng(31)
+    for (n, k, m) in [(13, 5, 7), (8, 4, 12)]:  # uneven + even
+        if dtype == "int32":
+            ad = rng.integers(-6, 7, (n, k)).astype(np.int32)
+            bd = rng.integers(-6, 7, (k, m)).astype(np.int32)
+        else:
+            jdt = jnp.bfloat16 if dtype == "bfloat16" else np.float32
+            ad = rng.standard_normal((n, k)).astype(jdt)
+            bd = rng.standard_normal((k, m)).astype(jdt)
+        for sa in all_splits(2):
+            for sb in all_splits(2):
+                def chain(t, bop=None):
+                    mm = ht.matmul(t, bop)
+                    return ht.tanh(mm * 1.0 + 0.5) if dtype != "int32" \
+                        else mm * 2 + 1
+
+                with fusion.override(False):
+                    eager = chain(ht.array(ad, split=sa),
+                                  ht.array(bd, split=sb)).numpy()
+                with fusion.override(True):
+                    fused = chain(ht.array(ad, split=sa),
+                                  ht.array(bd, split=sb)).numpy()
+                assert eager.dtype == fused.dtype
+                assert eager.shape == fused.shape
+                if dtype == "int32":
+                    assert np.array_equal(eager, fused), \
+                        f"a.split={sa} b.split={sb} not bitwise"
+                else:
+                    eps = _reduce_eps(dtype)
+                    np.testing.assert_allclose(
+                        np.asarray(fused, np.float64),
+                        np.asarray(eager, np.float64),
+                        rtol=8 * eps, atol=8 * eps,
+                        err_msg=f"a.split={sa} b.split={sb} {dtype}")
+
+
+def test_gemm_records_and_output_split():
+    """matmul stays pending (records a contract node) and the output split
+    follows the case table: a.split=0 → 0, b.split=1 → 1, contracted-split
+    → replicated."""
+    rng = np.random.default_rng(1)
+    ad = rng.standard_normal((12, 8)).astype(np.float32)
+    bd = rng.standard_normal((8, 4)).astype(np.float32)
+    with fusion.override(True):
+        for sa, sb, want in [(0, None, 0), (None, 1, 1), (1, 0, None)]:
+            y = ht.matmul(ht.array(ad, split=sa), ht.array(bd, split=sb))
+            assert y._lazy_node is not None, "matmul must record"
+            assert y.split == want
+            np.testing.assert_allclose(y.numpy(), ad @ bd, rtol=1e-5,
+                                       atol=1e-5)
+
+
+def test_gemm_rowsplit_chain_zero_collectives():
+    """ACCEPTANCE AUDIT: a row-split matmul + elementwise epilogue lowers
+    to ONE executable with ZERO collectives — the local-GEMM-on-blocks
+    plan, never a GSPMD guess."""
+    if ht.get_comm().size == 1:
+        pytest.skip("needs a multi-device mesh")
+    fusion.reset()
+    fusion.capture_hlo(True)
+    try:
+        with fusion.override(True):
+            rng = np.random.default_rng(3)
+            x = ht.array(rng.standard_normal((13, 8)).astype(np.float32),
+                         split=0)
+            w = ht.array(rng.standard_normal((8, 6)).astype(np.float32))
+            compiles0 = fusion.program_cache().stats()["compiles"]
+            flushes0 = _flushes()
+            y = ht.tanh(ht.matmul(x, w) * 0.5 + 0.25)
+            y.numpy()
+            assert _flushes() - flushes0 == 1
+            assert fusion.program_cache().stats()["compiles"] - compiles0 == 1
+            hlo = fusion.last_hlo()
+            assert hlo is not None
+            assert collective_stats(hlo) == {}, \
+                f"row-split GEMM emitted collectives: {collective_stats(hlo)}"
+    finally:
+        fusion.capture_hlo(False)
+
+
+def test_gemm_contracted_split_exactly_one_allreduce():
+    """ACCEPTANCE AUDIT: a contracted-split matmul (a.split=1, b.split=0)
+    plus epilogue compiles to ONE executable containing EXACTLY ONE
+    all-reduce — the planner's psum, nothing else."""
+    if ht.get_comm().size == 1:
+        pytest.skip("needs a multi-device mesh")
+    fusion.reset()
+    fusion.capture_hlo(True)
+    try:
+        with fusion.override(True):
+            rng = np.random.default_rng(5)
+            ad = rng.standard_normal((9, 13)).astype(np.float32)  # k uneven
+            bd = rng.standard_normal((13, 6)).astype(np.float32)
+            a = ht.array(ad, split=1)
+            b = ht.array(bd, split=0)
+            flushes0 = _flushes()
+            y = ht.matmul(a, b) * 2.0 + 1.0
+            got = y.numpy()
+            assert _flushes() - flushes0 == 1
+            hlo = fusion.last_hlo()
+            assert hlo is not None
+            cs = collective_stats(hlo)
+            assert set(cs) == {"all-reduce"}, f"collectives: {cs}"
+            assert cs["all-reduce"]["count"] == 1
+            np.testing.assert_allclose(got, ad @ bd * 2 + 1, rtol=1e-4,
+                                       atol=1e-4)
+    finally:
+        fusion.capture_hlo(False)
+
+
+def test_gemm_bias_gelu_sum_one_executable():
+    """ACCEPTANCE AUDIT: ``matmul(x, w) + b → gelu → sum`` on the mesh
+    compiles to ONE executable whose only collective is the split-axis
+    sum's single all-reduce (the row-split GEMM contributes zero)."""
+    if ht.get_comm().size == 1:
+        pytest.skip("needs a multi-device mesh")
+    fusion.reset()
+    fusion.capture_hlo(True)
+    try:
+        with fusion.override(True):
+            rng = np.random.default_rng(7)
+            xd = rng.standard_normal((13, 8)).astype(np.float32)
+            wd = rng.standard_normal((8, 6)).astype(np.float32)
+            bd = rng.standard_normal((6,)).astype(np.float32)
+            x = ht.array(xd, split=0)
+            w = ht.array(wd)
+            bias = ht.array(bd)
+            compiles0 = fusion.program_cache().stats()["compiles"]
+            flushes0 = _flushes()
+            contract0 = _counter("op_engine.fusion_contract_flushes")
+            out = _gelu_ht(ht.matmul(x, w) + bias).sum(axis=0)
+            assert out._lazy_node is not None
+            got = out.numpy()
+            assert _flushes() - flushes0 == 1, "chain must flush once"
+            assert fusion.program_cache().stats()["compiles"] - compiles0 \
+                == 1, "chain must lower to ONE executable"
+            assert _counter("op_engine.fusion_contract_flushes") \
+                == contract0 + 1
+            hlo = fusion.last_hlo()
+            assert hlo is not None
+            cs = collective_stats(hlo)
+            assert set(cs) == {"all-reduce"}, f"collectives: {cs}"
+            assert cs["all-reduce"]["count"] == 1
+            t = xd @ wd + bd
+            want = (0.5 * t * (np.tanh((t + 0.044715 * t**3)
+                                       * 0.7978845608) + 1.0)).sum(0)
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    finally:
+        fusion.capture_hlo(False)
+
+
+def test_gemm_psum_packs_with_independent_reduction():
+    """ACCEPTANCE AUDIT: an independent matmul-psum and a reduction-psum
+    on the same tape combine in EXACTLY ONE packed all-reduce (the
+    arXiv:2004.09362 schedule discipline extended to contractions)."""
+    if ht.get_comm().size == 1:
+        pytest.skip("needs a multi-device mesh")
+    fusion.reset()
+    fusion.capture_hlo(True)
+    try:
+        with fusion.override(True):
+            rng = np.random.default_rng(11)
+            ad = rng.standard_normal((9, 13)).astype(np.float32)
+            bd = rng.standard_normal((13, 6)).astype(np.float32)
+            xd = rng.standard_normal((13, 6)).astype(np.float32)
+            z = ht.matmul(ht.array(ad, split=1), ht.array(bd, split=0)) \
+                + ht.sum(ht.array(xd, split=0), axis=0)
+            got = z.numpy()
+            hlo = fusion.last_hlo()
+            assert hlo is not None
+            cs = collective_stats(hlo)
+            assert set(cs) == {"all-reduce"}, f"collectives: {cs}"
+            assert cs["all-reduce"]["count"] == 1, \
+                f"matmul-psum and reduce-psum not packed: {cs}"
+            np.testing.assert_allclose(got, ad @ bd + xd.sum(0), rtol=1e-4,
+                                       atol=1e-4)
+    finally:
+        fusion.capture_hlo(False)
+
+
+def test_gemm_even_k_replicated_side_psum_planned_and_packed():
+    """REGRESSION (review): ``a.split=1`` × ``b`` replicated (and the
+    mirror) with the contracted extent EVENLY divisible by the mesh — no
+    alignment pad node exists to carry the replicated side to block
+    state, so the planner used to reject the tape into GSPMD and the
+    matmul-psum lost its packing with independent reductions (2
+    all-reduces instead of 1). The plan now dynamic-slices the replicated
+    side to its contracted-axis block."""
+    if ht.get_comm().size == 1:
+        pytest.skip("needs a multi-device mesh")
+    size = ht.get_comm().size
+    k = 2 * size  # even: comm.padded_size(k) == k, no pad node
+    rng = np.random.default_rng(17)
+    for sa, sb in ((1, None), (None, 0)):
+        ad = rng.standard_normal((5, k)).astype(np.float32)
+        bd = rng.standard_normal((k, 6)).astype(np.float32)
+        xd = rng.standard_normal((12, 6)).astype(np.float32)
+        fusion.reset()
+        fusion.capture_hlo(True)
+        try:
+            with fusion.override(True):
+                z = ht.matmul(ht.array(ad, split=sa),
+                              ht.array(bd, split=sb)) \
+                    + ht.sum(ht.array(xd, split=0), axis=0)
+                got = z.numpy()
+                hlo = fusion.last_hlo()
+                assert hlo is not None, f"({sa},{sb}): no fused program"
+                cs = collective_stats(hlo)
+                assert set(cs) == {"all-reduce"}, \
+                    f"({sa},{sb}) collectives: {cs}"
+                assert cs["all-reduce"]["count"] == 1, \
+                    f"({sa},{sb}) psum not planned/packed: {cs}"
+                np.testing.assert_allclose(got, ad @ bd + xd.sum(0),
+                                           rtol=1e-4, atol=1e-4)
+        finally:
+            fusion.capture_hlo(False)
+
+
+def test_gemm_steady_state_zero_recompiles_mixed_splits():
+    """ACCEPTANCE: repeated mixed-split GEMM chains serve from the program
+    cache — zero new misses after one warmup pass over the split cases."""
+    with fusion.override(True):
+        rng = np.random.default_rng(13)
+        ad = rng.standard_normal((12, 8)).astype(np.float32)
+        bd = rng.standard_normal((8, 4)).astype(np.float32)
+
+        def chain(sa, sb):
+            y = ht.matmul(ht.array(ad, split=sa), ht.array(bd, split=sb))
+            return (ht.tanh(y) * 0.5 + 1.0).numpy()
+
+        cases = [(0, None), (None, 1), (1, 0), (0, 1), (None, None)]
+        for sa, sb in cases:
+            chain(sa, sb)  # warm
+        s0 = fusion.program_cache().stats()
+        for _ in range(3):
+            for sa, sb in cases:
+                chain(sa, sb)
+        s = fusion.program_cache().stats()
+        assert s["misses"] == s0["misses"], "steady-state cache miss"
+        assert s["compiles"] == s0["compiles"]
+
+
+def test_einsum_tensordot_record_and_epilogue():
+    """2-operand einsum (and tensordot riding it) records a contract node:
+    the chain stays pending through the epilogue and flushes once, values
+    equal eager within the GEMM contract."""
+    rng = np.random.default_rng(17)
+    ad = rng.standard_normal((13, 5)).astype(np.float32)
+    bd = rng.standard_normal((5, 7)).astype(np.float32)
+    for sa, sb in [(0, None), (None, 0), (0, 0), (1, 0)]:
+        with fusion.override(False):
+            eager = (ht.tanh(ht.einsum(
+                "ij,jk->ik", ht.array(ad, split=sa),
+                ht.array(bd, split=sb))) * 2.0).numpy()
+        with fusion.override(True):
+            e = ht.einsum("ij,jk->ik", ht.array(ad, split=sa),
+                          ht.array(bd, split=sb))
+            assert e._lazy_node is not None, "einsum must record"
+            fused = (ht.tanh(e) * 2.0).numpy()
+        np.testing.assert_allclose(fused, eager, rtol=1e-5, atol=1e-5,
+                                   err_msg=f"splits {sa},{sb}")
+    # tensordot over a batched operand
+    td_a = rng.standard_normal((6, 4, 5)).astype(np.float32)
+    td_b = rng.standard_normal((5, 3)).astype(np.float32)
+    with fusion.override(True):
+        td = ht.tensordot(ht.array(td_a, split=0), ht.array(td_b), axes=1)
+        np.testing.assert_allclose(
+            td.numpy(), np.tensordot(td_a, td_b, axes=1), rtol=1e-5,
+            atol=1e-5)
+
+
+def test_contract_opt_out_escape_hatch(monkeypatch):
+    """HEAT_TPU_FUSION_CONTRACT=0 semantics: GEMMs dispatch eagerly on
+    zero-filled physical arrays while elementwise recording stays on."""
+    monkeypatch.setattr(fusion, "_CONTRACT", False)
+    with fusion.override(True):
+        a = ht.array(np.ones((8, 4), np.float32), split=0)
+        b = ht.array(np.ones((4, 4), np.float32))
+        y = ht.matmul(a, b)
+        assert y._lazy_node is None, "contract must not record when gated"
+        np.testing.assert_allclose(y.numpy(), np.full((8, 4), 4.0))
+    assert fusion.stats()["contract_enabled"] is False
+
+
+def test_gemm_donation_disabled_on_contract_tapes():
+    """Contract-carrying tapes never donate input buffers (same rule as
+    reduce tapes): rebinding GEMM chains stay correct."""
+    rng = np.random.default_rng(19)
+    ad = rng.standard_normal((12, 12)).astype(np.float32)
+    with fusion.override(False):
+        e = ht.array(ad, split=0)
+        for _ in range(3):
+            e = ht.matmul(e, e.resplit(None)) * 0.1
+        eager = e.numpy()
+    with fusion.override(True):
+        x = ht.array(ad, split=0)
+        for _ in range(3):
+            x = ht.matmul(x, x.resplit(None)) * 0.1
+        fused = x.numpy()
+    np.testing.assert_allclose(fused, eager, rtol=1e-4, atol=1e-4)
+
+
+def test_filled0_pad_is_zero_fast_path():
+    """Satellite: fresh factory/planner outputs carry ``pad_is_zero`` and
+    skip the GEMM masking pass; garbage-padded operands pay it ONCE (the
+    zero-filled buffer is written back) — ``op_engine.zero_fills`` counts
+    exactly the payers."""
+    rng = np.random.default_rng(23)
+    ad = rng.standard_normal((9, 13)).astype(np.float32)  # k=13 uneven
+    bd = rng.standard_normal((13, 6)).astype(np.float32)
+    with fusion.override(False):
+        b = ht.array(bd, split=0)
+        assert b.pad_is_zero, "from_logical output must be pad_is_zero"
+        a = ht.array(ad, split=1)
+        z0 = _counter("op_engine.zero_fills")
+        ht.matmul(a, b).numpy()
+        assert _counter("op_engine.zero_fills") == z0, \
+            "fresh operands must skip the zero-fill pass"
+        g = ht.exp(ht.array(ad, split=1))  # garbage padding (exp(0)=1)
+        g.larray
+        assert not g.pad_is_zero
+        z0 = _counter("op_engine.zero_fills")
+        r1 = ht.matmul(g, b).numpy()
+        assert _counter("op_engine.zero_fills") == z0 + 1
+        r2 = ht.matmul(g, b).numpy()  # write-back: second call is free
+        assert _counter("op_engine.zero_fills") == z0 + 1
+        np.testing.assert_allclose(r1, np.exp(ad) @ bd, rtol=1e-4, atol=1e-4)
+        np.testing.assert_array_equal(r1, r2)
+
+
+def test_fused_gemm_zero_fill_writeback_pays_once():
+    """REGRESSION (review): a concrete garbage-padded operand reused
+    across FUSED GEMMs pays the masking select exactly once — the fused
+    path takes the same ``_filled0`` write-back as eager, and the GEMM
+    output inherits ``pad_is_zero`` from its split operand."""
+    rng = np.random.default_rng(37)
+    ad = rng.standard_normal((9, 13)).astype(np.float32)  # k=13 uneven
+    bd = rng.standard_normal((13, 6)).astype(np.float32)
+    with fusion.override(True):
+        g = ht.exp(ht.array(ad, split=1))  # garbage padding (exp(0)=1)
+        g.larray  # materialize: concrete operand with pad_is_zero False
+        assert not g.pad_is_zero
+        b = ht.array(bd, split=0)
+        z0 = _counter("op_engine.zero_fills")
+        r1 = ht.matmul(g, b).numpy()
+        assert _counter("op_engine.zero_fills") == z0 + 1
+        assert g.pad_is_zero, "write-back must set the bit"
+        r2 = ht.matmul(g, b).numpy()
+        r3 = ht.matmul(g, b).numpy()
+        assert _counter("op_engine.zero_fills") == z0 + 1, \
+            "repeat fused GEMMs must not re-pay the masking pass"
+        np.testing.assert_array_equal(r1, r2)
+        np.testing.assert_array_equal(r2, r3)
+        np.testing.assert_allclose(r1, np.exp(ad) @ bd, rtol=1e-4,
+                                   atol=1e-4)
+        # output bit: a GEMM output never CLAIMS pad_is_zero (0 * inf = NaN
+        # can poison padding even for clean operands) — a downstream
+        # zero-fill consumer pays exactly one write-back select instead
+        x = ht.array(ad, split=0)
+        w = ht.array(bd)
+        y = ht.matmul(x, w)
+        y.larray
+        assert not y._pad_zero, \
+            "fused GEMM output must not claim zero padding (0*inf=NaN)"
+        z0 = _counter("op_engine.zero_fills")
+        yt = ht.matmul(ht.array(rng.standard_normal(
+            (6, 9)).astype(np.float32)), y)  # consumes y zero-filled
+        yt.larray
+        assert _counter("op_engine.zero_fills") == z0 + 1
+        ht.matmul(ht.array(rng.standard_normal(
+            (6, 9)).astype(np.float32)), y).larray
+        assert _counter("op_engine.zero_fills") == z0 + 1, \
+            "write-back must make the second consumer free"
+
+
+def test_pending_garbage_padded_operands_still_masked():
+    """REGRESSION (review): a PENDING tape array must never claim
+    ``pad_is_zero`` — ``DNDarray._lazy`` leaves ``__parray`` None, and a
+    ``None is None`` certificate match made record_contract skip the
+    zero-fill masks on pending chains whose padding holds garbage
+    (``exp(0)=1`` leaked into every element of the contracted-split
+    GEMM)."""
+    if ht.get_comm().size == 1:
+        pytest.skip("needs a multi-device mesh")
+    rng = np.random.default_rng(43)
+    ad = rng.standard_normal((4, 13)).astype(np.float32)  # k=13 uneven
+    bd = rng.standard_normal((13, 5)).astype(np.float32)
+    with fusion.override(True):
+        a = ht.exp(ht.array(ad, split=1))   # pending, garbage padding
+        b = ht.exp(ht.array(bd, split=0))   # pending, garbage padding
+        assert a._lazy_node is not None and not a.pad_is_zero
+        assert b._lazy_node is not None and not b.pad_is_zero
+        got = ht.matmul(a, b).numpy()
+    np.testing.assert_allclose(got, np.exp(ad) @ np.exp(bd), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_fused_gemm_aliased_operand_writeback():
+    """REGRESSION (review): ``matmul(x, x)`` on a garbage-padded concrete
+    array — the write-back swaps the buffer, and the aliased sibling
+    handle must see the post-write-back buffer, so the output's
+    ``pad_is_zero`` claim is actually true (a stale handle shipped garbage
+    into the program while the bit read True, corrupting ``filled(0)``'s
+    fast path downstream)."""
+    rng = np.random.default_rng(41)
+    d = rng.standard_normal((13, 13)).astype(np.float32)  # uneven square
+    for split in (0, 1):
+        with fusion.override(True):
+            g = ht.exp(ht.array(d, split=split))
+            g.larray  # concrete, garbage padding
+            assert not g.pad_is_zero
+            y = ht.matmul(g, g)
+            y.larray
+            # GEMM outputs never CLAIM zero padding — the bit must not lie
+            # about the post-write-back buffer the aliased handles share
+            assert not y._pad_zero, \
+                f"split={split}: GEMM output claimed pad_is_zero"
+            # downstream consumer of the bit (filled(0) fast path)
+            np.testing.assert_allclose(
+                y.numpy(), np.exp(d) @ np.exp(d), rtol=1e-3, atol=1e-3)
+            s = ht.sum(y, axis=0)
+            np.testing.assert_allclose(
+                s.numpy(), (np.exp(d) @ np.exp(d)).sum(0), rtol=1e-3,
+                atol=1e-3, err_msg=f"split={split} sum over fused GEMM")
+
+
+def test_batched_matmul_mappable_split_no_gather():
+    """Satellite: a mappable batch split runs on shard-local physical
+    blocks (no all-gather, split preserved); non-mappable layouts count
+    their unavoidable gathers in ``op_engine.align_resplits``."""
+    rng = np.random.default_rng(29)
+    A = rng.standard_normal((6, 9, 4)).astype(np.float32)  # batch uneven
+    B = rng.standard_normal((4, 3)).astype(np.float32)
+    r0 = _counter("op_engine.align_resplits")
+    r = ht.matmul(ht.array(A, split=0), ht.array(B))
+    assert r.split == 0
+    np.testing.assert_allclose(r.numpy(), A @ B, rtol=1e-5, atol=1e-5)
+    assert _counter("op_engine.align_resplits") == r0, \
+        "mappable batch split must not gather"
+    # both operands batch-split on the same axis: still block-local
+    B2 = rng.standard_normal((6, 4, 3)).astype(np.float32)
+    r0 = _counter("op_engine.align_resplits")
+    r2 = ht.matmul(ht.array(A, split=0), ht.array(B2, split=0))
+    assert r2.split == 0
+    np.testing.assert_allclose(r2.numpy(), A @ B2, rtol=1e-5, atol=1e-5)
+    assert _counter("op_engine.align_resplits") == r0
+    # non-mappable (split on a contracted dim): gather, counted
+    r0 = _counter("op_engine.align_resplits")
+    r3 = ht.matmul(ht.array(A, split=2), ht.array(B))
+    np.testing.assert_allclose(r3.numpy(), A @ B, rtol=1e-5, atol=1e-5)
+    assert _counter("op_engine.align_resplits") > r0, \
+        "unavoidable gather must be counted"
+
+
 def test_live_partial_results_promoted_with_reduce():
     """Live intermediates of a reduce tape (the sums a user keeps) are
     promoted to program outputs and carry correct combined values."""
