@@ -459,7 +459,14 @@ def _fusion_bench_main() -> None:
       intermediates; fused it is ONE shard_map program whose GEMM plan
       carries zero collectives and whose reduce psum is the only
       all-reduce. Sized so dispatch+traffic dominates the MXU-less CPU
-      GEMM (acceptance ≥ 1.5×).
+      GEMM (acceptance ≥ 1.5×);
+    * a layout-change pipeline (``fusion_resplit_chain_*``): elementwise
+      chain → ``resplit(0→1)`` → elementwise chain — the PR 6
+      resplit-node shape. Eager compiles THREE programs (chain, the
+      planner's reshard, chain) and materializes the intermediate at
+      full shard size on both sides of the boundary; fused it is ONE
+      shard_map program with the planner's single all-to-all placed
+      mid-body (acceptance ≥ 1.5×).
 
     Prints ONE JSON line with the speedups and the fusion program-cache
     stats proving the steady state runs zero recompiles.
@@ -536,6 +543,17 @@ def _fusion_bench_main() -> None:
         t = t * t + t
         return t.sum(axis=0)
 
+    def resplit_chain(a):
+        # chain → resplit(0→1) → chain: eager pays three programs and two
+        # full-size materializations around the layout change; fused the
+        # planner's ONE all-to-all rides mid-body in one program
+        t = (a - row) * 0.5
+        t = ht.tanh(t) + 0.25
+        t = t.resplit(1)
+        t = t * 2.0 + 0.125
+        t = abs(t) + 1.0
+        return t
+
     def timed(build, reps: int) -> float:
         out = build(x)  # compile + warm (cache miss lands here)
         jax.block_until_ready(out.larray)
@@ -549,7 +567,8 @@ def _fusion_bench_main() -> None:
     for label, build, reps in (("chain16", chain16, 30),
                                ("kmeans_mixed", kmeans_mixed, 30),
                                ("reduce_chain", reduce_chain, 30),
-                               ("gemm_chain", gemm_chain, 30)):
+                               ("gemm_chain", gemm_chain, 30),
+                               ("resplit_chain", resplit_chain, 30)):
         with fusion.override(False):
             t_eager = min(timed(build, reps) for _ in range(2))
         with fusion.override(True):
@@ -563,12 +582,15 @@ def _fusion_bench_main() -> None:
             jax.block_until_ready(chain16(x).larray)
             jax.block_until_ready(reduce_chain(x).larray)
             jax.block_until_ready(gemm_chain(x).larray)
+            jax.block_until_ready(resplit_chain(x).larray)
         cstats = fusion.program_cache().stats()
     record["fusion_steady_misses"] = cstats["misses"] - cstats0["misses"]
     record["fusion_program_cache"] = cstats
     record["fusion_ops_per_flush"] = fusion.stats()["ops_per_flush"]
     record["fusion_reduce_flushes"] = fusion.stats()["reduce_flushes"]
     record["fusion_contract_flushes"] = fusion.stats()["contract_flushes"]
+    record["fusion_resplit_nodes"] = fusion.stats()["resplit_nodes"]
+    record["fusion_resplit_fallbacks"] = fusion.stats()["resplit_fallbacks"]
     print(json.dumps(record), flush=True)
 
 

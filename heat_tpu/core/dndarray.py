@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import builtins
 import math
+import weakref
 from typing import List, Optional, Tuple, Union
 
 import numpy as np
@@ -433,11 +434,36 @@ class DNDarray:
         """In-place split-axis change (reference ``resplit_``, ``:1239-1361``).
 
         One jitted slice→pad→reshard XLA program; collectives ride ICI.
+        On a pending fusion tape the planner's move records as a RESPLIT
+        node instead of flushing (:func:`heat_tpu.core.fusion.record_resplit`)
+        — this array stays lazy, already carrying the target split.
         """
         if axis is not None:
             axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
             return self
+        if self._lazy_node is not None:
+            from . import fusion
+
+            lazy = fusion.record_resplit(self, axis)
+            if lazy is not None:
+                # the whole adoption runs under the flush lock: a
+                # concurrent sibling flush writes back into owners under
+                # that lock, and interleaving its owner-read with this
+                # rebind could land the PRE-resplit buffer under the
+                # post-resplit split metadata
+                with fusion._FLUSH_LOCK:
+                    node = lazy._lazy_node
+                    # detach the pre-resplit node first: it stays
+                    # evaluable as the RESPLIT node's input, but must stop
+                    # writing back into this array
+                    fusion.cancel(self)
+                    self._lazy_node = node
+                    node.owner = weakref.ref(self)
+                    self.__parray = None
+                    self._pad_zero_buf = None
+                    self.__split = axis
+                return self
         self.__parray = _reshard_physical(
             self.larray, self.__gshape, self.__split, axis, self.__comm
         )
@@ -446,15 +472,31 @@ class DNDarray:
         return self
 
     def resplit(self, axis=None) -> "DNDarray":
-        """Out-of-place resplit (reference ``manipulations.py:3325``)."""
+        """Out-of-place resplit (reference ``manipulations.py:3325``).
+
+        On a pending fusion tape the layout change records as a RESPLIT
+        node — the returned array is lazy, and the eventual flush places
+        the planner's collective mid-body in the one fused program."""
         if axis is not None:
             axis = sanitize_axis(self.__gshape, axis)
         if axis == self.__split:
+            if self._lazy_node is not None:
+                from . import fusion
+
+                alias = fusion.alias_pending(self)
+                if alias is not None:
+                    return alias  # no-op resplit must not flush the tape
             out = DNDarray(
                 self.larray, self.__gshape, self.__dtype, self.__split, self.__device, self.__comm
             )
             out._pad_zero = self._pad_zero  # shares the buffer verbatim
             return out
+        if self._lazy_node is not None:
+            from . import fusion
+
+            lazy = fusion.record_resplit(self, axis)
+            if lazy is not None:
+                return lazy
         parray = _reshard_physical(self.larray, self.__gshape, self.__split, axis, self.__comm)
         out = DNDarray(parray, self.__gshape, self.__dtype, axis, self.__device, self.__comm)
         out._pad_zero = True  # every reshard plan zero-pads the new axis

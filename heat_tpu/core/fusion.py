@@ -73,6 +73,26 @@ activation then a split-axis reduction compiles as ONE cached executable
 with exactly the planner's collectives. Opt-out:
 ``HEAT_TPU_FUSION_CONTRACT=0`` restores the eager ``_filled0`` GEMM.
 
+Resplit nodes (the reshard planner folded into the DAG)
+-------------------------------------------------------
+``DNDarray.resplit``/``resplit_`` on a PENDING tape records a **RESPLIT
+node** (:func:`record_resplit`) instead of flushing: the reshard
+planner (:mod:`.resharding`, arXiv:2112.01075) already knows the exact
+one-collective move per ``(from, to)`` pair, and ``_plan_sm`` translates
+it mid-body inside the one shard_map program — local pad → ONE
+``lax.all_to_all`` → local reslice for split→split, a zero-collective
+local ``dynamic_slice`` for None→split, ``all_gather`` for split→None —
+with per-node split state switching from the source to the target layout
+downstream of the node. ``chain → resplit → chain → reduce`` therefore
+compiles as ONE executable containing exactly the planner's collective
+count, and the op-engine's binary-op alignment resplits plus the
+manipulations family's pre-alignment resplits stop being flush barriers.
+Non-translatable cases (degenerate layouts, non-canonical physicals,
+foreign meshes) decline recording and take the historic
+flush-then-planned-resplit path — correctness never depends on the
+translation. Opt-out: ``HEAT_TPU_FUSION_RESPLIT=0``; counters
+``op_engine.fusion_resplit_nodes`` / ``_fallbacks`` / ``_flushes``.
+
 Program identity and caching
 ----------------------------
 A flush compiles at most once per *chain signature*: a structural key over
@@ -135,6 +155,8 @@ __all__ = [
     "record_reduce",
     "record_contract",
     "record_contract_einsum",
+    "record_resplit",
+    "alias_pending",
     "register_reduce_collective",
     "program_cache",
     "stats",
@@ -164,6 +186,10 @@ _REDUCE = _env_on("HEAT_TPU_FUSION_REDUCE")
 # dispatch eagerly on zero-filled physical arrays (the pre-contract-fusion
 # behavior), while elementwise/reduction recording stays on
 _CONTRACT = _env_on("HEAT_TPU_FUSION_CONTRACT")
+# escape hatch for the resplit-node extension alone: with 0, a resplit on
+# a pending tape flushes it and runs the eager planned reshard (the
+# pre-resplit-fusion behavior), while all other recording stays on
+_RESPLIT = _env_on("HEAT_TPU_FUSION_RESPLIT")
 
 _PROGRAMS = None  # lazy singleton (utils imports back into core)
 
@@ -236,9 +262,14 @@ def capture_hlo(flag: bool) -> None:
     """Debug switch: compile flush programs ahead-of-time and keep the
     optimized-HLO text of the most recent compile for :func:`last_hlo`
     (the collective audit in ``tests/test_fusion.py``). Only *compiles*
-    capture — reset :func:`program_cache` first to force one."""
-    global _capture_hlo
+    capture — reset :func:`program_cache` first to force one. Arming the
+    capture clears any previous dump: a cache-hit (or compile-error) path
+    must read as a loud ``last_hlo() is None``, never silently satisfy an
+    audit with a stale program's HLO."""
+    global _capture_hlo, _last_hlo
     _capture_hlo = bool(flag)
+    if _capture_hlo:
+        _last_hlo = None
 
 
 def last_hlo() -> Optional[str]:
@@ -268,21 +299,23 @@ class _Node:
     is set once a flush evaluates the node (it then acts as a leaf for any
     later chain that still references it).
 
-    ``kind``/``split``/``rmeta``/``cmeta``/``comm`` drive the shard_map
-    translation of reduce- and contract-containing tapes: ``kind`` is
+    ``kind``/``split``/``rmeta``/``cmeta``/``smeta``/``comm`` drive the
+    shard_map translation of collective-carrying tapes: ``kind`` is
     ``"ew"`` (elementwise/cum/astype), ``"pad"`` (replicated-operand
     physical pad), ``"mask"`` (neutral-element padding fill),
-    ``"reduce"``, ``"contract"`` (distributed GEMM/einsum), or ``"crop"``
-    (static slice back to canonical extents — never blockwise);
-    ``split`` is the physical split axis of the node's VALUE; ``rmeta``
-    holds the reduce metadata (collective kind, whether the split axis is
-    reduced, the input split); ``cmeta`` the contract metadata (split
-    case, collective, translatability); ``comm`` is set on reduce and
-    contract nodes only."""
+    ``"reduce"``, ``"contract"`` (distributed GEMM/einsum), ``"resplit"``
+    (the reshard planner's layout change folded into the DAG), or
+    ``"crop"`` (static slice back to canonical extents — never
+    blockwise); ``split`` is the physical split axis of the node's VALUE;
+    ``rmeta`` holds the reduce metadata (collective kind, whether the
+    split axis is reduced, the input split); ``cmeta`` the contract
+    metadata (split case, collective, translatability); ``smeta`` the
+    resplit metadata (source/target split); ``comm`` is set on reduce,
+    contract and resplit nodes only."""
 
     __slots__ = ("fn", "args", "kwargs", "kwargs_key", "aval", "depth",
                  "owner", "ext_refs", "value", "kind", "split", "rmeta",
-                 "cmeta", "comm", "__weakref__")
+                 "cmeta", "smeta", "comm", "__weakref__")
 
     def __init__(self, fn, args, kwargs, kwargs_key, aval, depth):
         self.fn = fn
@@ -298,6 +331,7 @@ class _Node:
         self.split = None
         self.rmeta = None
         self.cmeta = None
+        self.smeta = None
         self.comm = None
 
 
@@ -920,6 +954,106 @@ def record_contract_einsum(in_specs, out_part, a, b, out_split) -> Optional[obje
     return _wrap(node, tuple(logical), out_split, a.device, comm)
 
 
+def _resplit_op(a, gshape, pad, sharding):
+    """Module-level (stable identity) GLOBAL form of a planned resplit:
+    cut the source-axis tail padding, zero-pad the target axis, constrain
+    the target layout. Pure value semantics — the data motion is a
+    sharding change, which ``_sm_body`` renders as exactly the planner's
+    collective; this global form serves the plain-jit GSPMD fallback
+    (where the constraint hands XLA the intended layout) and the
+    eval-shape/aval machinery. The slice/pad steps are the PLANNER'S OWN
+    helpers so the fallback can never drift from the planner programs the
+    audits pin against. ``_flush_inline`` never calls it: short tapes
+    dispatch the eager planner program instead."""
+    from . import resharding
+
+    a = resharding._slice_logical(a, gshape)
+    for ax, (_lo, w) in enumerate(pad):
+        if w:
+            a = resharding._pad_axis(a, ax, int(a.shape[ax]) + int(w))
+    return jax.lax.with_sharding_constraint(a, sharding)
+
+
+def alias_pending(x) -> Optional[object]:
+    """A lazy copy-wrapper sharing ``x``'s pending node — the no-op
+    (same-split) ``resplit`` case, which the eager path serves as a
+    buffer-sharing wrapper and which must not flush the tape either.
+    The shared node's ``ext_refs`` is bumped under the flush lock so any
+    sibling flush promotes its value to a program output — the alias can
+    always materialize later, even after ``x`` dies (the same
+    stranded-value discipline as shared interior nodes)."""
+    from .dndarray import DNDarray
+
+    node = x._lazy_node
+    if node is None:
+        return None
+    with _FLUSH_LOCK:
+        if node.value is not None:
+            return None  # evaluated already: the concrete path is exact
+        node.ext_refs += 1
+    return DNDarray._lazy(node, x.gshape, x.dtype, x.split, x.device,
+                          x.comm)
+
+
+def record_resplit(x, to_split) -> Optional[object]:
+    """Lazy form of ``DNDarray.resplit``/``resplit_`` on a PENDING tape:
+    the reshard planner's one-collective move (arXiv:2112.01075 — one
+    all-to-all + local reslice for split→split, a zero-collective local
+    slice for None→split, all-gather for split→None) records as a RESPLIT
+    node instead of flushing the tape, and the flush translates it
+    mid-body inside the one shard_map program, with per-node split state
+    switching from the source to the target layout downstream of the
+    node. Declines (→ the historic flush-then-planned-resplit path,
+    counted in ``op_engine.fusion_resplit_fallbacks``) whenever the
+    planner itself would fall back to GSPMD: degenerate layouts, a
+    physical shape off the canonical from-layout. Concrete arrays (no
+    pending tape) never record — the eager planner path is already one
+    cached program, and the ``resharding.plan_*`` counters stay honest."""
+    from . import resharding
+
+    if x._lazy_node is None:
+        return None  # concrete arrays keep the eager planner path
+    if not _ENABLED or not _RESPLIT:
+        _metrics().inc("op_engine.fusion_resplit_fallbacks")
+        return None
+    comm = x.comm
+    gshape = tuple(int(s) for s in x.gshape)
+    from_split = x.split
+    if resharding.plan_kind(gshape, from_split, to_split, comm) == "gspmd":
+        _metrics().inc("op_engine.fusion_resplit_fallbacks")
+        return None
+    # the planner programs (and the blockwise translation) assume the
+    # canonical from-layout physical; anything else keeps the eager path
+    expect = list(gshape)
+    if from_split is not None:
+        expect[from_split] = comm.padded_size(gshape[from_split])
+    if tuple(x._phys_shape()) != tuple(expect):
+        _metrics().inc("op_engine.fusion_resplit_fallbacks")
+        return None
+    h = _handle_of(x)
+    if h is None:
+        _metrics().inc("op_engine.fusion_resplit_fallbacks")
+        return None
+    out_phys = list(gshape)
+    pad = [(0, 0)] * len(gshape)
+    if to_split is not None:
+        out_phys[to_split] = comm.padded_size(gshape[to_split])
+        pad[to_split] = (0, out_phys[to_split] - gshape[to_split])
+    node = _make_node(_resplit_op,
+                      {"gshape": gshape, "pad": tuple(pad),
+                       "sharding": comm.sharding(len(gshape), to_split)},
+                      (h,), tuple(out_phys))
+    if node is None:
+        _metrics().inc("op_engine.fusion_resplit_fallbacks")
+        return None
+    node.kind = "resplit"
+    node.split = to_split
+    node.smeta = {"from": from_split, "to": to_split}
+    node.comm = comm
+    _metrics().inc("op_engine.fusion_resplit_nodes")
+    return _wrap(node, gshape, to_split, x.device, comm)
+
+
 # ---------------------------------------------------------------------- #
 # flush                                                                  #
 # ---------------------------------------------------------------------- #
@@ -1020,9 +1154,10 @@ def _flush_locked(root: _Node) -> None:
     order, in_refs = _topo(root)
     has_reduce = any(n.kind == "reduce" for n in order)
     has_contract = any(n.kind == "contract" for n in order)
+    has_resplit = any(n.kind == "resplit" for n in order)
 
     if len(order) < _MIN_OPS and not _capture_hlo:
-        _flush_inline(order, has_reduce, has_contract)
+        _flush_inline(order, has_reduce, has_contract, has_resplit)
         return
 
     leaves = []        # unique concrete arrays, first-encounter order
@@ -1073,7 +1208,8 @@ def _flush_locked(root: _Node) -> None:
 
     touching = [n for n in order
                 if (n.kind == "reduce" and n.rmeta["touches"])
-                or (n.kind == "contract" and n.cmeta["case"] != "replicated")]
+                or (n.kind == "contract" and n.cmeta["case"] != "replicated")
+                or n.kind == "resplit"]
     comm = touching[0].comm if touching else None
     sm = None
     if touching and all(n.cmeta["translatable"] for n in order
@@ -1081,9 +1217,9 @@ def _flush_locked(root: _Node) -> None:
         # a gspmd-case contract anywhere on the tape dooms the plan at
         # that node — skip the O(tape) walk and go straight to plain-jit
         sm = _plan_sm(order, plan, leaves, leaf_splits, out_idx, comm)
-    if has_reduce or has_contract:
-        # reduce- and contract-carrying tapes compile without donation
-        # (documented contract, doc/fusion.md): the program is
+    if has_reduce or has_contract or has_resplit:
+        # reduce-, contract- and resplit-carrying tapes compile without
+        # donation (documented contract, doc/fusion.md): the program is
         # shard_map-shaped or collective-carrying, so buffer reuse buys
         # little — and donated inputs would complicate the
         # packed-collective body for zero win
@@ -1145,6 +1281,8 @@ def _flush_locked(root: _Node) -> None:
         m.inc("op_engine.fusion_reduce_flushes")
     if has_contract:
         m.inc("op_engine.fusion_contract_flushes")
+    if has_resplit:
+        m.inc("op_engine.fusion_resplit_flushes")
 
     for pos, res in zip(out_idx, results):
         node = order[pos]
@@ -1152,6 +1290,11 @@ def _flush_locked(root: _Node) -> None:
         owner = node.owner() if node.owner is not None else None
         if owner is not None:
             owner._set_materialized(res)
+            if node.kind == "resplit":
+                # the translation zero-pads the target axis (shard_map
+                # body and GSPMD fallback alike) — certify exactly this
+                # buffer, matching the eager planner's _pad_zero claim
+                owner._pad_zero_buf = res
     # evaluated interior nodes can never be demanded again (every external
     # holder was promoted to an output) — release their inputs promptly
     for node in order:
@@ -1248,6 +1391,23 @@ def _plan_sm(order, plan, leaves, leaf_splits, out_idx, comm):
             if not ok:
                 return None
             instrs.append(("contract", cm["collective"], blocks))
+        elif node.kind == "resplit":
+            # the planner's move mid-body: the collective sits between the
+            # upstream and downstream block computations, and the value's
+            # layout state switches from the source to the target split
+            if node.comm is not comm:
+                return None
+            (tag, i), = codes
+            j, k = node.smeta["from"], node.smeta["to"]
+            if state_of(tag, i) != j:
+                return None
+            gs = kwargs["gshape"]
+            expect = list(gs)
+            if j is not None:
+                expect[j] = comm.padded_size(gs[j])
+            if tuple(shape_of(tag, i)) != tuple(expect):
+                return None  # off-canonical value: let GSPMD sort it out
+            instrs.append(("resplit", j, k))
         elif node.kind == "crop":
             # a crop's limits span the GLOBAL padded extent — no blockwise
             # form exists (it only ever follows a gspmd-case contract)
@@ -1308,6 +1468,9 @@ def _sm_body(plan, sm, out_idx, comm):
     sched, instrs, phases, _, _ = sm
     axn = comm.axis_name
     size = comm.size
+    # lazy (utils/core cycle): the resplit branch reuses the planner's
+    # pad helper so the blockwise translation shares its one source
+    from . import resharding
 
     def body(*leaf_vals):
         vals = [None] * len(plan)
@@ -1366,6 +1529,41 @@ def _sm_body(plan, sm, out_idx, comm):
                     + start
                 vals[pos] = jnp.where(iota < kwargs["n"], a,
                                       jnp.asarray(kwargs["fill"], a.dtype))
+            elif op == "resplit":
+                # the reshard planner's per-(from, to) move on the local
+                # block (core/resharding.py, arXiv:2112.01075) — the
+                # collective placed mid-body, not at a flush barrier
+                a = args[0]
+                j, k = ins[1], ins[2]
+                gs = kwargs["gshape"]
+                if k is None:
+                    # split j → None: gathering IS the semantics here
+                    a = jax.lax.all_gather(a, axn, axis=j, tiled=True)
+                    if a.shape[j] != gs[j]:
+                        a = jax.lax.slice_in_dim(a, 0, gs[j], axis=j)
+                else:
+                    pad = kwargs["pad"]
+                    if pad[k][1]:
+                        # local zero-pad of axis k so the tile split (or
+                        # the canonical chunking) divides evenly — the
+                        # planner's own helper (core/resharding.py)
+                        a = resharding._pad_axis(
+                            a, k, a.shape[k] + pad[k][1])
+                    if j is None:
+                        # None → k: every device slices its own canonical
+                        # chunk out of the replicated value; ZERO
+                        # collectives
+                        ck = a.shape[k] // size
+                        a = jax.lax.dynamic_slice_in_dim(
+                            a, jax.lax.axis_index(axn) * ck, ck, axis=k)
+                    else:
+                        # j → k: ONE all_to_all (split_axis=k,
+                        # concat_axis=j) then cut axis j's tail padding
+                        a = jax.lax.all_to_all(
+                            a, axn, split_axis=k, concat_axis=j, tiled=True)
+                        if a.shape[j] != gs[j]:
+                            a = jax.lax.slice_in_dim(a, 0, gs[j], axis=j)
+                vals[pos] = a
             else:  # reduce/contract: shard-local partial (or local GEMM on
                 # blocks), combined at the phase barrier when a collective
                 # kind is attached
@@ -1382,20 +1580,32 @@ def _sm_body(plan, sm, out_idx, comm):
 
 
 def _flush_inline(order, has_reduce: bool = False,
-                  has_contract: bool = False) -> None:
+                  has_contract: bool = False,
+                  has_resplit: bool = False) -> None:
     """Evaluate a short chain op-by-op (children first — ``order`` is
     post-order): each dispatch reuses XLA's per-op executable cache, which
     every other chain in the process shares. Values land on every node, so
     later chains referencing them see leaves. Reduce and mask nodes carry
     global semantics, so the eager dispatch (GSPMD collective placement)
-    is exactly the pre-recording behavior."""
+    is exactly the pre-recording behavior; a resplit node dispatches the
+    eager PLANNER program (:func:`heat_tpu.core.resharding.reshard` —
+    plan-cache counters tick, like pre-recording)."""
     for node in order:
         args = [h.value if isinstance(h, _Node) else h.array
                 for h in node.args]
-        node.value = node.fn(*args, **node.kwargs)
+        if node.kind == "resplit":
+            from . import resharding
+
+            node.value = resharding.reshard(
+                args[0], node.kwargs["gshape"], node.smeta["from"],
+                node.smeta["to"], node.comm)
+        else:
+            node.value = node.fn(*args, **node.kwargs)
         owner = node.owner() if node.owner is not None else None
         if owner is not None:
             owner._set_materialized(node.value)
+            if node.kind == "resplit":
+                owner._pad_zero_buf = node.value  # planner zero-pads
     m = _metrics()
     m.inc("op_engine.fusion_flushes")
     m.inc("op_engine.fusion_ops", len(order))
@@ -1404,6 +1614,8 @@ def _flush_inline(order, has_reduce: bool = False,
         m.inc("op_engine.fusion_reduce_flushes")
     if has_contract:
         m.inc("op_engine.fusion_contract_flushes")
+    if has_resplit:
+        m.inc("op_engine.fusion_resplit_flushes")
     for node in order:
         node.args = ()
         node.kwargs = {}
@@ -1421,11 +1633,17 @@ def stats() -> dict:
         "enabled": _ENABLED,
         "reduce_enabled": _REDUCE,
         "contract_enabled": _CONTRACT,
+        "resplit_enabled": _RESPLIT,
         "flushes": flushes,
         "inline_flushes": int(c.get("op_engine.fusion_inline_flushes", 0)),
         "reduce_flushes": int(c.get("op_engine.fusion_reduce_flushes", 0)),
         "contract_flushes": int(
             c.get("op_engine.fusion_contract_flushes", 0)),
+        "resplit_flushes": int(
+            c.get("op_engine.fusion_resplit_flushes", 0)),
+        "resplit_nodes": int(c.get("op_engine.fusion_resplit_nodes", 0)),
+        "resplit_fallbacks": int(
+            c.get("op_engine.fusion_resplit_fallbacks", 0)),
         "fused_ops": ops,
         "ops_per_flush": round(ops / flushes, 3) if flushes else 0.0,
         "max_ops": _MAX_OPS,
@@ -1435,7 +1653,9 @@ def stats() -> dict:
 
 
 def reset() -> None:
-    """Drop cached programs and memoized avals (tests)."""
+    """Drop cached programs, memoized avals and the captured HLO (tests)."""
+    global _last_hlo
     program_cache().reset()
     _AVAL_CACHE.clear()
     _SCALAR_CACHE.clear()
+    _last_hlo = None

@@ -911,8 +911,13 @@ def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
             out_split = base_split + (1 if axis <= base_split else 0)
         result = _wrap_logical(res, out_split, arrays[0])
     if out is not None:
-        out.larray = result.resplit(out.split).larray
-        return out
+        from . import _operations
+
+        # the op engine's counted alignment helper: out-buffer sanitation,
+        # a recorded/counted resplit (op_engine.align_resplits) and the
+        # dtype cast — the raw ``result.resplit(out.split).larray`` here
+        # bypassed both the counter and the shape check
+        return _operations._finalize(result, out)
     return result
 
 

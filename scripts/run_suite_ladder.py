@@ -122,10 +122,12 @@ def run_suite(n: int, timeout: float) -> dict:
 # The reduction-heavy slice (statistics + nan-reductions + the distributed
 # statistics module) exercises the PR 4 reduction-fused tapes; the
 # linalg-heavy slice (linalg + transformer) the PR 5 contraction-fused
-# tapes — the per-test HEAT_TPU_LADDER_STATS log carries
-# fusion_reduce_flushes / fusion_contract_flushes next to the executable
-# counters so the A/B shows which tests actually took the
-# collective-fused paths
+# tapes; the manipulations-heavy slice the PR 6 resplit-fused tapes (the
+# alignment/pre-alignment resplit surface: concatenate/reshape/stack over
+# mixed splits) — the per-test HEAT_TPU_LADDER_STATS log carries
+# fusion_reduce_flushes / fusion_contract_flushes / fusion_resplit_nodes
+# next to the executable counters so the A/B shows which tests actually
+# took the collective-fused paths
 _FUSION_AB_TESTS = [
     "tests/test_operations.py", "tests/test_arithmetics.py",
     "tests/test_fuzz_chains.py", "tests/test_rounding_exp_trig.py",
@@ -137,6 +139,9 @@ _FUSION_AB_TESTS = [
     # record_contract paths + the transformer forward that inherits them)
     "tests/test_linalg.py", "tests/test_linalg_more.py",
     "tests/test_linalg_gauss.py", "tests/test_transformer.py",
+    # manipulations-heavy slice (resplit-fused tapes: record_resplit plus
+    # the concatenate/reshape/stack alignment resplits that now record)
+    "tests/test_manipulations.py", "tests/test_manips_distributed.py",
 ]
 
 
