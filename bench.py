@@ -466,7 +466,13 @@ def _fusion_bench_main() -> None:
       planner's reshard, chain) and materializes the intermediate at
       full shard size on both sides of the boundary; fused it is ONE
       shard_map program with the planner's single all-to-all placed
-      mid-body (acceptance ≥ 1.5×).
+      mid-body (acceptance ≥ 1.5×);
+    * a whole TRAIN STEP (``fusion_train_step_*``): tanh-MLP loss +
+      ``fusion.value_and_grad`` + SGD update over DNDarray params — the
+      PR 7 differentiable-tape shape. Eager pays a fresh grad trace plus
+      per-op dispatch and the update's chain flushes every step; under
+      ``fusion.trace_step`` the whole step is ONE cached donated
+      executable (acceptance ≥ 2×, the ISSUE 7 figure).
 
     Prints ONE JSON line with the speedups and the fusion program-cache
     stats proving the steady state runs zero recompiles.
@@ -585,12 +591,58 @@ def _fusion_bench_main() -> None:
             jax.block_until_ready(resplit_chain(x).larray)
         cstats = fusion.program_cache().stats()
     record["fusion_steady_misses"] = cstats["misses"] - cstats0["misses"]
-    record["fusion_program_cache"] = cstats
+
+    # ---- train-step stage: loss + grad + update as ONE executable ---- #
+    nt, dt, ht_ = 1 << 13, 64, 32
+    bx = ht.array(rng.standard_normal((nt, dt)).astype(np.float32), split=0)
+    by = ht.array(rng.standard_normal((nt, 1)).astype(np.float32), split=0)
+    p0 = {"w1": ht.array(rng.standard_normal((dt, ht_)).astype(np.float32)),
+          "b1": ht.array(np.zeros(ht_, np.float32)),
+          "w2": ht.array(rng.standard_normal((ht_, 1)).astype(np.float32))}
+
+    def train_step(p, a, b):
+        def loss_fn(q, xa, yb):
+            hdn = ht.tanh(ht.matmul(xa, q["w1"]) + q["b1"])
+            dlt = ht.matmul(hdn, q["w2"]) - yb
+            return ht.mean(dlt * dlt)
+
+        lval, g = fusion.value_and_grad(loss_fn)(p, a, b)
+        return {k: p[k] - 0.05 * g[k] for k in p}, lval
+
+    def timed_steps(step_fn, reps: int) -> float:
+        p = dict(p0)
+        p, lval = step_fn(p, bx, by)  # compile/trace warmup
+        jax.block_until_ready(lval.larray)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            p, lval = step_fn(p, bx, by)
+        jax.block_until_ready(lval.larray)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    with fusion.override(True), fusion.step_override(False):
+        t_eager = min(timed_steps(train_step, 10) for _ in range(2))
+    traced = fusion.trace_step(train_step)
+    with fusion.override(True), fusion.step_override(True):
+        t_fused = min(timed_steps(traced, 10) for _ in range(2))
+        sstats0 = fusion.program_cache().stats()
+        p = dict(p0)
+        for _ in range(5):
+            p, lval = traced(p, bx, by)
+        jax.block_until_ready(lval.larray)
+        sstats = fusion.program_cache().stats()
+    record["fusion_train_step_eager_ms"] = round(t_eager, 3)
+    record["fusion_train_step_fused_ms"] = round(t_fused, 3)
+    record["fusion_train_step_speedup"] = round(t_eager / t_fused, 2)
+    record["fusion_train_step_steady_misses"] = \
+        sstats["misses"] - sstats0["misses"]
+
+    record["fusion_program_cache"] = fusion.program_cache().stats()
     record["fusion_ops_per_flush"] = fusion.stats()["ops_per_flush"]
     record["fusion_reduce_flushes"] = fusion.stats()["reduce_flushes"]
     record["fusion_contract_flushes"] = fusion.stats()["contract_flushes"]
     record["fusion_resplit_nodes"] = fusion.stats()["resplit_nodes"]
     record["fusion_resplit_fallbacks"] = fusion.stats()["resplit_fallbacks"]
+    record["fusion_step_flushes"] = fusion.stats()["step_flushes"]
     print(json.dumps(record), flush=True)
 
 

@@ -108,16 +108,29 @@ def main():
     # tokens sharded along the sequence axis
     toks = ht.array(tokens, split=1).larray
 
-    @jax.jit
     def train_step(params, opt_state, toks):
         lval, grads = jax.value_and_grad(loss_fn)(params, toks)
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, lval
 
+    # one traced, donated-state executable for the whole step (loss +
+    # grad + optimizer update): repeat steps are a program-cache hit with
+    # zero host round-trips. HEAT_TPU_FUSION_STEP=0 (or the master
+    # HEAT_TPU_FUSION=0 — step_enabled() honors both) escapes back to a
+    # plain jitted step: same math, and still ONE program — a trace_step
+    # whose gate is off would run the body RAW per-op, never that.
+    if ht.fusion.step_enabled():
+        train_step = ht.fusion.trace_step(train_step, donate_argnums=(0, 1))
+    else:
+        train_step = jax.jit(train_step, donate_argnums=(0, 1))
+
     for step in range(args.steps):
         params, opt_state, lval = train_step(params, opt_state, toks)
         if step % 5 == 0 or step == args.steps - 1:
             print(f"step {step:3d}: loss {float(lval):.4f}")
+    stats = ht.fusion.stats()
+    print(f"fusion step flushes: {stats['step_flushes']} "
+          f"(fallbacks: {stats['step_fallbacks']})")
 
 
 if __name__ == "__main__":

@@ -122,6 +122,25 @@ accurate single rounding that can differ from eager (and NumPy) by 1 ulp.
 ``tests/test_fusion.py`` pins both properties; ``doc/fusion.md`` documents
 the contract.
 
+Differentiable tapes (whole-train-step tracing)
+-----------------------------------------------
+:func:`trace_step` compiles an entire user train step — loss, gradients
+via :func:`value_and_grad`, optimizer update — into ONE cached, donated
+executable over the ``DNDarray`` leaves of its arguments: the classic JAX
+one-jitted-train-step idiom the eager NumPy surface otherwise denies.
+Tracing reuses the op engine itself: under a jax trace every recorded-op
+entry point declines (tracers must never be captured into a cross-call
+tape), so the step body dispatches through the *eager* op semantics onto
+abstract leaves and the whole step lowers as one jaxpr. Gradient
+all-reduces for the model-level fused steps
+(:meth:`heat_tpu.nn.TransformerLM.make_train_step`,
+:class:`heat_tpu.nn.DataParallel`) are PACKED by :func:`packed_psum` —
+one flattened collective per dtype, the train-step form of the flush
+body's phase-barrier packing (arXiv:2004.09362). Step bodies that cannot
+trace (host branching on values, ``.numpy()``/``float()`` round-trips)
+fall back to the eager path, counted in
+``op_engine.fusion_step_fallbacks``. Opt-out: ``HEAT_TPU_FUSION_STEP=0``.
+
 Opt-out: ``HEAT_TPU_FUSION=0`` (or :func:`set_enabled` at runtime).
 Counters: ``op_engine.fusion_flushes``, ``op_engine.fusion_ops`` (their
 ratio is the ops-per-flush figure in ``ht.runtime_stats()``), plus the
@@ -163,6 +182,13 @@ __all__ = [
     "reset",
     "capture_hlo",
     "last_hlo",
+    "trace_step",
+    "value_and_grad",
+    "grad",
+    "packed_psum",
+    "step_enabled",
+    "set_step_enabled",
+    "step_override",
 ]
 
 
@@ -190,6 +216,11 @@ _CONTRACT = _env_on("HEAT_TPU_FUSION_CONTRACT")
 # a pending tape flushes it and runs the eager planned reshard (the
 # pre-resplit-fusion behavior), while all other recording stays on
 _RESPLIT = _env_on("HEAT_TPU_FUSION_RESPLIT")
+# escape hatch for the differentiable-tape extension alone: with 0,
+# trace_step-wrapped steps run their body eagerly (per-op dispatch, host
+# round-trips and all) and the model-level fused steps revert to their
+# historic GSPMD/check_vma train programs
+_STEP = _env_on("HEAT_TPU_FUSION_STEP")
 
 _PROGRAMS = None  # lazy singleton (utils imports back into core)
 
@@ -256,6 +287,33 @@ def override(flag: bool):
         yield
     finally:
         set_enabled(prev)
+
+
+def step_enabled() -> bool:
+    """Whether trace_step tracing (and the model-level fused train steps)
+    are on (``HEAT_TPU_FUSION_STEP``, default on; also requires the master
+    ``HEAT_TPU_FUSION`` switch)."""
+    return _ENABLED and _STEP
+
+
+def set_step_enabled(flag: bool) -> bool:
+    """Toggle the differentiable-tape extension alone; returns the
+    previous setting."""
+    global _STEP
+    prev = _STEP
+    _STEP = bool(flag)
+    return prev
+
+
+@contextlib.contextmanager
+def step_override(flag: bool):
+    """Context manager form of :func:`set_step_enabled` (the traced-vs-
+    eager property tests and the train-step bench A/B)."""
+    prev = set_step_enabled(flag)
+    try:
+        yield
+    finally:
+        set_step_enabled(prev)
 
 
 def capture_hlo(flag: bool) -> None:
@@ -404,6 +462,12 @@ def _scalar_leaf(s) -> Optional[_Leaf]:
         try:
             arr = jnp.asarray(s)
         except Exception:
+            return None
+        if isinstance(arr, jax.core.Tracer):
+            # inside a jax trace (user jit / trace_step) even a python
+            # constant lifts to a tracer on this jax; caching it would
+            # poison every later EAGER chain that reuses the same scalar
+            # (the flush reads leaf.array.sharding — tracers have none)
             return None
         if len(_SCALAR_CACHE) >= _SCALAR_CACHE_CAP:
             _SCALAR_CACHE.clear()
@@ -1622,6 +1686,392 @@ def _flush_inline(order, has_reduce: bool = False,
 
 
 # ---------------------------------------------------------------------- #
+# differentiable tapes: grads + whole-train-step tracing                 #
+# ---------------------------------------------------------------------- #
+class _Untraceable(Exception):
+    """A step argument/structure trace_step cannot key or trace."""
+
+
+def _isdnd(x) -> bool:
+    from .dndarray import DNDarray
+
+    return isinstance(x, DNDarray)
+
+
+def _is_arr(x) -> bool:
+    return isinstance(x, (jnp.ndarray, np.ndarray, np.generic, float,
+                          complex))
+
+
+def packed_psum(values, axes):
+    """ONE flattened all-reduce per dtype over mesh ``axes`` for a list of
+    mutually independent shard-local partials — the train-step form of the
+    flush body's phase-barrier packing (``_sm_body.emit_all``; the
+    generalized-allreduce flattening of arXiv:2004.09362). Call inside a
+    ``shard_map`` body; returns the combined values in order. ``axes``
+    empty (all trivial mesh axes) returns the inputs untouched — no
+    collective is emitted for a 1-device reduction scope. Flatten-concat-
+    psum is bitwise-equal to per-value solo psums (probed in PR 4: XLA
+    neither tuple-fuses grouped psums itself nor re-associates the
+    concatenated reduce), so packing never moves the numerics."""
+    values = list(values)
+    if not axes:
+        return values
+    groups: Dict[Any, list] = {}
+    for i, v in enumerate(values):
+        groups.setdefault(jnp.dtype(v.dtype), []).append(i)
+    out = list(values)
+    for _dt, members in groups.items():
+        if len(members) == 1:
+            i = members[0]
+            out[i] = jax.lax.psum(values[i], axes)
+            continue
+        packed = jnp.concatenate([values[i].reshape(-1) for i in members])
+        combined = jax.lax.psum(packed, axes)
+        off = 0
+        for i in members:
+            n = 1
+            for s in values[i].shape:
+                n *= s
+            out[i] = combined[off:off + n].reshape(values[i].shape)
+            off += n
+    return out
+
+
+def _dnd_meta(x):
+    """(rebuild metadata, signature entry) for one DNDarray leaf. The
+    signature entry is hashable and pins everything program identity
+    depends on; the metadata carries the live python objects (heat dtype,
+    device, comm) the rebuild needs."""
+    meta = ("dnd", x.gshape, x.dtype, x.split, x.device, x.comm)
+    sig = ("dnd", tuple(x.gshape), str(jnp.dtype(x.dtype.jax_type())),
+           x.split, x.comm.cache_key, str(x.device))
+    return meta, sig
+
+
+def _rebuild_dnd(meta, array):
+    from .dndarray import DNDarray
+
+    _, gshape, dtype, split, device, comm = meta
+    return DNDarray(array, gshape, dtype, split, device, comm)
+
+
+def value_and_grad(fun, argnums=0, has_aux=False):
+    """``jax.value_and_grad`` over functions of ``DNDarray`` pytrees — the
+    tape's grad-capable form.
+
+    ``fun`` must return a scalar (0-d ``DNDarray`` or jax scalar; with
+    ``has_aux`` a ``(scalar, aux)`` pair). The wrapper rebuilds the
+    differentiated arguments' ``DNDarray`` leaves around jax's abstract
+    leaves and traces ``fun`` through the op engine's EAGER semantics
+    (recording declines on tracers by design, so the traced jaxpr is
+    exactly the eager dispatch sequence); gradients come back as
+    ``DNDarray`` leaves mirroring each parameter's layout. No loss
+    cotangent ever flows into split-axis padding (every padding-crossing
+    read is masked by the op engine's neutral-element discipline), so
+    padded grad positions are don't-care — exact zeros for canonically
+    zero-padded parameters (factories, planner outputs); grads are NOT
+    certified ``pad_is_zero``, so consumers mask as usual.
+
+    Called EAGERLY this traces per invocation (the torch-autograd cost
+    shape); inside :func:`trace_step` the whole thing lowers into the one
+    cached step executable — that composition is the supported hot path.
+    The loss is returned as a 0-d ``DNDarray``; ``aux`` may contain
+    ``DNDarray`` leaves (rebuilt on the way out).
+    """
+    multi = isinstance(argnums, (tuple, list))
+    idxs = tuple(argnums) if multi else (int(argnums),)
+
+    def wrapped(*args, **kwargs):
+        from . import types
+        from .communication import sanitize_comm
+        from .dndarray import DNDarray
+
+        per_arg = [jax.tree_util.tree_flatten(args[i], is_leaf=_isdnd)
+                   for i in idxs]
+        metas, phys, spans = [], [], []
+        for leaves, _td in per_arg:
+            start = len(phys)
+            for leaf in leaves:
+                if _isdnd(leaf):
+                    m, _s = _dnd_meta(leaf)
+                    metas.append(m)
+                    phys.append(leaf.larray)
+                else:
+                    metas.append(("raw",))
+                    phys.append(jnp.asarray(leaf))
+            spans.append((start, len(phys)))
+        aux_meta = []
+
+        def pure(*leaf_arrays):
+            rebuilt = [_rebuild_dnd(m, a) if m[0] == "dnd" else a
+                       for m, a in zip(metas, leaf_arrays)]
+            args2 = list(args)
+            for j, i in enumerate(idxs):
+                lo, hi = spans[j]
+                args2[i] = jax.tree_util.tree_unflatten(
+                    per_arg[j][1], rebuilt[lo:hi])
+            out = fun(*args2, **kwargs)
+            if has_aux:
+                out, aux = out
+                aflat, atree = jax.tree_util.tree_flatten(aux,
+                                                          is_leaf=_isdnd)
+                del aux_meta[:]
+                aux_meta.append(atree)
+                aux_arrs = []
+                for a in aflat:
+                    if _isdnd(a):
+                        aux_meta.append(_dnd_meta(a)[0])
+                        aux_arrs.append(a.larray)
+                    else:
+                        aux_meta.append(("raw",))
+                        aux_arrs.append(a)
+            val = out.larray if _isdnd(out) else jnp.asarray(out)
+            val = val.reshape(())
+            return (val, tuple(aux_arrs)) if has_aux else val
+
+        vg = jax.value_and_grad(pure, argnums=tuple(range(len(phys))),
+                                has_aux=has_aux)
+        if has_aux:
+            (val, aux_arrs), gphys = vg(*phys)
+        else:
+            val, gphys = vg(*phys)
+        gleaves = [_rebuild_dnd(m, g) if m[0] == "dnd" else g
+                   for m, g in zip(metas, gphys)]
+        grads = tuple(
+            jax.tree_util.tree_unflatten(per_arg[j][1],
+                                         gleaves[spans[j][0]:spans[j][1]])
+            for j in range(len(idxs)))
+        if not multi:
+            grads = grads[0]
+        first_dnd = next((m for m in metas if m[0] == "dnd"), None)
+        comm = first_dnd[5] if first_dnd is not None else sanitize_comm(None)
+        device = first_dnd[4] if first_dnd is not None else None
+        from .devices import sanitize_device
+
+        vout = DNDarray(val, (), types.canonical_heat_type(val.dtype),
+                        None, sanitize_device(device), comm)
+        if has_aux:
+            atree, ams = aux_meta[0], aux_meta[1:]
+            aleaves = [_rebuild_dnd(m, a) if m[0] == "dnd" else a
+                       for m, a in zip(ams, aux_arrs)]
+            return (vout, jax.tree_util.tree_unflatten(atree, aleaves)), \
+                grads
+        return vout, grads
+
+    return wrapped
+
+
+def grad(fun, argnums=0, has_aux=False):
+    """:func:`value_and_grad` without the value."""
+    vg = value_and_grad(fun, argnums=argnums, has_aux=has_aux)
+
+    def wrapped(*args, **kwargs):
+        out, grads = vg(*args, **kwargs)
+        return (grads, out[1]) if has_aux else grads
+
+    return wrapped
+
+
+class _StepRecord:
+    """One compiled traced step: the jitted pure function plus the output
+    rebuild metadata captured during its first trace."""
+
+    __slots__ = ("jitted", "out_meta")
+
+    def __init__(self, jitted):
+        self.jitted = jitted
+        self.out_meta = None
+
+
+class _TracedStep:
+    """The callable :func:`trace_step` returns. Caches one compiled
+    program per structural signature of the arguments in the fusion
+    :func:`program_cache` (steady-state repeat calls are a key lookup and
+    one donated program dispatch — zero host round-trips)."""
+
+    def __init__(self, fn, donate_argnums=()):
+        self.fn = fn
+        self.donate_argnums = tuple(sorted(set(int(i)
+                                               for i in donate_argnums)))
+        # signatures whose first call failed to trace/compile: those
+        # stay eager. PER-SIGNATURE, not per-fn — one oversized batch
+        # failing to compile must not un-fuse the signatures already
+        # running fused (each new signature pays at most one failed
+        # trace before settling eager)
+        self._eager_keys = set()
+
+    def __call__(self, *args, **kwargs):
+        if not (_ENABLED and _STEP):
+            return self.fn(*args, **kwargs)
+        try:
+            flat, treedef = jax.tree_util.tree_flatten((args, kwargs),
+                                                       is_leaf=_isdnd)
+            metas, sig, phys = self._classify(flat)
+        except _Untraceable:
+            _metrics().inc("op_engine.fusion_step_fallbacks")
+            return self.fn(*args, **kwargs)
+        key = ("step", self.fn, treedef, tuple(sig), self.donate_argnums)
+        if key in self._eager_keys:
+            _metrics().inc("op_engine.fusion_step_fallbacks")
+            return self.fn(*args, **kwargs)
+        record = program_cache().get_custom(
+            key, lambda: self._build(args, treedef, metas))
+        primed = record.out_meta is not None  # this program ran before
+        try:
+            results = record.jitted(*phys)
+        except Exception:
+            if primed:
+                # a previously-successful program failed at DISPATCH
+                # (donated tree reused, device error): that is a real
+                # runtime error — surface it, don't silently degrade
+                # every later step to the eager path
+                raise
+            # first-call trace/compile failure: the body is not
+            # traceable at this signature. It may have half-run with
+            # tracers — step bodies must be functional (the standard jax
+            # contract) — so the eager re-run below is exact; this
+            # signature stays eager
+            self._eager_keys.add(key)
+            _metrics().inc("op_engine.fusion_step_fallbacks")
+            return self.fn(*args, **kwargs)
+        _metrics().inc("op_engine.fusion_step_flushes")
+        # out_meta is always set by the time jitted() returns: compiling
+        # needs the jaxpr, the jaxpr needs pure() to complete, and pure()
+        # writes the metadata before returning — in every thread
+        ometa, otree = record.out_meta
+        it = iter(results)
+        oleaves = []
+        for m in ometa:
+            if m[0] == "static":
+                oleaves.append(m[1])
+            elif m[0] == "dnd":
+                oleaves.append(_rebuild_dnd(m, next(it)))
+            else:
+                oleaves.append(next(it))
+        return jax.tree_util.tree_unflatten(otree, oleaves)
+
+    # -------------------------------------------------------------- #
+    def _classify(self, flat):
+        """Per-leaf (rebuild meta, hashable signature entry, program
+        argument). DNDarray leaves flush any pending tape here (the step
+        boundary) and enter as their physical arrays; raw arrays and
+        python floats enter as (weak-typed) arguments so one program
+        serves every value; ints/bools/strings are STATIC — they key the
+        program (shape-like and control-flow-like roles)."""
+        metas, sig, phys = [], [], []
+        for leaf in flat:
+            if _isdnd(leaf):
+                m, s = _dnd_meta(leaf)
+                metas.append(m)
+                sig.append(s)
+                phys.append(leaf.larray)
+            elif isinstance(leaf, jax.core.Tracer):
+                raise _Untraceable("tracer argument")  # nested-trace call
+            elif _is_arr(leaf):
+                a = jnp.asarray(leaf)
+                metas.append(("raw",))
+                sig.append(("arr", tuple(a.shape), str(a.dtype),
+                            bool(a.aval.weak_type)))
+                phys.append(a)
+            else:
+                k = _key_val(leaf)
+                if k is None:
+                    raise _Untraceable("unhashable static argument")
+                metas.append(("static", leaf))
+                sig.append(("static", k))
+        return metas, tuple(sig), phys
+
+    def _build(self, args, treedef, metas):
+        record = [None]  # box: pure() runs inside the jit trace
+
+        def pure(*leaf_arrays):
+            it = iter(leaf_arrays)
+            rebuilt = []
+            for m in metas:
+                if m[0] == "static":
+                    rebuilt.append(m[1])
+                elif m[0] == "dnd":
+                    rebuilt.append(_rebuild_dnd(m, next(it)))
+                else:
+                    rebuilt.append(next(it))
+            args2, kwargs2 = jax.tree_util.tree_unflatten(treedef, rebuilt)
+            out = self.fn(*args2, **kwargs2)
+            oflat, otree = jax.tree_util.tree_flatten(out, is_leaf=_isdnd)
+            ometa, oarrs = [], []
+            for o in oflat:
+                if _isdnd(o):
+                    ometa.append(_dnd_meta(o)[0])
+                    oarrs.append(o.larray)
+                elif isinstance(o, (jnp.ndarray, np.ndarray, np.generic,
+                                    jax.core.Tracer)):
+                    ometa.append(("raw",))
+                    oarrs.append(jnp.asarray(o))
+                else:
+                    # host-static output (int epoch counters, flags):
+                    # baked into the record; data-dependent host values
+                    # cannot reach here (float(tracer) raises upstream)
+                    ometa.append(("static", o))
+            record[0].out_meta = (tuple(ometa), otree)
+            return tuple(oarrs)
+
+        donate = self._donate_slots(args, metas)
+        record[0] = _StepRecord(jax.jit(pure, donate_argnums=donate))
+        return record[0]
+
+    def _donate_slots(self, args, metas):
+        """Flat dynamic-argument slots of the donated step arguments.
+        Donated ``DNDarray`` buffers are INVALIDATED by the call — the
+        functional-update idiom (``params, ... = step(params, ...)``)
+        rebinds them anyway, and XLA reuses the memory in place."""
+        if not self.donate_argnums:
+            return ()
+        spans, pos = [], 0
+        for a in args:
+            n = len(jax.tree_util.tree_flatten(a, is_leaf=_isdnd)[0])
+            spans.append((pos, pos + n))
+            pos += n
+        wanted = set()
+        for i in self.donate_argnums:
+            if i < len(spans):
+                wanted.update(range(*spans[i]))
+        out, dyn = [], 0
+        for slot, m in enumerate(metas):
+            if m[0] == "static":
+                continue
+            if slot in wanted:
+                out.append(dyn)
+            dyn += 1
+        return tuple(out)
+
+
+def trace_step(fn, donate_argnums=()):
+    """Compile a whole (functional) train step over ``DNDarray`` / jax
+    pytrees as ONE cached executable — loss, backward and optimizer
+    update in a single program with donated state.
+
+    ``fn`` must be functional: pytrees in, pytrees out, no host-side
+    value inspection (``float()``, ``.numpy()``, value-dependent
+    branches). The first call per argument signature traces ``fn`` on
+    abstract leaves — recorded ops decline tracers, so the body runs the
+    op engine's eager semantics symbolically — and compiles the jaxpr
+    once; repeat calls are a cache hit plus one program dispatch with
+    zero host round-trips (``op_engine.fusion_step_flushes`` counts
+    them). Non-traceable bodies fall back to the eager path — per
+    argument signature, so one failing signature never un-fuses the
+    others (``op_engine.fusion_step_fallbacks``; the semantics are
+    identical, the fusion is lost). ``donate_argnums`` marks positional
+    arguments
+    (params, optimizer state) whose buffers XLA may update in place —
+    their input ``DNDarray``\\ s are invalidated by the call.
+
+    Escape hatch: ``HEAT_TPU_FUSION_STEP=0`` (or
+    :func:`step_override`) runs every wrapped step eagerly.
+    """
+    return _TracedStep(fn, donate_argnums)
+
+
+# ---------------------------------------------------------------------- #
 # observability                                                          #
 # ---------------------------------------------------------------------- #
 def stats() -> dict:
@@ -1634,6 +2084,9 @@ def stats() -> dict:
         "reduce_enabled": _REDUCE,
         "contract_enabled": _CONTRACT,
         "resplit_enabled": _RESPLIT,
+        "step_enabled": _STEP,
+        "step_flushes": int(c.get("op_engine.fusion_step_flushes", 0)),
+        "step_fallbacks": int(c.get("op_engine.fusion_step_fallbacks", 0)),
         "flushes": flushes,
         "inline_flushes": int(c.get("op_engine.fusion_inline_flushes", 0)),
         "reduce_flushes": int(c.get("op_engine.fusion_reduce_flushes", 0)),

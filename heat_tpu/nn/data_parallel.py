@@ -52,6 +52,15 @@ class DataParallel:
         API parity with the reference (``:52``); the XLA schedule always
         overlaps communication with compute, so both modes are the fused
         step.
+    loss_is_batch_mean : bool, optional
+        Declares that ``loss_fn`` is a per-example MEAN over the batch
+        (plus optional replicated additive terms) — the decomposition the
+        packed-collective train step relies on (global mean == mean of
+        equal-shard means). Defaults to True for the built-in
+        cross-entropy and False for user losses: a sum-reduction loss
+        under the packed step would silently scale gradients by 1/world,
+        so custom losses keep the exact GSPMD step unless the caller
+        opts in here.
     """
 
     def __init__(
@@ -62,6 +71,7 @@ class DataParallel:
         loss_fn: Optional[Callable] = None,
         blocking_parameter_updates: bool = False,
         seed: int = 0,
+        loss_is_batch_mean: Optional[bool] = None,
     ):
         self.module = module
         self.comm = sanitize_comm(comm)
@@ -70,6 +80,10 @@ class DataParallel:
         self.seed = seed
         self.params = None
         self._train_step = None
+        self._packed_step = None
+        if loss_is_batch_mean is None:
+            loss_is_batch_mean = loss_fn is None  # default CE is a mean
+        self.loss_is_batch_mean = bool(loss_is_batch_mean)
         if loss_fn is None:
             from . import functional
 
@@ -119,23 +133,96 @@ class DataParallel:
 
         return jax.jit(train_step, donate_argnums=(0, 1))
 
+    def _build_packed_train_step(self):
+        """The packed-collective form of the train step: one ``shard_map``
+        program computing each device's gradients on its LOCAL batch shard
+        and combining every parameter cotangent — and the loss — in ONE
+        flattened all-reduce (:func:`heat_tpu.core.fusion.packed_psum`,
+        the arXiv:2004.09362 generalized-allreduce packing; the
+        reference's per-parameter Allreduce hooks collapse into it),
+        instead of the one-all-reduce-per-parameter GSPMD places for the
+        transposed batch sharding. Exact for batch-mean losses (equal
+        canonical shards): the global mean is the mean of per-shard means,
+        plus any replicated additive terms (regularizers)."""
+        import optax
+
+        from ..core import fusion
+        from ..core._compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        apply_fn = self.module.apply
+        loss_fn = self.loss_fn
+        tx = self.optimizer.tx
+        comm = self.comm
+        axis, p = comm.axis_name, comm.size
+
+        def body(params, opt_state, bx, by):
+            def local_loss(prm):
+                return loss_fn(apply_fn(prm, bx), by)
+
+            lval, grads = jax.value_and_grad(local_loss)(params)
+            leaves, treedef = jax.tree_util.tree_flatten(grads)
+            packed = fusion.packed_psum(leaves + [lval], (axis,))
+            grads = jax.tree_util.tree_unflatten(
+                treedef, [g / p for g in packed[:-1]])
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, packed[-1] / p
+
+        sm = shard_map(
+            body, mesh=comm.mesh,
+            in_specs=(P(), P(), P(axis), P(axis)),
+            out_specs=(P(), P(), P()),
+            check_vma=False)
+        return jax.jit(sm, donate_argnums=(0, 1))
+
+    def _pick_step(self, bx, by):
+        """Packed step when it applies (fusion step tracing on, a
+        declared batch-mean loss, a real mesh, the PHYSICAL batch
+        dividing over it); the GSPMD step otherwise — e.g. a custom
+        sum-reduction loss, a raw numpy batch whose length does not
+        divide the mesh, or ``HEAT_TPU_FUSION_STEP=0``. Note a split
+        ``DNDarray`` batch arrives as its padded physical array (always
+        mesh-divisible) on BOTH paths — the historic semantics: any
+        zero-padded tail rows participate in the loss mean identically
+        packed or GSPMD."""
+        from ..core import fusion
+
+        size = self.comm.size
+        if (fusion.step_enabled() and self.loss_is_batch_mean and size > 1
+                and bx.ndim >= 1 and bx.shape[0] % size == 0
+                and by.shape[:1] == bx.shape[:1]):
+            if self._packed_step is None:
+                self._packed_step = self._build_packed_train_step()
+            return self._packed_step
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        return self._train_step
+
     def step(self, x, y) -> float:
         """One fused data-parallel training step.
 
-        The batch arrives sharded over the mesh ('proc' = dp axis); gradient
-        averaging is the GSPMD psum the partitioner inserts (the reference's
-        blocking ``Allreduce(grad/size)`` hook, ``data_parallel.py:223-241``).
+        The batch arrives sharded over the mesh ('proc' = dp axis);
+        gradient averaging is ONE packed all-reduce carrying every
+        parameter cotangent (:meth:`_build_packed_train_step` — the
+        reference's blocking per-parameter ``Allreduce(grad/size)`` hooks,
+        ``data_parallel.py:223-241``, fused into a single flattened
+        collective), falling back to the GSPMD-placed step for uneven
+        batches or under ``HEAT_TPU_FUSION_STEP=0``.
         """
         if self.optimizer is None:
             raise RuntimeError("an optimizer is required for step()")
         if self.params is None:
             self.init(x)
-        if self._train_step is None:
-            self._train_step = self._build_train_step()
         bx, by = _as_jax(x), _as_jax(y)
-        self.params, self.optimizer.opt_state, loss = self._train_step(
+        step_fn = self._pick_step(bx, by)
+        self.params, self.optimizer.opt_state, loss = step_fn(
             self.params, self.optimizer.opt_state, bx, by
         )
+        if step_fn is self._packed_step:
+            from ..utils import metrics
+
+            metrics.inc("op_engine.fusion_step_flushes")
         return float(loss)
 
     def local_loss(self, x, y) -> float:

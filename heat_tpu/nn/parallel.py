@@ -184,6 +184,18 @@ def switch_moe(x, router_w, expert_up_shard, expert_down_shard, *, axis: str,
 # GPipe pipeline parallelism                                            #
 # --------------------------------------------------------------------- #
 
+def vma_capable() -> bool:
+    """Whether this jax can express varying-across-mesh-axes (vma/rep)
+    typing — the single capability gate for keeping identity psums whose
+    only job is clearing an axis-varying type (``pipeline_apply``'s
+    pp==1 branch, ``TransformerLM._psum_tp``). Superset probe: any of
+    the vma-era APIs present means the typing system may be live."""
+    import jax as _jax
+
+    return (hasattr(_jax, "typeof") or hasattr(lax, "pcast")
+            or hasattr(lax, "pvary"))
+
+
 def pipeline_apply(stage_fn: Callable, stage_params, x_micro, *, axis: str):
     """Run ``pp`` pipeline stages over microbatches (per-device, shard_map).
 
@@ -235,10 +247,16 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro, *, axis: str):
         # degenerate pipeline: run the stage per microbatch (scan, not vmap —
         # the stage may contain collectives over other axes). The identity
         # psum clears the axis-varying type the (pp-sharded) stage params
-        # impart under vma tracking, matching the pp>1 branch's out type.
+        # impart under vma tracking, matching the pp>1 branch's out type;
+        # without vma tracking it is a pure identity that still lowers to
+        # a singleton-group all-reduce PAIR through forward+backward —
+        # skip it there so the packed train step's collective audit stays
+        # exactly the plan's count (same capability gate as below)
         _, out = lax.scan(
             lambda c, xm: (c, stage_fn(stage_params, xm)), 0, x_micro)
-        return lax.psum(out, axis)
+        if vma_capable():
+            out = lax.psum(out, axis)
+        return out
 
     # initial carries are device-varying (they hold per-stage activations);
     # on jax versions without vma tracking (no pcast/pvary) the annotation

@@ -266,11 +266,10 @@ class TransformerLM:
             flat = m_in.reshape(mb * S_local, D)
             # expert hidden dim is tp-sharded: partial down-projections sum
             # over tp (one psum, mirroring the dense Megatron block)
-            moe_out = lax.psum(
+            moe_out = self._psum_tp(
                 switch_moe(
                     flat, p["router"], p["w_up"], p["w_down"], axis="dp",
-                    capacity_factor=c.capacity_factor),
-                "tp")
+                    capacity_factor=c.capacity_factor))
             return x + moe_out.reshape(mb, S_local, D)
         return self._dense_mlp_residual(p, x, m_in)
 
@@ -302,14 +301,28 @@ class TransformerLM:
             k = rope_apply(k, pos, c.rope_theta)
         return q, k, v
 
+    def _psum_tp(self, x):
+        """The Megatron-block tp reduction — skipped on tp=1 grids when
+        the jax has no vma tracking: a size-1-axis psum is a value
+        identity but still lowers to a (singleton-group) all-reduce pair
+        through forward+backward. Under vma tracking the identity psum
+        stays — ``check_vma=True`` needs it to clear the tp-varying type
+        (the SAME capability gate as ``pipeline_apply``'s pp==1 branch:
+        :func:`heat_tpu.nn.parallel.vma_capable`)."""
+        from .parallel import vma_capable
+
+        if self.tp > 1 or vma_capable():
+            return lax.psum(x, "tp")
+        return x
+
     def _attn_residual(self, p, x, attn):
         """Row-parallel output projection (one tp psum) + residual."""
-        return x + lax.psum(
-            jnp.einsum("bshk,hkd->bsd", attn, p["wproj"]), "tp")
+        return x + self._psum_tp(
+            jnp.einsum("bshk,hkd->bsd", attn, p["wproj"]))
 
     def _dense_mlp_residual(self, p, x, m_in):
         h = jax.nn.gelu(m_in @ p["w_up"])
-        return x + lax.psum(h @ p["w_down"], "tp")
+        return x + self._psum_tp(h @ p["w_down"])
 
     def _head(self, params, h):
         """Final norm + unembed; logits upcast to f32 only after the GEMM —
@@ -377,8 +390,15 @@ class TransformerLM:
             h = zigzag_unlayout(h, sp_comm)
         return self._head(params, h)
 
-    def _loss_device(self, params, toks):
-        """Per-device code: toks (B_local, S_local) -> replicated global loss."""
+    def _local_loss_device(self, params, toks):
+        """Per-device code: toks (B_local, S_local) -> this device's SHARE
+        of the global loss (local masked NLL sum over the static global
+        count). ``psum(local, ("dp", "sp")) == global loss`` — the
+        :meth:`_loss_device` form the check_vma path compiles — and
+        because the share is collective-free past the forward, the packed
+        train step can differentiate it per device and combine every
+        parameter cotangent in ONE flattened all-reduce
+        (:func:`heat_tpu.core.fusion.packed_psum`)."""
         B_local, S_local = toks.shape
         logits = self._forward_device(params, toks)
 
@@ -404,9 +424,12 @@ class TransformerLM:
         # the count is static — B_global rows each lose one position —
         # which also keeps it out of the vma system (a mask-sum would be
         # invarying over dp and unreducible there)
-        loss_sum = lax.psum(jnp.sum(nll * mask), ("dp", "sp"))
         count = B_local * self.dp * (S_local * sp - 1)
-        return loss_sum / count
+        return jnp.sum(nll * mask) / count
+
+    def _loss_device(self, params, toks):
+        """Per-device code: toks (B_local, S_local) -> replicated global loss."""
+        return lax.psum(self._local_loss_device(params, toks), ("dp", "sp"))
 
     # ------------------------------------------------------------- #
     # jitted steps                                                  #
@@ -421,25 +444,79 @@ class TransformerLM:
             jnp.asarray(toks, jnp.int32),
             NamedSharding(self.grid.mesh, self._data_spec()))
 
+    @property
+    def packed_step_supported(self) -> bool:
+        """Whether the packed-collective train step applies to this grid:
+        pp == tp == 1 and a dense MLP. Those are exactly the layouts
+        whose forward has no collective the ``check_vma=False`` AD
+        transpose mishandles — ppermute/all_to_all (the sp attention
+        schedules) transpose exactly without replication typing, while a
+        forward tp psum or the pipeline's masked psum broadcast needs vma
+        tracking for factor-free cotangents of replicated parameters."""
+        return self.pp == 1 and self.tp == 1 and not self.cfg.moe_experts
+
+    def _batch_axes(self):
+        """Non-trivial data axes — the reduction scope of the packed
+        gradient all-reduce (empty on a 1-device grid: no collective)."""
+        return tuple(a for a, n in (("dp", self.dp), ("sp", self.sp))
+                     if n > 1)
+
+    def _packed_loss_and_grad_body(self):
+        """Per-device (params, toks) -> (loss, grads) with every gradient
+        cotangent — and the loss — combined in ONE flattened all-reduce:
+        local value_and_grad of the device's loss share, then
+        :func:`heat_tpu.core.fusion.packed_psum` over the data axes (the
+        generalized-allreduce packing, arXiv:2004.09362), instead of the
+        one-psum-per-parameter GSPMD emits for the transposed broadcast."""
+        from ..core import fusion
+
+        axes = self._batch_axes()
+
+        def body(params, toks):
+            lval, grads = jax.value_and_grad(
+                self._local_loss_device)(params, toks)
+            leaves, treedef = jax.tree_util.tree_flatten(grads)
+            packed = fusion.packed_psum(leaves + [lval], axes)
+            return packed[-1], jax.tree_util.tree_unflatten(
+                treedef, packed[:-1])
+
+        return body
+
     def loss_and_grad_fn(self):
-        """jitted (params, toks) -> (loss, grads) over the full grid."""
-        key = "loss_and_grad"
+        """jitted (params, toks) -> (loss, grads) over the full grid.
+
+        On grids the packed step supports (and with
+        ``HEAT_TPU_FUSION_STEP`` on) the gradient collectives are packed
+        into one flattened all-reduce under ``check_vma=False``; other
+        grids keep the check_vma path (vma tracking makes every
+        collective transpose exact for pipeline/tensor parallelism)."""
+        from ..core import fusion
+
+        packed = self.packed_step_supported and fusion.step_enabled()
+        key = ("loss_and_grad", packed)
         fn = self._step_cache.get(key)
         if fn is None:
             specs = self.param_specs()
+            if packed:
+                sm = shard_map(
+                    self._packed_loss_and_grad_body(), mesh=self.grid.mesh,
+                    in_specs=(specs, self._data_spec()),
+                    out_specs=(P(), specs),
+                    check_vma=False)
+            else:
+                def body(params, toks):
+                    return jax.value_and_grad(self._loss_device)(params, toks)
 
-            def body(params, toks):
-                return jax.value_and_grad(self._loss_device)(params, toks)
-
-            # check_vma=True: replication (varying-across-mesh-axes) types
-            # are tracked, so collective transposes are exact — gradients
-            # of replicated parameters are psum'd across exactly the axes
-            # they are replicated over, with no seed-count factors
-            sm = shard_map(
-                body, mesh=self.grid.mesh,
-                in_specs=(specs, self._data_spec()),
-                out_specs=(P(), specs),
-                check_vma=True)
+                # check_vma=True: replication (varying-across-mesh-axes)
+                # types are tracked, so collective transposes are exact —
+                # gradients of replicated parameters are psum'd across
+                # exactly the axes they are replicated over, with no
+                # seed-count factors
+                sm = shard_map(
+                    body, mesh=self.grid.mesh,
+                    in_specs=(specs, self._data_spec()),
+                    out_specs=(P(), specs),
+                    check_vma=True)
             fn = jax.jit(sm)
             self._step_cache[key] = fn
         return fn
@@ -466,9 +543,56 @@ class TransformerLM:
 
     def make_train_step(self, tx):
         """jitted (params, opt_state, toks) -> (params, opt_state, loss)
-        with an optax transform ``tx``; the optimizer update runs GSPMD
-        over the same shardings."""
+        with an optax transform ``tx``, parameter/optimizer state donated.
+
+        On grids :attr:`packed_step_supported` covers (and with
+        ``HEAT_TPU_FUSION_STEP`` on) the WHOLE step — forward, backward,
+        packed gradient all-reduce, optimizer update — is one
+        ``shard_map`` program: the collective count is the packed plan's
+        (one flattened all-reduce over the data axes carrying every
+        parameter cotangent plus the loss), not one-per-parameter, and
+        repeat calls are a single donated program dispatch with zero host
+        round-trips. Other grids compose the check_vma loss-and-grad
+        program with a GSPMD optimizer update under one outer jit (the
+        historic path)."""
         import optax
+
+        from ..core import fusion
+
+        if self.packed_step_supported and fusion.step_enabled():
+            specs = self.param_specs()
+            lg_body = self._packed_loss_and_grad_body()
+
+            def body(params, opt_state, toks):
+                loss, grads = lg_body(params, toks)
+                updates, opt_state = tx.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), opt_state, loss
+
+            # opt_state rides as a replicated pytree (P() spec prefix):
+            # the update math is identical on every device, like params
+            sm = shard_map(
+                body, mesh=self.grid.mesh,
+                in_specs=(specs, P(), self._data_spec()),
+                out_specs=(specs, P(), P()),
+                check_vma=False)
+            jitted = jax.jit(sm, donate_argnums=(0, 1))
+
+            def step(params, opt_state, toks):
+                out = jitted(params, opt_state, toks)
+                # the model-level fused step counts like a traced step
+                # (DataParallel's packed path does the same), so the
+                # ladder's per-test fusion_step_flushes line shows the
+                # packed path actually ran
+                from ..utils import metrics
+
+                metrics.inc("op_engine.fusion_step_flushes")
+                return out
+
+            # the audit/steady-state surface of the underlying program
+            step.lower = jitted.lower
+            if hasattr(jitted, "_cache_size"):
+                step._cache_size = jitted._cache_size
+            return step
 
         lg = self.loss_and_grad_fn()
 

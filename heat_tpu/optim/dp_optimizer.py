@@ -71,6 +71,18 @@ class DataParallelOptimizer:
 
     ``blocking`` is accepted for parity; the fused XLA step always overlaps
     the gradient reduction with the backward pass.
+
+    Two usage modes:
+
+    * attached to :class:`heat_tpu.nn.DataParallel` — the update runs
+      inside the trainer's fused step; :meth:`step` stays the historic
+      no-op shim.
+    * functional over ``DNDarray`` (or jax) parameter pytrees —
+      :meth:`apply_gradients` applies the WHOLE update tree in ONE traced
+      flush (:func:`heat_tpu.core.fusion.trace_step`, donated optimizer
+      state): one cached executable per parameter-tree signature instead
+      of one dispatched program per parameter leaf, counted under
+      ``op_engine.fusion_step_flushes``.
     """
 
     def __init__(self, optimizer, blocking: bool = False):
@@ -80,17 +92,75 @@ class DataParallelOptimizer:
         self.blocking = blocking
         self.opt_state = None
         self._net = None
+        self._traced_apply = None
 
     def _attach(self, net) -> None:
         self._net = net
 
-    def reset_state(self, params) -> None:
-        self.opt_state = self.tx.init(params)
+    @staticmethod
+    def _unwrap(tree):
+        """``DNDarray`` leaves -> their physical jax arrays (optax sees a
+        uniform jax pytree; layout metadata stays on the wrapper side)."""
+        from ..core.dndarray import DNDarray
 
-    def step(self) -> None:
-        """No-op shim (reference defers step in non-blocking mode ``:861``):
-        the update happens inside the fused train step."""
-        return None
+        return jax.tree_util.tree_map(
+            lambda x: x.larray if isinstance(x, DNDarray) else x, tree,
+            is_leaf=lambda x: isinstance(x, DNDarray))
+
+    def reset_state(self, params) -> None:
+        self.opt_state = self.tx.init(self._unwrap(params))
+
+    def apply_gradients(self, params, grads):
+        """Functional update: ``new_params`` mirroring ``params`` (same
+        pytree, same ``DNDarray`` layouts), with ``self.opt_state``
+        advanced. The whole tree updates in ONE traced flush — repeat
+        calls hit the step program cache; the optimizer-state buffers are
+        donated (updated in place). Initializes state lazily on first
+        use."""
+        from ..core import fusion
+
+        if self.opt_state is None:
+            self.reset_state(params)
+        if self._traced_apply is None:
+            tx = self.tx
+            unwrap = self._unwrap
+
+            def _apply(params, opt_state, grads):
+                import optax
+
+                p, g = unwrap(params), unwrap(grads)
+                updates, opt_state = tx.update(g, opt_state, p)
+                new_p = optax.apply_updates(p, updates)
+                # re-wrap: each new leaf inherits its parameter's layout
+                from ..core.dndarray import DNDarray
+
+                def rewrap(old, new):
+                    if isinstance(old, DNDarray):
+                        return DNDarray(new, old.gshape, old.dtype,
+                                        old.split, old.device, old.comm)
+                    return new
+
+                new_params = jax.tree_util.tree_map(
+                    rewrap, params, new_p,
+                    is_leaf=lambda x: isinstance(x, DNDarray))
+                return new_params, opt_state
+
+            self._traced_apply = fusion.trace_step(_apply,
+                                                   donate_argnums=(1,))
+        new_params, self.opt_state = self._traced_apply(
+            params, self.opt_state, grads)
+        return new_params
+
+    def step(self, params=None, grads=None):
+        """With ``(params, grads)``: one batched functional update
+        (:meth:`apply_gradients`). Argless: the historic no-op shim
+        (reference defers step in non-blocking mode ``:861`` — the update
+        happens inside the attached trainer's fused train step)."""
+        if params is None and grads is None:
+            return None
+        if params is None or grads is None:
+            raise TypeError("step() takes both params and grads (or neither)")
+        return self.apply_gradients(params, grads)
 
     def zero_grad(self) -> None:
         """No-op: functional gradients are never accumulated in place."""

@@ -152,7 +152,8 @@ def runtime_stats() -> dict:
     from . import executor as _executor
 
     depth = 0
-    cache_stats = {"hits": 0, "misses": 0, "compiles": 0, "entries": 0}
+    cache_stats = {"hits": 0, "misses": 0, "compiles": 0, "evictions": 0,
+                   "entries": 0}
     n_exec = 0
     caches = {}  # dedupe by identity: executors may SHARE a ProgramCache
     for ex in _executor.live_executors():

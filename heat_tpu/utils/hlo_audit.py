@@ -19,7 +19,8 @@ from __future__ import annotations
 import re
 from typing import Dict
 
-__all__ = ["collective_stats", "total_collective_bytes", "memory_stats",
+__all__ = ["collective_stats", "communicating_collective_stats",
+           "total_collective_bytes", "memory_stats",
            "entry_root_shapes", "COLLECTIVES"]
 
 COLLECTIVES = (
@@ -70,6 +71,57 @@ def collective_stats(hlo: str) -> Dict[str, Dict[str, int]]:
 
 def total_collective_bytes(stats: Dict[str, Dict[str, int]]) -> int:
     return sum(v["bytes"] for v in stats.values())
+
+
+_ONE_GROUP_RE = re.compile(r"\{([\d,\s]*)\}")
+
+
+def _moves_data(line: str) -> bool:
+    """Whether a collective instruction line actually communicates: at
+    least one replica group has more than one participant. Identity psums
+    over size-1 mesh axes lower to singleton-group all-reduces
+    (``replica_groups={{0},{1},...}``) that move ZERO bytes — the
+    packed-collective train-step audits must not count them, and must not
+    be fooled when another jax keeps them."""
+    tag = "replica_groups="
+    start = line.find(tag)
+    if start < 0:
+        return True  # no group annotation: count conservatively
+    rest = line[start + len(tag):]
+    if rest.startswith("["):
+        # iota form: replica_groups=[G,S]<=[...] — G groups of size S;
+        # singleton groups (S == 1) move nothing
+        m = re.match(r"\[(\d+),(\d+)\]", rest)
+        return True if m is None else int(m.group(2)) > 1
+    if not rest.startswith("{"):
+        return True
+    # balanced-brace scan (groups nest one level: {{0},{1}} or flat {0,1})
+    depth = 0
+    for j, ch in enumerate(rest):
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                body = rest[1:j]
+                groups = _ONE_GROUP_RE.findall(body)
+                if groups:
+                    return any("," in g for g in groups)
+                if not body.strip():
+                    # empty replica_groups = ONE group of all replicas —
+                    # that collective communicates
+                    return True
+                return "," in body  # flat single group: {0,1,2,3}
+    return True
+
+
+def communicating_collective_stats(hlo: str) -> Dict[str, Dict[str, int]]:
+    """:func:`collective_stats` restricted to instructions that move data
+    between devices (non-singleton replica groups)."""
+    kept = [line for line in hlo.splitlines()
+            if _INSTR_RE.match(_COMMENT_RE.sub("", line)) is not None
+            and _moves_data(_COMMENT_RE.sub("", line))]
+    return collective_stats("\n".join(kept))
 
 
 _ROOT_ASSIGN_RE = re.compile(r"^\s*ROOT\s+%?[\w.\-]+\s*=\s*")

@@ -58,6 +58,7 @@ class ProgramCache:
         self.hits = 0
         self.misses = 0
         self.compiles = 0
+        self.evictions = 0  # cap-clear events (capped caches only)
 
     # ------------------------------------------------------------------ #
     # generic form                                                       #
@@ -82,6 +83,8 @@ class ProgramCache:
             if self.max_entries is not None and \
                     len(self._programs) >= self.max_entries:
                 self._programs.clear()
+                self.evictions += 1
+                _metrics.inc(f"{self.counter_prefix}.program_evictions")
             self._programs[key] = prog
             self.compiles += 1
         _metrics.inc(f"{self.counter_prefix}.program_compiles")
@@ -118,6 +121,7 @@ class ProgramCache:
         with self._lock:
             return {"hits": self.hits, "misses": self.misses,
                     "compiles": self.compiles,
+                    "evictions": self.evictions,
                     "entries": len(self._programs)}
 
     def reset(self) -> None:
@@ -126,6 +130,7 @@ class ProgramCache:
             self.hits = 0
             self.misses = 0
             self.compiles = 0
+            self.evictions = 0
 
     def __len__(self) -> int:
         with self._lock:

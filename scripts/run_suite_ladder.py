@@ -125,9 +125,9 @@ def run_suite(n: int, timeout: float) -> dict:
 # tapes; the manipulations-heavy slice the PR 6 resplit-fused tapes (the
 # alignment/pre-alignment resplit surface: concatenate/reshape/stack over
 # mixed splits) — the per-test HEAT_TPU_LADDER_STATS log carries
-# fusion_reduce_flushes / fusion_contract_flushes / fusion_resplit_nodes
-# next to the executable counters so the A/B shows which tests actually
-# took the collective-fused paths
+# fusion_reduce_flushes / fusion_contract_flushes / fusion_resplit_nodes /
+# fusion_step_flushes next to the executable counters so the A/B shows
+# which tests actually took the collective-fused paths
 _FUSION_AB_TESTS = [
     "tests/test_operations.py", "tests/test_arithmetics.py",
     "tests/test_fuzz_chains.py", "tests/test_rounding_exp_trig.py",
@@ -142,6 +142,10 @@ _FUSION_AB_TESTS = [
     # manipulations-heavy slice (resplit-fused tapes: record_resplit plus
     # the concatenate/reshape/stack alignment resplits that now record)
     "tests/test_manipulations.py", "tests/test_manips_distributed.py",
+    # training-heavy slice (differentiable tapes: trace_step train steps,
+    # packed-gradient transformer/DataParallel steps, batched optimizer
+    # updates — fusion_step_flushes logged per test)
+    "tests/test_trace_step.py", "tests/test_nn_optim_data.py",
 ]
 
 

@@ -105,6 +105,10 @@ def pytest_runtest_teardown(item, nextitem):
                 c.get("op_engine.fusion_resplit_nodes", 0)),
             "fusion_resplit_fallbacks": int(
                 c.get("op_engine.fusion_resplit_fallbacks", 0)),
+            "fusion_step_flushes": int(
+                c.get("op_engine.fusion_step_flushes", 0)),
+            "fusion_step_fallbacks": int(
+                c.get("op_engine.fusion_step_fallbacks", 0)),
             "zero_fills": int(c.get("op_engine.zero_fills", 0)),
             "fusion_ops": int(c.get("op_engine.fusion_ops", 0)),
             "fusion_program_compiles": int(

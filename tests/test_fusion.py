@@ -471,7 +471,8 @@ def test_runtime_stats_exposes_fusion():
     assert set(f) >= {"enabled", "reduce_enabled", "flushes", "fused_ops",
                       "ops_per_flush", "reduce_flushes", "program_cache",
                       "resplit_enabled", "resplit_flushes", "resplit_nodes",
-                      "resplit_fallbacks"}
+                      "resplit_fallbacks", "step_enabled", "step_flushes",
+                      "step_fallbacks"}
     assert f["program_cache"]["misses"] >= 0
     assert s["counters"].get("op_engine.fusion_flushes", 0) == f["flushes"]
 

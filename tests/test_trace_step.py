@@ -1,0 +1,467 @@
+"""Differentiable tapes: ``fusion.trace_step`` / ``fusion.value_and_grad``
+— the whole train step (loss + grad + optimizer update) as ONE cached,
+donated-state executable, with traced-vs-eager grad parity, donation,
+steady-state zero recompiles and the packed-gradient-collective audits.
+"""
+
+import contextlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core import fusion
+from heat_tpu.core.dndarray import DNDarray
+
+
+@contextlib.contextmanager
+def _fused_on():
+    """Force the traced-step path regardless of ambient flags — the
+    ladder's HEAT_TPU_FUSION=0 A/B leg must still exercise (and assert)
+    the fused behavior here, exactly like test_fusion.py's overrides."""
+    with fusion.override(True), fusion.step_override(True):
+        yield
+
+
+def _step_counters():
+    s = fusion.stats()
+    return s["step_flushes"], s["step_fallbacks"]
+
+
+def _linear_step(lr=0.1):
+    """A small ht-native train step: tanh-MLP regression, SGD update."""
+
+    def loss_fn(p, bx, by):
+        h = ht.tanh(ht.matmul(bx, p["w"]) + p["b"])
+        pred = ht.matmul(h, p["v"])
+        d = ht.reshape(pred, by.shape) - by
+        return ht.mean(d * d)
+
+    def train_step(p, bx, by):
+        lval, g = fusion.value_and_grad(loss_fn)(p, bx, by)
+        newp = {k: p[k] - lr * g[k] for k in p}
+        return newp, lval
+
+    return train_step
+
+
+def _make_problem(n, d, h, split, dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    X = ht.array(rng.standard_normal((n, d)).astype(dtype), split=split)
+    y = ht.array(rng.standard_normal((n, 1)).astype(dtype), split=0 if split == 0 else None)
+    params = {
+        "w": ht.array(rng.standard_normal((d, h)).astype(dtype)),
+        "b": ht.array(np.zeros(h, dtype)),
+        "v": ht.array(rng.standard_normal((h, 1)).astype(dtype)),
+    }
+    return params, X, y
+
+
+class TestTracedStepParity:
+    """Traced-step results vs the eager path, across layouts and dtypes.
+
+    The traced program is ONE executable (FMA contraction, reassociation
+    freedom inside the program), so float results carry the documented
+    few-ulp contract vs the eager per-op dispatch; integer traced steps
+    are bitwise."""
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    @pytest.mark.parametrize("n,d", [(13, 5), (16, 4)])
+    def test_f32_grad_step_sweep(self, split, n, d):
+        train_step = _linear_step()
+        params, X, y = _make_problem(n, d, 3, split)
+        with fusion.step_override(False):
+            pe, eager_losses = dict(params), []
+            for _ in range(3):
+                pe, l = train_step(pe, X, y)
+                eager_losses.append(float(l))
+        step = fusion.trace_step(train_step)
+        pt, traced_losses = dict(params), []
+        with _fused_on():
+            for _ in range(3):
+                pt, l = step(pt, X, y)
+                traced_losses.append(float(l))
+        np.testing.assert_allclose(traced_losses, eager_losses, rtol=1e-5)
+        for k in pe:
+            np.testing.assert_allclose(
+                np.asarray(pt[k].larray), np.asarray(pe[k].larray),
+                rtol=1e-5, atol=1e-6, err_msg=f"param {k} drift (split={split})")
+
+    @pytest.mark.parametrize("split", [None, 0])
+    def test_bf16_grad_step(self, split):
+        train_step = _linear_step(lr=0.05)
+        params, X, y = _make_problem(12, 4, 3, split, dtype=np.float32)
+        # bf16 params; data f32 — the common mixed setup
+        params = {k: ht.array(np.asarray(v.larray).astype(jnp.bfloat16))
+                  for k, v in params.items()}
+        with fusion.step_override(False):
+            pe, le = train_step(dict(params), X, y)
+        with _fused_on():
+            pt, lt = fusion.trace_step(train_step)(dict(params), X, y)
+        np.testing.assert_allclose(float(lt), float(le), rtol=2e-2)
+        for k in pe:
+            np.testing.assert_allclose(
+                np.asarray(pt[k].larray, dtype=np.float32),
+                np.asarray(pe[k].larray, dtype=np.float32),
+                rtol=5e-2, atol=5e-3)
+
+    @pytest.mark.parametrize("split", [None, 0, 1])
+    def test_int_step_bitwise(self, split):
+        """A gradient-free integer traced step must be BITWISE eager."""
+
+        def int_step(state, delta):
+            acc = (state * 2 + delta) % 1000003
+            return acc, ht.sum(acc)
+
+        rng = np.random.default_rng(3)
+        s0 = ht.array(rng.integers(0, 997, (13, 5)).astype(np.int32),
+                      split=split)
+        d0 = ht.array(rng.integers(0, 997, (13, 5)).astype(np.int32),
+                      split=split)
+        with fusion.step_override(False):
+            se, tot_e = int_step(s0, d0)
+        with _fused_on():
+            st, tot_t = fusion.trace_step(int_step)(s0, d0)
+        assert int(tot_t.larray) == int(tot_e.larray)
+        np.testing.assert_array_equal(np.asarray(st.larray),
+                                      np.asarray(se.larray))
+
+
+class TestValueAndGrad:
+    def test_matches_finite_differences(self):
+        def loss_fn(p, bx, by):
+            d = ht.reshape(ht.matmul(bx, p["w"]), by.shape) - by
+            return ht.mean(d * d)
+
+        params, X, y = _make_problem(13, 5, 1, 0, seed=2)
+        p = {"w": ht.array(np.asarray(params["w"].larray)[:, :1].copy())}
+        val, g = fusion.value_and_grad(loss_fn)(p, X, y)
+        assert isinstance(val, DNDarray) and val.ndim == 0
+        assert isinstance(g["w"], DNDarray) and g["w"].gshape == (5, 1)
+        eps, w = 1e-3, np.asarray(p["w"].larray).copy()
+        for i in (0, 4):
+            w2 = w.copy()
+            w2[i, 0] += eps
+            v2 = float(fusion.value_and_grad(loss_fn)(
+                {"w": ht.array(w2)}, X, y)[0])
+            fd = (v2 - float(val)) / eps
+            np.testing.assert_allclose(np.asarray(g["w"].larray)[i, 0], fd,
+                                       rtol=5e-2, atol=1e-3)
+
+    def test_split_param_grads_keep_layout_and_zero_padding(self):
+        """Gradients of a SPLIT parameter come back in the parameter's
+        layout with exact-zero cotangents on the padded positions (every
+        padding-crossing read is masked by the op-engine discipline)."""
+        def loss_fn(p):
+            return ht.sum(p["x"] * p["x"] * 0.5)
+
+        x = ht.array(np.arange(13 * 3, dtype=np.float32).reshape(13, 3),
+                     split=0)
+        _, g = fusion.value_and_grad(loss_fn)({"x": x})
+        assert g["x"].split == 0 and g["x"].gshape == (13, 3)
+        gp = np.asarray(g["x"].larray)
+        np.testing.assert_allclose(gp[:13], np.arange(39, dtype=np.float32).reshape(13, 3))
+        np.testing.assert_array_equal(gp[13:], 0.0)
+
+    def test_has_aux(self):
+        def loss_fn(p):
+            s = ht.sum(p["x"] * 2.0)
+            return s, {"twice": p["x"] * 2.0}
+
+        x = ht.array(np.ones((4, 3), np.float32))
+        (val, aux), g = fusion.value_and_grad(loss_fn, has_aux=True)({"x": x})
+        assert float(val) == 24.0
+        assert isinstance(aux["twice"], DNDarray)
+        np.testing.assert_array_equal(np.asarray(aux["twice"].larray), 2.0)
+        np.testing.assert_array_equal(np.asarray(g["x"].larray), 2.0)
+
+
+class TestTracedStepMachinery:
+    def test_steady_state_zero_recompiles(self):
+        train_step = _linear_step()
+        params, X, y = _make_problem(16, 4, 3, 0, seed=5)
+        step = fusion.trace_step(train_step)
+        with _fused_on():
+            p, _ = step(dict(params), X, y)  # warmup: the one compile
+            c0 = fusion.program_cache().stats()
+            f0, _ = _step_counters()
+            for _ in range(5):
+                p, _l = step(p, X, y)
+        c1 = fusion.program_cache().stats()
+        f1, _ = _step_counters()
+        assert c1["misses"] == c0["misses"], "steady-state program-cache miss"
+        assert c1["compiles"] == c0["compiles"], "steady-state recompile"
+        assert f1 - f0 == 5
+
+    def test_donation_invalidates_param_buffers(self):
+        """donate_argnums params: the input buffers are updated in place —
+        no per-step state copy; the OLD wrappers' buffers are dead."""
+        train_step = _linear_step()
+        params, X, y = _make_problem(16, 4, 3, 0, seed=6)
+        step = fusion.trace_step(train_step, donate_argnums=(0,))
+        old_w = params["w"].larray
+        if not hasattr(old_w, "is_deleted"):
+            pytest.skip("this jax has no Array.is_deleted")
+        with _fused_on():
+            newp, _ = step(params, X, y)
+            assert old_w.is_deleted(), \
+                "donated param buffer survived the step"
+            assert not newp["w"].larray.is_deleted()
+            # and the updated params keep working as next-step inputs
+            newp, _ = step(newp, X, y)
+
+    def test_nontraceable_body_falls_back_eager(self):
+        def bad_step(p, bx, by):
+            lval, g = fusion.value_and_grad(
+                lambda q, a, b: ht.mean((ht.matmul(a, q["w"]) - b) * 1.0))(
+                    p, bx, by)
+            # host round-trip: not traceable
+            scale = float(lval)
+            return {"w": p["w"] - 0.1 * scale * g["w"]}, lval
+
+        params, X, y = _make_problem(8, 4, 1, None, seed=7)
+        p = {"w": params["w"][:, :1]}
+        with fusion.step_override(False):
+            pe, le = bad_step(dict(p), X, y)
+        step = fusion.trace_step(bad_step)
+        _, fb0 = _step_counters()
+        with _fused_on():
+            pt, lt = step(dict(p), X, y)
+        _, fb1 = _step_counters()
+        assert fb1 > fb0, "fallback not counted"
+        np.testing.assert_allclose(float(lt), float(le), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(pt["w"].larray),
+                                   np.asarray(pe["w"].larray), rtol=1e-6)
+        # permanently eager now — and still correct
+        f0, _ = _step_counters()
+        with _fused_on():
+            step(dict(p), X, y)
+        f1, fb2 = _step_counters()
+        assert f1 == f0 and fb2 > fb1
+
+    def test_primed_step_dispatch_error_propagates(self):
+        """A runtime failure of a PREVIOUSLY-SUCCESSFUL step program must
+        raise, not silently flip the step to the eager path forever —
+        e.g. re-using a donated (deleted) parameter tree is a user bug
+        that needs surfacing."""
+        train_step = _linear_step()
+        params, X, y = _make_problem(8, 4, 3, None, seed=11)
+        step = fusion.trace_step(train_step, donate_argnums=(0,))
+        with _fused_on():
+            newp, _ = step(params, X, y)
+            if not (hasattr(params["w"].larray, "is_deleted")
+                    and params["w"].larray.is_deleted()):
+                pytest.skip("donation did not invalidate on this backend")
+            with pytest.raises(Exception):
+                step(params, X, y)  # donated tree reused: must raise
+            assert not step._eager_keys
+            # and the step keeps working with live state
+            newp, _ = step(newp, X, y)
+
+    def test_escape_hatch_runs_eager(self):
+        train_step = _linear_step()
+        params, X, y = _make_problem(8, 4, 3, None, seed=8)
+        step = fusion.trace_step(train_step)
+        f0, fb0 = _step_counters()
+        with fusion.step_override(False):
+            step(dict(params), X, y)
+        f1, fb1 = _step_counters()
+        assert f1 == f0 and fb1 == fb0, "escape hatch still traced/counted"
+
+    def test_static_int_args_key_the_program(self):
+        def stepn(p, k):
+            out = p
+            for _ in range(k):
+                out = out * 2.0
+            return out
+
+        x = ht.array(np.ones((4, 4), np.float32))
+        step = fusion.trace_step(stepn)
+        np.testing.assert_array_equal(np.asarray(step(x, 2).larray), 4.0)
+        np.testing.assert_array_equal(np.asarray(step(x, 3).larray), 8.0)
+
+
+class TestOptimizerBatchedUpdate:
+    def test_whole_update_is_one_traced_flush(self):
+        rng = np.random.default_rng(9)
+        params = {"w": ht.array(rng.standard_normal((6, 3)).astype(np.float32)),
+                  "b": ht.array(np.zeros(3, np.float32)),
+                  "deep": {"v": ht.array(np.ones((3, 2), np.float32))}}
+        grads = jax.tree_util.tree_map(
+            lambda x: ht.array(np.ones(x.gshape, np.float32)), params,
+            is_leaf=lambda x: isinstance(x, DNDarray))
+        opt = ht.optim.DataParallelOptimizer(ht.optim.Adam(lr=0.1))
+        c0 = fusion.program_cache().stats()["compiles"]
+        f0, _ = _step_counters()
+        p = params
+        with _fused_on():
+            for _ in range(4):
+                p = opt.apply_gradients(p, grads)
+        c1 = fusion.program_cache().stats()["compiles"]
+        f1, _ = _step_counters()
+        assert f1 - f0 == 4, "each update must be ONE traced flush"
+        assert c1 - c0 <= 1, "update tree recompiled past the first call"
+        assert isinstance(p["w"], DNDarray) and p["w"].gshape == (6, 3)
+        # optax parity
+        import optax
+
+        tx = optax.adam(0.1)
+        ref = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(np.asarray(x.larray)), params,
+            is_leaf=lambda x: isinstance(x, DNDarray))
+        st = tx.init(ref)
+        g = jax.tree_util.tree_map(jnp.ones_like, ref)
+        for _ in range(4):
+            u, st = tx.update(g, st, ref)
+            ref = optax.apply_updates(ref, u)
+        np.testing.assert_allclose(np.asarray(p["w"].larray),
+                                   np.asarray(ref["w"]), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(p["deep"]["v"].larray),
+                                   np.asarray(ref["deep"]["v"]), rtol=1e-6)
+
+    def test_step_keeps_noop_shim_and_split_layouts(self):
+        opt = ht.optim.DataParallelOptimizer(ht.optim.SGD(lr=0.5))
+        assert opt.step() is None  # historic argless shim
+        params = {"w": ht.array(np.ones((13, 4), np.float32), split=0)}
+        grads = {"w": ht.array(np.full((13, 4), 2.0, np.float32), split=0)}
+        with _fused_on():
+            newp = opt.step(params, grads)
+        assert newp["w"].split == 0 and newp["w"].gshape == (13, 4)
+        np.testing.assert_allclose(
+            np.asarray(newp["w"]._logical()), 0.0, atol=1e-7)
+
+
+class TestDataParallelPackedStep:
+    def test_packed_matches_gspmd_step(self):
+        flax = pytest.importorskip("flax")
+        import flax.linen as fnn
+
+        class Net(fnn.Module):
+            @fnn.compact
+            def __call__(self, x):
+                x = fnn.Dense(16)(x)
+                x = fnn.relu(x)
+                return fnn.Dense(4)(x)
+
+        rng = np.random.default_rng(0)
+        n = ht.get_comm().size * 8
+        X = rng.standard_normal((n, 8)).astype(np.float32)
+        y = rng.integers(0, 4, n).astype(np.int32)
+
+        def run(packed):
+            opt = ht.optim.DataParallelOptimizer(ht.optim.SGD(lr=0.1))
+            net = ht.nn.DataParallel(Net(), optimizer=opt, seed=0)
+            # packed leg must force the MASTER flag too, or the ladder's
+            # HEAT_TPU_FUSION=0 leg compares the GSPMD path with itself
+            ctx = _fused_on() if packed else fusion.step_override(False)
+            with ctx:
+                losses = [net.step(ht.array(X, split=0),
+                                   ht.array(y, split=0))
+                          for _ in range(4)]
+            if packed and ht.get_comm().size > 1:
+                assert net._packed_step is not None, \
+                    "packed path not exercised"
+            return losses
+
+        np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
+
+    def test_custom_loss_keeps_gspmd_unless_declared_mean(self):
+        """A user loss_fn must NOT silently take the packed step (a
+        sum-reduction loss would scale grads by 1/world); declaring
+        loss_is_batch_mean opts in."""
+        flax = pytest.importorskip("flax")
+        import flax.linen as fnn
+
+        if ht.get_comm().size < 2:
+            pytest.skip("needs a multi-device mesh")
+
+        class Net(fnn.Module):
+            @fnn.compact
+            def __call__(self, x):
+                return fnn.Dense(4)(x)
+
+        n = ht.get_comm().size * 4
+        X = np.ones((n, 8), np.float32)
+        y = np.zeros(n, np.int32)
+
+        def loss_sum(logits, labels):
+            return jnp.sum((logits - 0.0) ** 2)
+
+        with _fused_on():
+            opt = ht.optim.DataParallelOptimizer(ht.optim.SGD(lr=0.01))
+            net = ht.nn.DataParallel(Net(), optimizer=opt, seed=0,
+                                     loss_fn=loss_sum)
+            net.step(ht.array(X, split=0), ht.array(y, split=0))
+            assert net._packed_step is None, \
+                "sum-reduction loss silently took the packed step"
+            opt2 = ht.optim.DataParallelOptimizer(ht.optim.SGD(lr=0.01))
+            mean_net = ht.nn.DataParallel(
+                Net(), optimizer=opt2, seed=0,
+                loss_fn=lambda o, t: jnp.mean((o - 0.0) ** 2),
+                loss_is_batch_mean=True)
+            mean_net.step(ht.array(X, split=0), ht.array(y, split=0))
+            assert mean_net._packed_step is not None
+
+    def test_packed_gradient_allreduce_is_packed(self):
+        """The train-step HLO carries ONE communicating all-reduce total —
+        every parameter cotangent plus the loss in one flattened
+        collective, not one-per-parameter."""
+        flax = pytest.importorskip("flax")
+        import flax.linen as fnn
+
+        comm = ht.get_comm()
+        if comm.size < 2:
+            pytest.skip("needs a multi-device mesh")
+
+        class Net(fnn.Module):
+            @fnn.compact
+            def __call__(self, x):
+                x = fnn.Dense(16)(x)
+                x = fnn.relu(x)
+                return fnn.Dense(4)(x)
+
+        opt = ht.optim.DataParallelOptimizer(ht.optim.SGD(lr=0.1))
+        net = ht.nn.DataParallel(Net(), optimizer=opt, seed=0)
+        X = np.ones((comm.size * 4, 8), np.float32)
+        y = np.zeros(comm.size * 4, np.int32)
+        net.init(X)
+        packed = net._build_packed_train_step()
+        txt = packed.lower(net.params, net.optimizer.opt_state,
+                           jnp.asarray(X), jnp.asarray(y)).compile().as_text()
+        from heat_tpu.utils import hlo_audit
+
+        stats = hlo_audit.communicating_collective_stats(txt)
+        assert stats.get("all-reduce", {}).get("count") == 1, stats
+        assert "all-gather" not in stats and "all-to-all" not in stats
+
+
+class TestHloAuditCommunicating:
+    def test_singleton_groups_do_not_count(self):
+        hlo = "\n".join([
+            "  %ar0 = f32[4]{0} all-reduce(f32[4]{0} %x), replica_groups={{0},{1},{2},{3}}, to_apply=%add",
+            "  %ar1 = f32[8]{0} all-reduce(f32[8]{0} %y), replica_groups={{0,1,2,3}}, use_global_device_ids=true, to_apply=%add",
+            "  %ar2 = f32[2]{0} all-reduce(f32[2]{0} %z), replica_groups={0,1}, to_apply=%add",
+        ])
+        from heat_tpu.utils import hlo_audit
+
+        assert hlo_audit.collective_stats(hlo)["all-reduce"]["count"] == 3
+        comm = hlo_audit.communicating_collective_stats(hlo)
+        assert comm["all-reduce"]["count"] == 2
+        assert comm["all-reduce"]["bytes"] == 8 * 4 + 2 * 4
+
+    def test_empty_and_iota_replica_group_forms(self):
+        """``replica_groups={}`` is ONE all-replicas group (communicates);
+        the iota form ``[G,S]<=[N]`` communicates iff group size S > 1."""
+        from heat_tpu.utils import hlo_audit
+
+        hlo = "\n".join([
+            "  %ar0 = f32[4]{0} all-reduce(f32[4]{0} %x), channel_id=1, replica_groups={}, to_apply=%add",
+            "  %ar1 = f32[4]{0} all-reduce(f32[4]{0} %y), replica_groups=[8,1]<=[8], to_apply=%add",
+            "  %ar2 = f32[4]{0} all-reduce(f32[4]{0} %z), replica_groups=[2,4]<=[8], to_apply=%add",
+        ])
+        assert hlo_audit.collective_stats(hlo)["all-reduce"]["count"] == 3
+        comm = hlo_audit.communicating_collective_stats(hlo)
+        assert comm["all-reduce"]["count"] == 2  # ar0 (all) + ar2 (size 4)

@@ -134,6 +134,140 @@ class TestDenseParity:
         assert losses[-1] < losses[0]
 
 
+class TestPackedTrainStep:
+    """The packed-collective fused train step (pp=tp=1 grids, any dp x sp):
+    check_vma-FREE — loss+grad+update compile as ONE shard_map executable
+    whose gradient all-reduce count is the packed plan's (one flattened
+    collective carrying every parameter cotangent plus the loss), not
+    one-per-parameter. Runs on every supported jax, including the older
+    check_rep ones the vma train path skips on."""
+
+    @pytest.fixture(autouse=True)
+    def _force_fused(self):
+        # the ladder's HEAT_TPU_FUSION=0 A/B leg must still exercise the
+        # packed path asserted here (the legacy route needs vma tracking
+        # and would skip/fail on this jax) — same override discipline as
+        # test_fusion.py
+        from heat_tpu.core import fusion
+
+        with fusion.override(True), fusion.step_override(True):
+            yield
+
+    @staticmethod
+    def _dp_sp_shapes():
+        n = ht.MESH_WORLD.size
+        shapes = [(n, 1, 1, 1)]
+        if n >= 2 and n % 2 == 0:
+            shapes.append((n // 2, 1, 1, 2))
+        return shapes
+
+    def test_packed_loss_and_grads_match_dense(self):
+        from heat_tpu.core import fusion
+
+        for shape in self._dp_sp_shapes():
+            grid = _grid(shape)
+            cfg = TransformerLMConfig(
+                vocab=32, d_model=8, n_heads=2, n_layers=2, d_ff=16)
+            model = TransformerLM(grid, cfg)
+            assert model.packed_step_supported
+            assert fusion.step_enabled()
+            params = model.init(0)
+            rng = np.random.default_rng(0)
+            B, S = 2 * model.dp, 4 * model.sp
+            toks = rng.integers(0, cfg.vocab, (B, S)).astype(np.int32)
+            loss, grads = model.loss_and_grad_fn()(
+                params, model.shard_batch(toks))
+            host = _host(params)
+            want_loss, want_grads = jax.value_and_grad(dense_loss)(
+                host, jnp.asarray(toks), cfg)
+            np.testing.assert_allclose(float(loss), float(want_loss),
+                                       rtol=1e-4)
+            flat_got = jax.tree_util.tree_flatten_with_path(grads)[0]
+            flat_want = dict(
+                jax.tree_util.tree_flatten_with_path(want_grads)[0])
+            for path, g in flat_got:
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(flat_want[path]),
+                    rtol=2e-3, atol=2e-4,
+                    err_msg=f"{shape} grad mismatch at "
+                            f"{jax.tree_util.keystr(path)}")
+
+    def test_fused_step_is_one_executable_with_packed_collectives(self):
+        """HLO audit: the whole train step's communicating all-reduce
+        count equals the packed plan's — exactly ONE (grads + loss in a
+        single flattened psum over dp), and no gather/scatter sneaks in."""
+        import optax
+
+        from heat_tpu.utils import hlo_audit
+
+        n = ht.MESH_WORLD.size
+        if n < 2:
+            pytest.skip("needs a multi-device mesh for a communicating psum")
+        grid = _grid((n, 1, 1, 1))
+        cfg = TransformerLMConfig(
+            vocab=64, d_model=16, n_heads=4, n_layers=2, d_ff=32)
+        model = TransformerLM(grid, cfg)
+        params = model.init(1)
+        tx = optax.adam(1e-2)
+        opt_state = tx.init(params)
+        step = model.make_train_step(tx)
+        toks = model.shard_batch(
+            np.zeros((2 * model.dp, 4), np.int32))
+        txt = step.lower(params, opt_state, toks).compile().as_text()
+        stats = hlo_audit.communicating_collective_stats(txt)
+        assert stats.get("all-reduce", {}).get("count") == 1, \
+            f"gradient collectives not packed: {stats}"
+        for kind in ("all-gather", "all-to-all", "reduce-scatter"):
+            assert kind not in stats, stats
+
+    @pytest.mark.parametrize("n_micro", [1, 2])
+    def test_fused_step_descends_donates_and_caches(self, n_micro):
+        import optax
+
+        n = ht.MESH_WORLD.size
+        grid = _grid((n, 1, 1, 1))
+        cfg = TransformerLMConfig(
+            vocab=64, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+            n_micro=n_micro)
+        model = TransformerLM(grid, cfg)
+        params = model.init(1)
+        tx = optax.adam(1e-2)
+        opt_state = tx.init(params)
+        step = model.make_train_step(tx)
+        rng = np.random.default_rng(1)
+        S = 4
+        B = 2 * model.dp * n_micro
+        base = np.arange(B * S).reshape(B, S)
+        toks = model.shard_batch(
+            (base + rng.integers(0, 2, base.shape)) % cfg.vocab)
+        old_embed = params["embed"]
+        losses = []
+        for _ in range(10):
+            params, opt_state, lval = step(params, opt_state, toks)
+            losses.append(float(lval))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+        if hasattr(old_embed, "is_deleted"):
+            assert old_embed.is_deleted(), \
+                "donated param state copied instead of updated in place"
+        if hasattr(step, "_cache_size"):
+            assert step._cache_size() <= 2, "per-step retrace"
+
+    def test_escape_hatch_restores_legacy_path(self):
+        from heat_tpu.core import fusion
+
+        n = ht.MESH_WORLD.size
+        grid = _grid((n, 1, 1, 1))
+        cfg = TransformerLMConfig(
+            vocab=32, d_model=8, n_heads=2, n_layers=2, d_ff=16)
+        model = TransformerLM(grid, cfg)
+        with fusion.step_override(False):
+            model.loss_and_grad_fn()
+        assert ("loss_and_grad", False) in model._step_cache
+        model.loss_and_grad_fn()
+        assert ("loss_and_grad", True) in model._step_cache
+
+
 class TestMoE:
     @needs_vma
     def test_ep_training_descends(self):
