@@ -557,6 +557,8 @@ def sanitize_comm(comm) -> TPUCommunication:
 def distributed_init(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None,
+                     max_retries: Optional[int] = None,
+                     backoff_s: Optional[float] = None,
                      **kwargs) -> TPUCommunication:
     """Join a multi-host pod and rebuild the world communicator.
 
@@ -569,14 +571,62 @@ def distributed_init(coordinator_address: Optional[str] = None,
     as single-host; collectives ride ICI within a slice and DCN across
     hosts via the mesh.
 
+    HARDENED FAILURE DOMAIN (doc/robustness.md): on a multi-host pod the
+    coordinator is typically another freshly-booting host, so the first
+    connect attempt failing is the COMMON case, not the exceptional one.
+    A failed ``jax.distributed.initialize`` is retried with bounded
+    exponential backoff plus deterministic per-process jitter (seeded
+    from ``process_id`` and the attempt number — hosts desynchronize
+    without losing reproducibility). ``max_retries`` (default 4, env
+    ``HEAT_TPU_INIT_MAX_RETRIES``) bounds the retries; ``backoff_s``
+    (default 0.5, env ``HEAT_TPU_INIT_BACKOFF_S``) is the base delay,
+    doubling per attempt and capped at 30 s. Each retry counts
+    ``init.connect_retries`` in :mod:`heat_tpu.utils.metrics`; the final
+    failure re-raises the connect error.
+
     Returns the new default communicator (also installed via
     :func:`use_comm` and as ``MESH_WORLD``).
     """
-    # None arguments mean auto-detect (the TPU-pod default)
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id, **kwargs)
+    import os
+    import random
+    import time
+
+    from ..utils import faults as _faults
+    from ..utils import metrics as _metrics
+
+    if max_retries is None:
+        max_retries = int(os.environ.get("HEAT_TPU_INIT_MAX_RETRIES", "4"))
+    if backoff_s is None:
+        backoff_s = float(os.environ.get("HEAT_TPU_INIT_BACKOFF_S", "0.5"))
+    attempt = 0
+    while True:
+        try:
+            _faults.check("init.coordinator.connect")
+            # None arguments mean auto-detect (the TPU-pod default)
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id, **kwargs)
+            break
+        except Exception:
+            attempt += 1
+            if attempt > max_retries:
+                raise
+            # a failed connect leaves jax.distributed's global client/
+            # service state SET on this jax (State.initialize assigns
+            # them before client.connect()), and a second initialize()
+            # would then refuse with "should only be called once" —
+            # tear the half-initialized state down before retrying
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            _metrics.inc("init.connect_retries")
+            delay = min(30.0, backoff_s * (2.0 ** (attempt - 1)))
+            # deterministic jitter in [0.5, 1.0) x delay: same process +
+            # same attempt -> same sleep, different processes spread out
+            rng = random.Random((process_id or 0) * 7919 + attempt)
+            time.sleep(delay * (0.5 + 0.5 * rng.random()))
     global _mesh_world
     _mesh_world = TPUCommunication(jax.devices())
     use_comm(_mesh_world)

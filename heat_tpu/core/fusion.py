@@ -261,6 +261,18 @@ def _metrics():
     return metrics
 
 
+_FAULTS = None  # lazy module handle (utils imports back into core)
+
+
+def _faults():
+    global _FAULTS
+    if _FAULTS is None:
+        from ..utils import faults
+
+        _FAULTS = faults
+    return _FAULTS
+
+
 # ---------------------------------------------------------------------- #
 # switches                                                               #
 # ---------------------------------------------------------------------- #
@@ -1307,6 +1319,7 @@ def _flush_locked(root: _Node) -> None:
         key = key + (("sm" if sm is not None else "gspmd"), comm.cache_key)
 
     def build():
+        _faults().check("fusion.flush.compile")
         if sm is not None:
             replay = _sm_body(plan, sm, out_idx, comm)
             from ._compat import shard_map
@@ -1335,8 +1348,34 @@ def _flush_locked(root: _Node) -> None:
                 pass
         return jitted
 
-    program = program_cache().get_custom(key, build)
-    results = program(*leaves)
+    try:
+        program = program_cache().get_custom(key, build)
+        _faults().check("fusion.flush.dispatch")
+        results = program(*leaves)
+    except Exception:
+        # HARDENED FAILURE DOMAIN (doc/robustness.md): a failed fused
+        # compile or dispatch must not strand the tape. No node has been
+        # mutated yet (values land only below), so the whole chain
+        # replays inline through the eager per-op path — bitwise the
+        # pre-fusion semantics — and the tape ends exactly as consistent
+        # as a successful flush (values set, owners written back, args
+        # released). A stale captured HLO from an earlier compile must
+        # not satisfy a later audit either: the dump is cleared before
+        # the fallback (same trap PR 6 fixed for reset(), now for the
+        # error path). A genuinely-broken op raises again from the
+        # inline replay and surfaces to the caller as eager dispatch
+        # would have. The one unreplayable case: a DONATING program that
+        # failed mid-dispatch may already have invalidated its input
+        # buffers — then the original error re-raises (replaying from
+        # deleted buffers would surface a misleading "Array deleted").
+        if any(getattr(a, "is_deleted", lambda: False)() for a in leaves):
+            raise
+        global _last_hlo
+        _last_hlo = None
+        _metrics().inc("op_engine.fusion_flush_fallbacks")
+        _flush_inline(order, has_reduce, has_contract, has_resplit,
+                      is_fallback=True)
+        return
 
     m = _metrics()
     m.inc("op_engine.fusion_flushes")
@@ -1645,7 +1684,8 @@ def _sm_body(plan, sm, out_idx, comm):
 
 def _flush_inline(order, has_reduce: bool = False,
                   has_contract: bool = False,
-                  has_resplit: bool = False) -> None:
+                  has_resplit: bool = False,
+                  is_fallback: bool = False) -> None:
     """Evaluate a short chain op-by-op (children first — ``order`` is
     post-order): each dispatch reuses XLA's per-op executable cache, which
     every other chain in the process shares. Values land on every node, so
@@ -1673,7 +1713,10 @@ def _flush_inline(order, has_reduce: bool = False,
     m = _metrics()
     m.inc("op_engine.fusion_flushes")
     m.inc("op_engine.fusion_ops", len(order))
-    m.inc("op_engine.fusion_inline_flushes")
+    if not is_fallback:
+        # error-path fallbacks are counted in fusion_flush_fallbacks;
+        # inline_flushes keeps its documented meaning (short chains)
+        m.inc("op_engine.fusion_inline_flushes")
     if has_reduce:
         m.inc("op_engine.fusion_reduce_flushes")
     if has_contract:
@@ -1919,6 +1962,8 @@ class _TracedStep:
             key, lambda: self._build(args, treedef, metas))
         primed = record.out_meta is not None  # this program ran before
         try:
+            _faults().check("fusion.step.dispatch" if primed
+                            else "fusion.step.trace")
             results = record.jitted(*phys)
         except Exception:
             if primed:
@@ -2088,6 +2133,8 @@ def stats() -> dict:
         "step_flushes": int(c.get("op_engine.fusion_step_flushes", 0)),
         "step_fallbacks": int(c.get("op_engine.fusion_step_fallbacks", 0)),
         "flushes": flushes,
+        "flush_fallbacks": int(
+            c.get("op_engine.fusion_flush_fallbacks", 0)),
         "inline_flushes": int(c.get("op_engine.fusion_inline_flushes", 0)),
         "reduce_flushes": int(c.get("op_engine.fusion_reduce_flushes", 0)),
         "contract_flushes": int(

@@ -67,6 +67,17 @@ _GSPMD_CACHE: dict = {}
 _HITS = 0
 _MISSES = 0
 
+_FAULTS = None  # lazy module handle (utils imports back into core)
+
+
+def _faults():
+    global _FAULTS
+    if _FAULTS is None:
+        from ..utils import faults
+
+        _FAULTS = faults
+    return _FAULTS
+
 
 def plan_cache_stats() -> dict:
     """Plan-cache observability: hits/misses since process start (also
@@ -229,7 +240,20 @@ def planned_reshard_fn(phys_shape, jdt, gshape, from_split, to_split, comm):
         fn = gspmd_reshard_fn(phys_shape, jdt, gshape, from_split, to_split,
                               comm)
     else:
-        fn = _build_plan(phys_shape, jdt, gshape, from_split, to_split, comm)
+        try:
+            _faults().check("reshard.plan.build")
+            fn = _build_plan(phys_shape, jdt, gshape, from_split, to_split,
+                             comm)
+        except Exception:
+            # HARDENED FAILURE DOMAIN (doc/robustness.md): the explicit
+            # plan is an optimization — a failed plan build degrades to
+            # the audited GSPMD baseline program (value-identical layout
+            # move, XLA-placed collectives) instead of failing the
+            # resplit. The fallback is cached under the same key so a
+            # hot loop pays the failed build once.
+            metrics.inc("resharding.plan_build_fallbacks")
+            fn = gspmd_reshard_fn(phys_shape, jdt, gshape, from_split,
+                                  to_split, comm)
     _PLAN_CACHE[key] = fn
     return fn
 
@@ -258,4 +282,17 @@ def reshard(parray, gshape, from_split: Optional[int],
     else:
         fn = planned_reshard_fn(parray.shape, parray.dtype, gshape,
                                 from_split, to_split, comm)
-    return fn(parray)
+    try:
+        _faults().check("reshard.dispatch")
+        return fn(parray)
+    except Exception:
+        # HARDENED FAILURE DOMAIN (doc/robustness.md): a failed collective
+        # dispatch gets ONE retry through the GSPMD baseline program (a
+        # distinct executable — if the planned program itself is the
+        # problem, the retry does not re-run it). A second failure is a
+        # real device/runtime error and surfaces.
+        from ..utils import metrics
+
+        metrics.inc("resharding.dispatch_fallbacks")
+        return gspmd_reshard_fn(parray.shape, parray.dtype, gshape,
+                                from_split, to_split, comm)(parray)

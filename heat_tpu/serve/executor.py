@@ -346,6 +346,9 @@ class ServingExecutor:
                 # backstop: NOTHING may kill the worker thread — a dead
                 # worker leaves every queued future unresolved forever
                 # while submit() keeps admitting. Fail the batch instead.
+                from ..utils import metrics as _pm
+
+                _pm.inc("serve.worker_backstops")
                 self.metrics.record_error()
                 for req in batch:
                     try:
@@ -420,14 +423,23 @@ class ServingExecutor:
         return live
 
     def _process(self, batch: list) -> None:
+        from ..utils import faults as _faults
+        from ..utils import metrics as _pm
+
         cfg = self.config
         batch = self._expire(batch)
         if not batch:
             return
+        # chaos site OUTSIDE every recovery path below: an armed
+        # 'serve.worker.batch' fault escapes to the _run backstop — the
+        # deterministic trigger for the "futures failed, worker alive,
+        # next batch serves" contract test
+        _faults.check("serve.worker.batch")
         rows = sum(r.rows for r in batch)
         feat, _ = batch[0].group
         dtype = batch[0].x.dtype
         try:
+            _faults.check("serve.bucket.policy")
             bucket = cfg.bucket_rows(rows)
             over_cap = (cfg.max_bucket_bytes is not None
                         and bucket_nbytes(bucket, feat, dtype)
@@ -442,6 +454,7 @@ class ServingExecutor:
             # A single request the policy rejects outright is a client
             # error: route it to that request's future, never the worker.
             if len(batch) > 1:
+                _pm.inc("serve.bucket_splits")
                 for chunk in self._split_to_ladder(batch):
                     self._process(chunk)
             else:
@@ -468,7 +481,8 @@ class ServingExecutor:
                           int(getattr(policy, "min_rows", cfg.min_rows)), 1)
             bucket = -(-rows // quantum) * quantum
             self.metrics.record_fallback_single()
-        try:
+        def run_once():
+            _faults.check("serve.batch.dispatch")
             payload = np.empty((bucket,) + feat, dtype)
             off = 0
             for req in batch:
@@ -483,12 +497,27 @@ class ServingExecutor:
             # sliced on host. Slicing the sharded device output per
             # request instead would dispatch a device program per slice —
             # more dispatches than the unbatched path it replaces.
-            out = jax.tree.map(np.asarray, jax.block_until_ready(out))
-        except Exception as exc:
-            self.metrics.record_error()
-            for req in batch:
-                req.future.set_exception(exc)
-            return
+            return jax.tree.map(np.asarray, jax.block_until_ready(out))
+
+        try:
+            out = run_once()
+        except Exception:
+            # HARDENED FAILURE DOMAIN (doc/robustness.md): one bounded
+            # retry before failing the batch's futures — a transient
+            # compile/dispatch/fetch error (OOM blip, a cache cap-clear
+            # racing a compile) must not shed a whole batch that the very
+            # next attempt would have served. A second failure is treated
+            # as real: the futures fail typed and the worker lives on
+            # (generalizing the PR 2 backstop from "don't die" to
+            # "retry, then shed").
+            _pm.inc("serve.batch_retries")
+            try:
+                out = run_once()
+            except Exception as exc:
+                self.metrics.record_error()
+                for req in batch:
+                    req.future.set_exception(exc)
+                return
         self.metrics.record_batch(len(batch), rows, bucket)
         done_t = time.monotonic()
         off = 0

@@ -141,12 +141,17 @@ def runtime_stats() -> dict:
     ``"op_engine"`` carries the alignment counter plus the fusion engine's
     figures (``"fusion"`` is exactly :func:`heat_tpu.core.fusion.stats`:
     enabled flag, flush count, fused-op count, their ops-per-flush ratio,
-    and the fusion program cache); ``"counters"`` is the full process-wide
+    and the fusion program cache); ``"faults"`` is exactly
+    :func:`heat_tpu.utils.faults.stats` (armed plan + per-site fire
+    counts — empty on a production run; ``doc/robustness.md``);
+    ``"counters"`` is the full process-wide
     counter map (includes ``op_engine.align_resplits``,
     ``op_engine.fusion_flushes`` / ``fusion_ops``, ``resharding.plan_hits``
-    / ``_misses``, ``serve.*``, ``fusion.program_*``).
+    / ``_misses``, ``serve.*``, ``fusion.program_*``, ``faults.*`` and the
+    fallback counters in the robustness matrix).
     """
     from ..core import fusion, resharding
+    from ..utils import faults as _faults
     from ..utils import metrics as _pm
 
     from . import executor as _executor
@@ -172,5 +177,8 @@ def runtime_stats() -> dict:
             "align_resplits": int(counters.get("op_engine.align_resplits", 0)),
             "fusion": fusion.stats(),
         },
+        # fault-injection surface (heat_tpu.utils.faults): armed plan +
+        # per-site fire counts — all zeros/empty on a production run
+        "faults": _faults.stats(),
         "counters": counters,
     }

@@ -3,6 +3,7 @@ and profiling subsystems the reference lacks (SURVEY.md §5)."""
 
 from . import data
 from . import vision_transforms
+from . import faults
 from . import checkpointing
 from . import hlo_audit
 from . import metrics

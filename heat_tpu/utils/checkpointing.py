@@ -108,15 +108,53 @@ def save_checkpoint(path: str, state: Dict[str, Any], step: Optional[int] = None
                     keys[leaf_path] = {"kind": "array"}
             manifest["entries"][name] = {"kind": "pytree", "leaves": keys, "spec": spec}
 
-    tmp_fd, tmp_npz = tempfile.mkstemp(dir=path, suffix=".tmp.npz")
-    os.close(tmp_fd)
-    np.savez(tmp_npz, **arrays)
-    os.replace(tmp_npz, os.path.join(path, "arrays.npz"))
+    # leaf payload FIRST, manifest LAST: a manifest is the completeness
+    # marker (all_steps()/restore() key on it), so it must never become
+    # visible before the arrays it describes
+    _atomic_write(path, "arrays.npz", ".tmp.npz",
+                  lambda tmp: np.savez(tmp, **arrays),
+                  "checkpoint.leaf.write")
 
-    tmp_fd, tmp_json = tempfile.mkstemp(dir=path, suffix=".json.tmp")
-    with os.fdopen(tmp_fd, "w") as f:
-        json.dump(manifest, f)
-    os.replace(tmp_json, os.path.join(path, _MANIFEST))
+    def _write_manifest(tmp):
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+
+    _atomic_write(path, _MANIFEST, ".json.tmp", _write_manifest,
+                  "checkpoint.manifest.write")
+
+
+def _atomic_write(dirpath: str, final_name: str, suffix: str, write_fn,
+                  site: str) -> None:
+    """Write ``final_name`` atomically (temp file + ``os.replace``),
+    retrying ONCE on an IO error.
+
+    HARDENED FAILURE DOMAIN (doc/robustness.md): a transient ``OSError``
+    (NFS blip, fd exhaustion) gets one retry on a fresh temp file,
+    counted as ``checkpoint.write_retries``; a second failure re-raises.
+    In every outcome the temp file is unlinked and the final name is
+    either the complete new payload or untouched — a partial write is
+    never visible under the real name."""
+    from . import faults as _faults
+    from . import metrics as _metrics
+
+    for attempt in (1, 2):
+        fd, tmp = tempfile.mkstemp(dir=dirpath, suffix=suffix)
+        os.close(fd)
+        try:
+            _faults.check(site)
+            write_fn(tmp)
+            os.replace(tmp, os.path.join(dirpath, final_name))
+            return
+        except BaseException as exc:
+            # the temp file never survives, whatever went wrong —
+            # only a transient OSError earns the one retry
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            if not isinstance(exc, OSError) or attempt == 2:
+                raise
+            _metrics.inc("checkpoint.write_retries")
 
 
 def _unflatten(leaves: Dict[str, Any], spec=None):
@@ -172,8 +210,12 @@ def _unflatten(leaves: Dict[str, Any], spec=None):
 
 def load_checkpoint(path: str) -> Dict[str, Any]:
     """Restore a checkpoint written by :func:`save_checkpoint`."""
+    from . import faults as _faults
+
+    _faults.check("checkpoint.manifest.read")
     with open(os.path.join(path, _MANIFEST)) as f:
         manifest = json.load(f)
+    _faults.check("checkpoint.leaf.read")
     with np.load(os.path.join(path, "arrays.npz")) as npz:
         arrays = {k: npz[k] for k in npz.files}
 
@@ -294,7 +336,10 @@ class CheckpointManager:
         # (all_steps() never lists them, so rotation alone misses them)
         for name in os.listdir(self.directory):
             full = os.path.join(self.directory, name)
-            if (name.startswith("ckpt_") and full != self._path(step)
+            # quarantined (".corrupt") directories are evidence, not
+            # orphans: restore() renamed them on purpose — keep them
+            if (name.startswith("ckpt_") and not name.endswith(".corrupt")
+                    and full != self._path(step)
                     and not os.path.exists(os.path.join(full, _MANIFEST))):
                 _rmtree(full)
         return True
@@ -302,25 +347,58 @@ class CheckpointManager:
     def restore(self):
         """(step, state) of the newest complete checkpoint, or None.
 
-        Checkpoints that fail to load (e.g. truncated by a crash mid-write,
-        which atomic manifests make unlikely) are skipped with a warning,
-        falling back to the next-newest — the elastic-recovery path. The
+        HARDENED FAILURE DOMAIN (doc/robustness.md): a checkpoint that
+        fails to load gets ONE immediate re-read first — a transient IO
+        error must not condemn good data (``checkpoint.read_retries``).
+        A checkpoint that fails twice (bad manifest JSON, missing or
+        truncated leaf payload) is real corruption: the directory is
+        QUARANTINED under a ``.corrupt`` rename — so it stops being a
+        restore candidate but survives on disk for the postmortem — and
+        restore falls back to the newest older good step
+        (``checkpoint.corrupt_skipped``), the elastic-recovery path. The
         returned state is exactly what was saved (the manifest's step is
         reported separately, not injected into the dict).
         """
         import warnings
 
+        from . import metrics as _metrics
+
         for step in reversed(self.all_steps()):
-            try:
-                state = load_checkpoint(self._path(step))
-            except Exception as exc:
+            state = err = None
+            for attempt in (1, 2):
+                try:
+                    state = load_checkpoint(self._path(step))
+                    break
+                except Exception as exc:
+                    err = exc
+                    if attempt == 1:
+                        _metrics.inc("checkpoint.read_retries")
+            if state is None:
+                _metrics.inc("checkpoint.corrupt_skipped")
                 warnings.warn(
-                    f"skipping unreadable checkpoint step {step} at "
-                    f"{self._path(step)}: {exc!r}")
+                    f"skipping corrupt checkpoint step {step} at "
+                    f"{self._path(step)} (quarantined as .corrupt): "
+                    f"{err!r}")
+                self._quarantine(step)
                 continue
             state.pop("__step__", None)
             return step, state
         return None
+
+    def _quarantine(self, step: int) -> None:
+        """Rename a corrupt checkpoint dir out of the restore candidate
+        set (best-effort: a read-only filesystem must not turn recovery
+        into a second failure)."""
+        src = self._path(step)
+        dst = src + ".corrupt"
+        n = 1
+        while os.path.exists(dst):
+            dst = f"{src}.corrupt.{n}"
+            n += 1
+        try:
+            os.rename(src, dst)
+        except OSError:
+            pass
 
 
 def _rmtree(path: str) -> None:
@@ -330,18 +408,33 @@ def _rmtree(path: str) -> None:
 
 
 def run_with_recovery(train_fn, manager: CheckpointManager, init_state,
-                      max_failures: int = 3):
+                      max_restarts: int = 3, backoff_s: float = 0.05,
+                      max_failures: Optional[int] = None):
     """Run a restartable training loop with crash recovery.
 
     ``train_fn(state, start_step, save) -> state`` runs the loop body; it
     must call ``save(step, state)`` as it goes (the manager's cadence
     applies) and may raise at any point. On an exception the loop restarts
-    from the newest checkpoint, up to ``max_failures`` times — the
-    single-controller analogue of elastic training (the reference's MPI
-    SPMD model cannot do this at all; SURVEY.md §5 "failure detection:
-    none").
+    from the newest checkpoint — the single-controller analogue of elastic
+    training (the reference's MPI SPMD model cannot do this at all;
+    SURVEY.md §5 "failure detection: none").
+
+    Restarts are BOUNDED and PACED: at most ``max_restarts`` (default 3;
+    the exceeding failure re-raises), with exponential backoff between
+    attempts (``backoff_s`` base, doubling per restart, capped at 30 s) so
+    a hard-failing step does not spin the loop at CPU speed against the
+    same broken state. Each restart counts
+    ``checkpoint.recovery_restarts`` in :mod:`heat_tpu.utils.metrics`
+    (visible in ``ht.runtime_stats()["counters"]``). ``max_failures`` is
+    the historic name for ``max_restarts`` and is honored as an alias.
     """
-    failures = 0
+    import time
+
+    from . import metrics as _metrics
+
+    if max_failures is not None:
+        max_restarts = max_failures
+    restarts = 0
     while True:
         restored = manager.restore()
         # fresh copy per attempt: a crashed train_fn that mutated the
@@ -350,9 +443,11 @@ def run_with_recovery(train_fn, manager: CheckpointManager, init_state,
         try:
             return train_fn(state, start, manager.save)
         except Exception:
-            failures += 1
-            if failures > max_failures:
+            restarts += 1
+            if restarts > max_restarts:
                 raise
+            _metrics.inc("checkpoint.recovery_restarts")
+            time.sleep(min(30.0, backoff_s * (2.0 ** (restarts - 1))))
 
 
 def _fresh_state(tree):

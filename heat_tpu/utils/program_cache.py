@@ -105,6 +105,9 @@ class ProgramCache:
         return self.get_custom(key, lambda: self._compile(fn, shape, dtype))
 
     def _compile(self, fn, shape, dtype) -> Callable:
+        from . import faults as _faults
+
+        _faults.check("program_cache.compile")
         jitted = jax.jit(fn)
         if self.aot:
             try:

@@ -180,6 +180,47 @@ def run_fusion_ab(n: int, timeout: float) -> dict:
                           and legs.get("1", {}).get("rc") == 0)}
 
 
+_CHAOS_SITE_RE = re.compile(
+    r"test_chaos_site\[([^\]]+)\]\s+(PASSED|FAILED|ERROR|SKIPPED)")
+
+
+def run_chaos(n: int, timeout: float) -> dict:
+    """The fault-injection chaos matrix (tests/test_faults.py) as a
+    ladder stage: every registered site fired one-at-a-time (seeded)
+    inside its designated workload, plus the fault-free counter-silence
+    leg. Per-site verdicts land in the artifact next to the executable
+    counters, so a regression names its failure DOMAIN, not just a test."""
+    env = _env(n)
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "pytest", "tests/test_faults.py",
+             "-v", "--no-header"],
+            env=env, capture_output=True, text=True, timeout=timeout,
+            cwd=_REPO)
+    except subprocess.TimeoutExpired:
+        return {"error": f"chaos matrix exceeded {timeout:.0f}s"}
+    sites = {}
+    for m in _CHAOS_SITE_RE.finditer(out.stdout):
+        sites[m.group(1)] = m.group(2).lower()
+    silence = None
+    m = re.search(r"test_no_faults_armed_is_silent\s+"
+                  r"(PASSED|FAILED|ERROR)", out.stdout)
+    if m:
+        silence = m.group(1).lower()
+    rec = {"rc": out.returncode, "wall_s": round(time.time() - t0, 1),
+           "sites": dict(sorted(sites.items())),
+           "counter_silence": silence}
+    m = _SUMMARY_RE.search(out.stdout)
+    if m:
+        failed, passed, skipped, errors, _dur = m.groups()
+        rec.update(passed=int(passed), failed=int(failed or 0),
+                   skipped=int(skipped or 0), errors=int(errors or 0))
+    if out.returncode != 0:
+        rec["tail"] = out.stdout.strip().splitlines()[-20:]
+    return rec
+
+
 def run_examples(n: int, timeout: float) -> list:
     """Smoke-run every examples/ script end-to-end on an n-device mesh."""
     results = []
@@ -236,6 +277,13 @@ def main():
     ap.add_argument("--no-serve-smoke", dest="serve_smoke",
                     action="store_false",
                     help="skip the serving executor smoke step")
+    ap.add_argument("--chaos", dest="chaos", action="store_true",
+                    default=True,
+                    help="run the fault-injection chaos matrix + "
+                         "counter-silence check (default on)")
+    ap.add_argument("--no-chaos", dest="chaos", action="store_false",
+                    help="skip the chaos matrix stage")
+    ap.add_argument("--chaos-timeout", type=float, default=600.0)
     args = ap.parse_args()
 
     ladder = []
@@ -291,6 +339,18 @@ def main():
             serve_bad = True
         print(json.dumps({"serve_smoke_ok": not serve_bad}), flush=True)
 
+    chaos_bad = False
+    if args.chaos and not args.examples_only:
+        # failure-domain gate: every injection site must degrade
+        # gracefully (seeded, one-at-a-time) and a fault-free pass must
+        # tick zero faults.* counters (4-device mesh, like serve smoke)
+        print("=== chaos matrix (4 devices) ===", flush=True)
+        chaos = run_chaos(4, args.chaos_timeout)
+        artifact["chaos"] = chaos
+        chaos_bad = chaos.get("rc") != 0
+        print(json.dumps({"chaos_ok": not chaos_bad,
+                          "sites": chaos.get("sites", {})}), flush=True)
+
     fusion_bad = False
     if args.fusion_ab and not args.examples_only:
         # semantic-drift gate: the same fast, numerically-loaded subset
@@ -332,7 +392,8 @@ def main():
     print(f"wrote {args.out}")
     bad = ([r for r in ladder if r.get("rc") != 0]
            + [r for r in ex if r.get("rc") != 0])
-    sys.exit(1 if bad or audit_bad or serve_bad or fusion_bad else 0)
+    sys.exit(1 if bad or audit_bad or serve_bad or fusion_bad or chaos_bad
+             else 0)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,387 @@
+"""The chaos matrix: every fault-injection site, one at a time, seeded.
+
+The contract under test (ISSUE 8, doc/robustness.md):
+
+* for EVERY site registered in ``heat_tpu.utils.faults.SITES``, firing it
+  once (deterministically, ``nth:1``) inside its designated workload —
+  an op chain + resplit + reduce, a 2-step train loop, a 20-request
+  serve burst, a checkpoint save/restore cycle, a (stubbed) multi-host
+  init — leaves the process alive, the workload's numerics equal to the
+  fault-free run, and EXACTLY the documented fallback counter ticked
+  (no cross-domain counter bleed);
+* with no plan armed, the same workloads fire ZERO faults and tick ZERO
+  fallback counters — the counter-silence leg the ladder's ``--chaos``
+  stage re-checks on every run — and ``faults.stats()`` /
+  ``runtime_stats()["faults"]`` keep a stable shape;
+* the ``HEAT_TPU_FAULTS`` grammar parses round-trip, rejects unknown
+  sites, and the ``prob:P@SEED`` rule is deterministic per seed.
+
+Sites whose documented behavior is *raise-then-recover* rather than a
+silent fallback (a PRIMED trace_step program failing at dispatch, the
+serve worker backstop) are pinned exactly as documented: the error
+surfaces typed, the engine stays usable, and the retried work matches
+the fault-free numerics.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import heat_tpu as ht
+from heat_tpu.core import fusion, resharding
+from heat_tpu.serve import (Pow2Buckets, ServeConfig, ServeMetrics,
+                            ServingExecutor)
+from heat_tpu.utils import faults, metrics
+from heat_tpu.utils.checkpointing import CheckpointManager
+
+# every fallback counter any site may tick: the matrix asserts the
+# documented one moved and ALL the others stayed put
+FALLBACK_COUNTERS = (
+    "op_engine.fusion_flush_fallbacks",
+    "op_engine.fusion_step_fallbacks",
+    "resharding.plan_build_fallbacks",
+    "resharding.dispatch_fallbacks",
+    "serve.batch_retries",
+    "serve.worker_backstops",
+    "serve.bucket_splits",
+    "checkpoint.write_retries",
+    "checkpoint.read_retries",
+    "checkpoint.corrupt_skipped",
+    "init.connect_retries",
+)
+
+# site -> (workload, documented fallback counter, expected tick count).
+# A None counter documents a raise-then-recover site: nothing falls back
+# silently, the workload absorbs the typed error and retries (the
+# "absorbed" info channel below proves the raise actually happened).
+MATRIX = {
+    "fusion.flush.compile": ("ops", "op_engine.fusion_flush_fallbacks", 1),
+    "fusion.flush.dispatch": ("ops", "op_engine.fusion_flush_fallbacks", 1),
+    # a failed first trace parks the SIGNATURE eager: both steps of the
+    # loop count a fallback (documented in doc/robustness.md)
+    "fusion.step.trace": ("train", "op_engine.fusion_step_fallbacks", 2),
+    "fusion.step.dispatch": ("train", None, 0),
+    "reshard.plan.build": ("resplit", "resharding.plan_build_fallbacks", 1),
+    "reshard.dispatch": ("resplit", "resharding.dispatch_fallbacks", 1),
+    "serve.worker.batch": ("serve", "serve.worker_backstops", 1),
+    "serve.batch.dispatch": ("serve", "serve.batch_retries", 1),
+    "serve.bucket.policy": ("serve", "serve.bucket_splits", 1),
+    "program_cache.compile": ("serve", "serve.batch_retries", 1),
+    "checkpoint.manifest.write": ("ckpt", "checkpoint.write_retries", 1),
+    "checkpoint.leaf.write": ("ckpt", "checkpoint.write_retries", 1),
+    "checkpoint.manifest.read": ("ckpt", "checkpoint.read_retries", 1),
+    "checkpoint.leaf.read": ("ckpt", "checkpoint.read_retries", 1),
+    "init.coordinator.connect": ("init", "init.connect_retries", 1),
+}
+
+D = 5  # serve feature width
+
+
+def _snap():
+    c = metrics.counters()
+    return {k: int(c.get(k, 0)) for k in FALLBACK_COUNTERS}
+
+
+def _fires(site):
+    return int(metrics.counters().get(f"faults.{site}.fires", 0))
+
+
+# --------------------------------------------------------------------- #
+# workloads — each returns (payload-to-compare, info-not-compared)      #
+# --------------------------------------------------------------------- #
+def _wl_ops(tmp_path):
+    """Elementwise chain (>= MIN_OPS so the flush COMPILES) + resplit +
+    split-axis reduction: the fused tape engine's whole surface."""
+    fusion.reset()
+    resharding.reset_plan_cache()
+    x = ht.arange(52, dtype=ht.float32, split=0).reshape((13, 4))
+    y = ht.exp(x * 0.01) + x * 0.5 - 1.25
+    y = y * y + 0.5
+    z = y.resplit(1)
+    r = (z + 1.0).sum()
+    return {"y": y.numpy(), "r": np.asarray(float(r))}, {}
+
+
+def _wl_train(tmp_path):
+    """2-step train loop through trace_step. A PRIMED program failing at
+    dispatch is DOCUMENTED to raise (never silently degrade); the loop
+    absorbs the typed error and retries the step — the info channel
+    reports how many raises it absorbed."""
+    fusion.reset()
+
+    def step(p, g):
+        return p - 0.1 * g
+
+    ts = fusion.trace_step(step)
+    p = ht.arange(8, dtype=ht.float32, split=0) / 8.0
+    g = ht.ones(8, dtype=ht.float32, split=0)
+    absorbed = 0
+    for _ in range(2):
+        try:
+            p = ts(p, g)
+        except faults.FaultInjected:
+            absorbed += 1
+            p = ts(p, g)
+    return {"p": p.numpy()}, {"absorbed": absorbed}
+
+
+def _wl_resplit(tmp_path):
+    """Eager planner path (fusion off so reshard() itself is exercised,
+    plan cache reset so the build site is reached)."""
+    resharding.reset_plan_cache()
+    with fusion.override(False):
+        x = ht.arange(30, dtype=ht.float32, split=0).reshape((15, 2))
+        y = x.resplit(1)
+        z = y.resplit(None)
+        return {"y": y.numpy(), "z": z.numpy()}, {}
+
+
+def _model(x):
+    return x * np.float32(2.0) + np.float32(1.0)
+
+
+def _wl_serve(tmp_path):
+    """20-request burst, paused-then-resumed so the first batch is a
+    deterministic max_batch coalesce. Futures failed by the worker
+    backstop are re-submitted (the documented "worker alive, next batch
+    serves" contract); the info channel counts them."""
+    comm = ht.get_comm()
+    cfg = ServeConfig(
+        max_batch=4, max_wait_ms=20.0,
+        bucket_rows=Pow2Buckets(min_rows=comm.size, multiple_of=comm.size))
+    absorbed = 0
+    results = {}
+    with ServingExecutor(_model, cfg, metrics=ServeMetrics(),
+                         cache_token=comm.cache_key) as ex:
+        ex.pause()
+        futs = {i: ex.submit(np.full((comm.size, D), i, np.float32))
+                for i in range(20)}
+        ex.resume()
+        for i, f in futs.items():
+            try:
+                results[i] = np.asarray(f.result(60))
+            except faults.FaultInjected:
+                absorbed += 1
+                assert ex._worker.is_alive()
+                results[i] = np.asarray(ex.predict(
+                    np.full((comm.size, D), i, np.float32), timeout=60))
+    return ({"res": np.stack([results[i] for i in range(20)])},
+            {"absorbed": absorbed})
+
+
+def _wl_ckpt(tmp_path):
+    """Save two steps, restore the newest — the full manifest+leaf
+    write/read cycle."""
+    mgr = CheckpointManager(str(tmp_path / "chaos_ckpt"), every_steps=1,
+                            keep=3)
+    w = ht.arange(10, dtype=ht.float32, split=0)
+    mgr.save(1, {"w": w, "n": 1}, force=True)
+    mgr.save(2, {"w": w * 2.0, "n": 2}, force=True)
+    step, state = mgr.restore()
+    return {"step": np.asarray(step), "w": state["w"].numpy(),
+            "n": np.asarray(state["n"])}, {}
+
+
+def _wl_init(tmp_path):
+    """distributed_init bring-up with the coordinator connect stubbed
+    (a real connect needs a pod); the retry/backoff machinery around it
+    is exactly what production runs."""
+    calls = {"n": 0}
+    orig = jax.distributed.initialize
+
+    def stub(**kwargs):
+        calls["n"] += 1
+
+    jax.distributed.initialize = stub
+    try:
+        comm = ht.distributed_init(backoff_s=0.001)
+    finally:
+        jax.distributed.initialize = orig
+    return {"size": np.asarray(comm.size)}, {"connects": calls["n"]}
+
+
+_WORKLOADS = {"ops": _wl_ops, "train": _wl_train, "resplit": _wl_resplit,
+              "serve": _wl_serve, "ckpt": _wl_ckpt, "init": _wl_init}
+
+_BASELINES: dict = {}  # workload name -> fault-free payload (per session)
+
+
+def _baseline(name, tmp_path):
+    if name not in _BASELINES:
+        assert not faults.armed()
+        payload, _info = _WORKLOADS[name](tmp_path)
+        _BASELINES[name] = payload
+    return _BASELINES[name]
+
+
+def _assert_payload_equal(got, want):
+    assert set(got) == set(want)
+    for k in want:
+        np.testing.assert_allclose(
+            got[k], want[k], rtol=1e-5, atol=1e-6,
+            err_msg=f"fault-run payload {k!r} drifted from fault-free")
+
+
+# --------------------------------------------------------------------- #
+# the matrix                                                            #
+# --------------------------------------------------------------------- #
+def test_matrix_covers_every_registered_site():
+    """Adding a site without chaos coverage must fail CI loudly."""
+    assert set(MATRIX) == set(faults.SITES)
+
+
+@pytest.mark.parametrize("site", sorted(faults.SITES))
+def test_chaos_site(site, tmp_path):
+    wl_name, counter, expected = MATRIX[site]
+    if site == "reshard.plan.build" and ht.get_comm().size == 1:
+        pytest.skip("single-device mesh never builds an explicit plan")
+    want = _baseline(wl_name, tmp_path)
+    before = _snap()
+    fires_before = _fires(site)
+    with faults.inject(f"{site}=nth:1"):
+        payload, info = _WORKLOADS[wl_name](tmp_path)
+    assert not faults.armed()
+    assert _fires(site) == fires_before + 1, \
+        f"site {site} never fired — instrumentation point unreachable"
+    _assert_payload_equal(payload, want)
+    delta = {k: v - before[k] for k, v in _snap().items() if v != before[k]}
+    if counter is None:
+        # raise-then-recover site: the typed error must actually have
+        # surfaced (and been absorbed by the workload's retry)
+        assert info.get("absorbed", 0) >= 1
+        assert delta == {}, f"unexpected fallback counters ticked: {delta}"
+    else:
+        assert delta == {counter: expected}, (
+            f"site {site}: want exactly {{{counter}: {expected}}}, "
+            f"got {delta}")
+
+
+def test_no_faults_armed_is_silent(tmp_path):
+    """The production steady state: zero fires, zero fallback ticks,
+    stable stats shape — the ladder's counter-silence check."""
+    assert not faults.armed()
+    before = _snap()
+    total_before = int(metrics.counters().get("faults.fires", 0))
+    for name in sorted(_WORKLOADS):
+        payload, _ = _WORKLOADS[name](tmp_path)
+        _assert_payload_equal(payload, _baseline(name, tmp_path))
+    assert int(metrics.counters().get("faults.fires", 0)) == total_before
+    delta = {k: v - before[k] for k, v in _snap().items() if v != before[k]}
+    assert delta == {}, f"fault-free run ticked fallback counters: {delta}"
+    st = faults.stats()
+    assert set(st) == {"armed", "plan", "sites", "arms", "total_fires",
+                       "fires"}
+    assert st["armed"] is False and st["plan"] == {}
+    assert st["sites"] == len(faults.SITES)
+    rt = ht.runtime_stats()
+    assert rt["faults"]["armed"] is False
+
+
+# --------------------------------------------------------------------- #
+# framework semantics                                                   #
+# --------------------------------------------------------------------- #
+class TestFramework:
+    def test_spec_grammar_round_trip(self):
+        plan = faults.parse_spec(
+            "serve.batch.dispatch=nth:3;checkpoint.leaf.write=every:2;"
+            "fusion.flush.compile=prob:0.25@7;reshard.dispatch=once")
+        assert plan.spec() == {
+            "serve.batch.dispatch": "nth:3",
+            "checkpoint.leaf.write": "every:2",
+            "fusion.flush.compile": "prob:0.25@7",
+            "reshard.dispatch": "nth:1",
+        }
+
+    def test_unknown_site_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            faults.parse_spec("no.such.site=nth:1")
+        with pytest.raises(ValueError, match="unknown fault rule"):
+            faults.parse_spec("serve.batch.dispatch=sometimes")
+
+    def test_every_n_fires_on_schedule(self):
+        fired = []
+        with faults.inject("serve.batch.dispatch=every:3"):
+            for i in range(9):
+                try:
+                    faults.check("serve.batch.dispatch")
+                    fired.append(False)
+                except faults.FaultInjected:
+                    fired.append(True)
+        assert fired == [False, False, True] * 3
+
+    def test_prob_rule_is_seed_deterministic(self):
+        plan = faults.parse_spec("serve.batch.dispatch=prob:0.5@42")
+        rule = plan.rules["serve.batch.dispatch"]
+        seq1 = [rule.should_fire() for _ in range(32)]
+        plan.reset()
+        seq2 = [rule.should_fire() for _ in range(32)]
+        assert seq1 == seq2
+        assert any(seq1) and not all(seq1)
+
+    def test_inject_restores_previous_plan(self):
+        assert not faults.armed()
+        with faults.inject("serve.batch.dispatch=nth:1"):
+            assert faults.armed()
+            with faults.inject("reshard.dispatch=nth:1"):
+                assert faults.stats()["plan"] == {
+                    "reshard.dispatch": "nth:1"}
+            assert faults.stats()["plan"] == {
+                "serve.batch.dispatch": "nth:1"}
+        assert not faults.armed()
+
+    def test_arm_resets_hit_state(self):
+        plan = faults.parse_spec("serve.batch.dispatch=nth:1")
+        faults.arm(plan)
+        try:
+            with pytest.raises(faults.FaultInjected):
+                faults.check("serve.batch.dispatch")
+            faults.check("serve.batch.dispatch")  # nth:1 spent
+            faults.arm(plan)  # re-arming starts the count fresh
+            with pytest.raises(faults.FaultInjected):
+                faults.check("serve.batch.dispatch")
+        finally:
+            faults.disarm()
+
+    def test_io_sites_raise_oserror(self):
+        """Filesystem sites raise what a real IO failure would, so the
+        hardened except-OSError paths are exercised as-is."""
+        with faults.inject("checkpoint.leaf.write=nth:1"):
+            with pytest.raises(OSError):
+                faults.check("checkpoint.leaf.write")
+
+    def test_env_spec_arms_at_import(self):
+        """HEAT_TPU_FAULTS arms a process-wide plan when the module is
+        imported — the "running chaos locally" entry point. Checked in a
+        subprocess so this process stays disarmed."""
+        import subprocess
+        import sys
+
+        code = (
+            "from heat_tpu.utils import faults\n"
+            "assert faults.armed()\n"
+            "assert faults.stats()['plan'] == "
+            "{'serve.batch.dispatch': 'nth:2'}\n"
+            "faults.check('serve.batch.dispatch')\n"
+            "try:\n"
+            "    faults.check('serve.batch.dispatch')\n"
+            "    raise SystemExit('nth:2 did not fire on hit 2')\n"
+            "except faults.FaultInjected:\n"
+            "    print('OK')\n")
+        env = dict(os.environ)
+        env["HEAT_TPU_FAULTS"] = "serve.batch.dispatch=nth:2"
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr[-500:]
+        assert "OK" in out.stdout
+
+    def test_disarmed_check_is_free_of_side_effects(self):
+        before = dict(metrics.counters())
+        for site in faults.SITES:
+            faults.check(site)
+        after = dict(metrics.counters())
+        assert {k: v for k, v in after.items() if k.startswith("faults.")} \
+            == {k: v for k, v in before.items() if k.startswith("faults.")}

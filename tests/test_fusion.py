@@ -306,6 +306,50 @@ def test_fused_chain_one_executable_zero_collectives():
         fusion.capture_hlo(False)
 
 
+def test_flush_error_clears_captured_hlo_and_falls_back():
+    """Regression (ISSUE 8 satellite): an exception mid-flush must CLEAR
+    the captured HLO — the next audit must read a loud None, never a
+    stale dump from the previous successful compile (the same trap PR 6
+    fixed for reset(), now for the error path) — and the tape must land
+    consistent via the inline-eager fallback (values written back, no
+    stranded pending nodes), counted in op_engine.fusion_flush_fallbacks."""
+    from heat_tpu.utils import faults
+    from heat_tpu.utils import metrics as _pm
+
+    fusion.reset()
+    fusion.capture_hlo(True)
+    try:
+        with fusion.override(True):
+            x = ht.array(np.linspace(0, 1, 26, dtype=np.float32).reshape(13, 2),
+                         split=0)
+            y = ht.exp(ht.sin(x) * 0.5 + 0.1) - 0.25
+            want = y.numpy()
+            assert fusion.last_hlo() is not None  # successful capture
+            before = int(_pm.counters().get(
+                "op_engine.fusion_flush_fallbacks", 0))
+            with faults.inject("fusion.flush.compile=nth:1"):
+                # DIFFERENT signature -> cache miss -> build() fails
+                a = ht.array(np.linspace(0, 1, 34, dtype=np.float32)
+                             .reshape(17, 2), split=0)
+                b = ht.exp(ht.sin(a) * 0.5 + 0.1) - 0.25
+                got = b.numpy()  # survives via inline-eager fallback
+            assert fusion.last_hlo() is None, \
+                "stale HLO survived a failed flush"
+            assert int(_pm.counters().get(
+                "op_engine.fusion_flush_fallbacks", 0)) == before + 1
+            # fallback is the eager replay: bitwise the eager semantics
+            with fusion.override(False):
+                a2 = ht.array(np.linspace(0, 1, 34, dtype=np.float32)
+                              .reshape(17, 2), split=0)
+                eager = (ht.exp(ht.sin(a2) * 0.5 + 0.1) - 0.25).numpy()
+            np.testing.assert_array_equal(got, eager)
+            # tape fully consistent: b rereads without a second flush
+            np.testing.assert_array_equal(b.numpy(), got)
+            del want
+    finally:
+        fusion.capture_hlo(False)
+
+
 def test_flush_boundary_with_resplit_exact_planner_collectives():
     """A chain consumed by a resplit is NOT a flush boundary anymore (PR
     6): the resplit records as a tape node, the whole expression compiles

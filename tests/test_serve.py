@@ -448,6 +448,85 @@ class TestServeRobustness:
         assert metrics.snapshot()["errors"] == 1
         ex.close()
 
+    def test_injected_worker_fault_futures_fail_worker_survives(self):
+        """Pin the _run backstop contract DELIBERATELY (it was previously
+        only exercised by accident): a fault escaping _process fails that
+        batch's futures typed, ticks serve.worker_backstops, and the
+        worker lives to serve the next batch."""
+        from heat_tpu.utils import faults
+        from heat_tpu.utils import metrics as _pm
+
+        comm = _comm()
+        metrics = ServeMetrics()
+        ex = _executor(_elemwise_fn(comm), comm, metrics=metrics,
+                       max_batch=4, max_wait_ms=20.0)
+        before = int(_pm.counters().get("serve.worker_backstops", 0))
+        ex.pause()
+        with faults.inject("serve.worker.batch=nth:1"):
+            futs = [ex.submit(np.ones((comm.size, D_FEAT), np.float32))
+                    for _ in range(4)]
+            ex.resume()
+            for f in futs:
+                with pytest.raises(faults.FaultInjected):
+                    f.result(60)
+        assert ex._worker.is_alive()
+        assert int(_pm.counters().get("serve.worker_backstops", 0)) \
+            == before + 1
+        # next batch serves normally
+        np.testing.assert_array_equal(
+            np.asarray(ex.predict(
+                np.ones((comm.size, D_FEAT), np.float32), timeout=60)),
+            np.full((comm.size, D_FEAT), 3.0, np.float32))
+        ex.close()
+
+    def test_transient_dispatch_failure_retried_once(self):
+        """One bounded retry before shedding: a batch whose dispatch fails
+        transiently is re-run and every future resolves — no typed error
+        reaches any client, serve.batch_retries ticks exactly once."""
+        from heat_tpu.utils import faults
+        from heat_tpu.utils import metrics as _pm
+
+        comm = _comm()
+        metrics = ServeMetrics()
+        ex = _executor(_elemwise_fn(comm), comm, metrics=metrics,
+                       max_batch=4, max_wait_ms=20.0)
+        before = int(_pm.counters().get("serve.batch_retries", 0))
+        ex.pause()
+        with faults.inject("serve.batch.dispatch=nth:1"):
+            futs = [ex.submit(np.full((comm.size, D_FEAT), i, np.float32))
+                    for i in range(4)]
+            ex.resume()
+            for i, f in enumerate(futs):
+                np.testing.assert_array_equal(
+                    np.asarray(f.result(60)),
+                    np.full((comm.size, D_FEAT), 2.0 * i + 1.0, np.float32))
+        assert int(_pm.counters().get("serve.batch_retries", 0)) \
+            == before + 1
+        assert metrics.snapshot()["errors"] == 0  # retry, not shed
+        ex.close()
+
+    def test_persistent_dispatch_failure_sheds_after_one_retry(self):
+        """The retry is BOUNDED: a failure that persists through the
+        retry fails the batch's futures (worker still alive)."""
+        from heat_tpu.utils import faults
+
+        comm = _comm()
+        metrics = ServeMetrics()
+        ex = _executor(_elemwise_fn(comm), comm, metrics=metrics,
+                       max_batch=2, max_wait_ms=20.0)
+        with faults.inject("serve.batch.dispatch=every:1"):  # every hit
+            fut = ex.submit(np.ones((comm.size, D_FEAT), np.float32))
+            with pytest.raises(faults.FaultInjected):
+                fut.result(60)
+        assert ex._worker.is_alive()
+        assert metrics.snapshot()["errors"] == 1
+        # disarmed: the same executor keeps serving
+        np.testing.assert_array_equal(
+            np.asarray(ex.predict(
+                np.ones((comm.size, D_FEAT), np.float32), timeout=60)),
+            np.full((comm.size, D_FEAT), 3.0, np.float32))
+        ex.close()
+
     def test_coalesced_overflow_of_bounded_policy_resplits(self):
         """A bounded ladder (FixedBuckets / Pow2Buckets(max_rows)) can
         reject the COALESCED row total even when every member request fits
