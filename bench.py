@@ -636,6 +636,65 @@ def _fusion_bench_main() -> None:
     record["fusion_train_step_steady_misses"] = \
         sstats["misses"] - sstats0["misses"]
 
+    # ---- quantized packed collectives: step bytes + wall, quant/exact #
+    # Fail-soft INSIDE the stage (like the outer stages): a quant-path
+    # regression must not take down the whole fusion record. Wall time on
+    # the CPU mesh is a dispatch-overhead surrogate (no real wire): the
+    # honest win is the audited collective-wire-byte reduction, which is
+    # what any TPU tunnel-up window re-benches automatically.
+    try:
+        import optax
+
+        from heat_tpu.nn.transformer import (TransformerLM,
+                                             TransformerLMConfig)
+        from heat_tpu.utils import hlo_audit
+
+        ndev = comm.size
+        grid = ht.MeshGrid((ndev, 1, 1, 1), ("dp", "pp", "tp", "sp"))
+        cfgq = TransformerLMConfig(
+            vocab=128, d_model=64, n_heads=4, n_layers=2, d_ff=128)
+        modelq = TransformerLM(grid, cfgq)
+        toksq = modelq.shard_batch(np.random.default_rng(0).integers(
+            0, cfgq.vocab, (4 * ndev, 16)).astype(np.int32))
+        txq = optax.adam(1e-2)
+
+        def timed_quant(codec, reps=20):
+            with fusion.quant_override(codec):
+                step = modelq.make_train_step(txq)
+                hlo = step.lower(modelq.init(0), txq.init(modelq.init(0)),
+                                 toksq).compile().as_text()
+                p, o = modelq.init(0), txq.init(modelq.init(0))
+                p, o, l = step(p, o, toksq)  # warm
+                jax.block_until_ready(l)
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    p, o, l = step(p, o, toksq)
+                jax.block_until_ready(l)
+                wall = (time.perf_counter() - t0) / reps * 1e3
+            return wall, hlo_audit.collective_bytes(
+                hlo, world=ndev)["total_wire_bytes"]
+
+        qstats0 = fusion.stats()
+        t_exact, b_exact = timed_quant(None)
+        t_int8, b_int8 = timed_quant("int8")
+        qstats = fusion.stats()
+        record["fusion_quant_step_exact_ms"] = round(t_exact, 3)
+        record["fusion_quant_step_quant_ms"] = round(t_int8, 3)
+        record["fusion_quant_step_wire_bytes_exact"] = int(b_exact)
+        record["fusion_quant_step_wire_bytes_quant"] = int(b_int8)
+        record["fusion_quant_step_byte_reduction"] = round(
+            b_exact / max(b_int8, 1), 2)
+        # STAGE deltas (snapshot-diffed like the steady-state blocks):
+        # with a codec armed in the ambient env the earlier stages tick
+        # the same counters, and lifetime totals would not compare
+        # across runs with different stage sets
+        record["fusion_quant_collectives"] = (
+            qstats["quant_collectives"] - qstats0["quant_collectives"])
+        record["fusion_quant_bytes_saved"] = (
+            qstats["quant_bytes_saved"] - qstats0["quant_bytes_saved"])
+    except Exception as exc:  # fail-soft: keep the rest of the record
+        record["fusion_quant_error"] = repr(exc)[:300]
+
     record["fusion_program_cache"] = fusion.program_cache().stats()
     record["fusion_ops_per_flush"] = fusion.stats()["ops_per_flush"]
     record["fusion_reduce_flushes"] = fusion.stats()["reduce_flushes"]

@@ -141,6 +141,38 @@ trace (host branching on values, ``.numpy()``/``float()`` round-trips)
 fall back to the eager path, counted in
 ``op_engine.fusion_step_fallbacks``. Opt-out: ``HEAT_TPU_FUSION_STEP=0``.
 
+Quantized packed collectives (block-scaled wire formats)
+--------------------------------------------------------
+``HEAT_TPU_QUANT_COLLECTIVES`` selects an opt-in wire codec for the
+packed float all-reduces this engine emits — the flush body's
+:func:`packed all-reduce <_sm_body>` packing and every
+:func:`packed_psum` call site (the model-level fused train steps,
+``DataParallel.step``, DASO's slow-tier blending). EQuARX
+(arXiv:2506.17615) shows block-scaled quantized all-reduce recovers ~2×
+collective bytes at negligible accuracy cost, and the decomposition it
+rides is exactly the generalized-allreduce structure
+(arXiv:2004.09362) the phase scheduler already plans around:
+
+* ``bf16`` — the payload crosses the wire as ONE bf16 all-reduce
+  (encode = round-to-nearest downcast, decode = upcast): half the f32
+  bytes on hardware with native bf16 reductions (TPU ICI).
+* ``int8`` — block-scaled (``HEAT_TPU_QUANT_BLOCK``-element blocks,
+  default 128, bf16 scales riding the payload): encode int8 → reduce-scatter-style ``all_to_all`` over the
+  shard axis → exact f32 combine of the dequantized summand blocks →
+  bf16 ``all_gather`` of the combined chunks → decode. The float wire
+  legs travel bitcast to ``u16`` so XLA:CPU's float normalization
+  cannot silently upcast them back to f32.
+
+Integer/bool collectives, ``pmax``/``pmin``, f64, and payloads below
+``HEAT_TPU_QUANT_MIN_NUMEL`` (default 256 elements) stay exact. The
+codec (and floor) join the program keys, so toggling never poisons a
+cached exact program; ``HEAT_TPU_QUANT_COLLECTIVES=0`` is bitwise
+today's behavior. Counters: ``op_engine.quant_collectives`` /
+``quant_bytes_saved`` (ring-wire model, the same formulas
+``heat_tpu.utils.hlo_audit.collective_bytes`` applies to real HLO) /
+``quant_fallbacks``. Error contract and the when-not-to table live in
+``doc/fusion.md``.
+
 Opt-out: ``HEAT_TPU_FUSION=0`` (or :func:`set_enabled` at runtime).
 Counters: ``op_engine.fusion_flushes``, ``op_engine.fusion_ops`` (their
 ratio is the ops-per-flush figure in ``ht.runtime_stats()``), plus the
@@ -189,6 +221,10 @@ __all__ = [
     "step_enabled",
     "set_step_enabled",
     "step_override",
+    "quant_codec",
+    "set_quant_codec",
+    "quant_override",
+    "quant_key",
 ]
 
 
@@ -221,6 +257,35 @@ _RESPLIT = _env_on("HEAT_TPU_FUSION_RESPLIT")
 # round-trips and all) and the model-level fused steps revert to their
 # historic GSPMD/check_vma train programs
 _STEP = _env_on("HEAT_TPU_FUSION_STEP")
+
+
+def _parse_codec(val):
+    """``HEAT_TPU_QUANT_COLLECTIVES`` value -> codec name or None (exact).
+    Unknown values raise immediately: a typo'd codec silently running the
+    exact path would defeat the whole byte-reduction intent."""
+    if val is None or val in ("", "0", "false", "False", "off", "none"):
+        return None
+    if val == "1":
+        return "bf16"  # the conservative default codec
+    if val in ("bf16", "int8"):
+        return val
+    raise ValueError(
+        f"HEAT_TPU_QUANT_COLLECTIVES={val!r}: expected 0, 1, bf16 or int8")
+
+
+# opt-in quantized wire codec for packed float all-reduces (None = exact)
+_QUANT = _parse_codec(os.environ.get("HEAT_TPU_QUANT_COLLECTIVES"))
+# payloads below this many elements stay exact: small collectives are
+# latency-bound, and quantizing them buys nothing while still paying the
+# encode/decode epilogue (it also keeps packed scalar losses exact)
+_QUANT_FLOOR = int(os.environ.get("HEAT_TPU_QUANT_MIN_NUMEL", "256"))
+# elements per int8 scale block (bf16 scales travel with the payload).
+# 128 balances scale overhead (2 bytes per 128 payload bytes, ~1.6%)
+# against within-block dynamic range: transformer grads are spiky
+# (embedding rows span orders of magnitude), and 256-blocks measured at
+# the edge of the documented 1e-2 rel-err contract where 128 leaves
+# ~15% margin (tests/test_quant_collectives.py pins the figure)
+_QUANT_BLOCK = int(os.environ.get("HEAT_TPU_QUANT_BLOCK", "128"))
 
 _PROGRAMS = None  # lazy singleton (utils imports back into core)
 
@@ -326,6 +391,49 @@ def step_override(flag: bool):
         yield
     finally:
         set_step_enabled(prev)
+
+
+def quant_codec() -> Optional[str]:
+    """The active quantized-collective codec: ``None`` (exact, the
+    default), ``"bf16"`` or ``"int8"`` (``HEAT_TPU_QUANT_COLLECTIVES``)."""
+    return _QUANT
+
+
+def set_quant_codec(codec) -> Optional[str]:
+    """Select the quantized-collective codec at runtime; returns the
+    previous one. Accepts the env-var spellings (``None``/``"0"``/
+    ``"bf16"``/``"int8"``). Cached exact programs stay valid — the codec
+    is part of every quantization-sensitive program key."""
+    global _QUANT
+    prev = _QUANT
+    _QUANT = _parse_codec(codec)
+    return prev
+
+
+def quant_key() -> Tuple:
+    """Hashable identity of the quantization configuration (codec, size
+    floor, scale-block size) — model-level step caches (``TransformerLM``,
+    ``DataParallel``, DASO) and the flush program key carry it so toggling
+    any knob rebuilds instead of reusing a program with the wrong wire
+    format."""
+    return (_QUANT, _QUANT_FLOOR, _QUANT_BLOCK)
+
+
+@contextlib.contextmanager
+def quant_override(codec, min_numel: Optional[int] = None):
+    """Context manager form of :func:`set_quant_codec`; ``min_numel``
+    optionally overrides the size floor (the quant property sweeps use a
+    low floor so small test payloads exercise the codec)."""
+    global _QUANT_FLOOR
+    prev = set_quant_codec(codec)
+    prev_floor = _QUANT_FLOOR
+    if min_numel is not None:
+        _QUANT_FLOOR = int(min_numel)
+    try:
+        yield
+    finally:
+        set_quant_codec(prev)
+        _QUANT_FLOOR = prev_floor
 
 
 def capture_hlo(flag: bool) -> None:
@@ -1310,18 +1418,32 @@ def _flush_locked(root: _Node) -> None:
     # degrades to an internal recompile, never a wrong program. The
     # recorded split axes join the key because they pick the shard_map
     # in_specs; the reduce mode and comm identity key the collective form.
+    # quantized-collective selection (HEAT_TPU_QUANT_COLLECTIVES): static
+    # per-flush, so the decision, the program key and the traced body all
+    # agree; a fault/floor/codec-off decision keys as None and therefore
+    # HITS any cached exact program instead of compiling a duplicate
+    qplan = _quant_flush_plan(order, sm, comm) if sm is not None else None
+    # codec/block from the PLAN's captured key, never re-read from the
+    # globals: a concurrent set_quant_codec between planning and build
+    # (or the deferred jit trace) must not trace a body whose wire format
+    # mismatches the selection or the program key
+    qcfg = qplan[3] if qplan is not None else (None, 0, 0)
+    qsel = qplan[0] if qplan is not None else frozenset()
+
     leaf_descrs = tuple(
         (tuple(a.shape), str(a.dtype), bool(a.aval.weak_type),
          str(a.sharding), leaf_splits[j])
         for j, a in enumerate(leaves))
     key = (leaf_descrs, tuple(sig_nodes), out_idx, donate)
     if touching:
-        key = key + (("sm" if sm is not None else "gspmd"), comm.cache_key)
+        qtag = qplan[3] if qplan is not None else None
+        key = key + (("sm" if sm is not None else "gspmd"), comm.cache_key,
+                     qtag)
 
     def build():
         _faults().check("fusion.flush.compile")
         if sm is not None:
-            replay = _sm_body(plan, sm, out_idx, comm)
+            replay = _sm_body(plan, sm, out_idx, comm, qsel, qcfg)
             from ._compat import shard_map
 
             sched, instrs, phases, in_specs, out_specs = sm
@@ -1386,6 +1508,11 @@ def _flush_locked(root: _Node) -> None:
         m.inc("op_engine.fusion_contract_flushes")
     if has_resplit:
         m.inc("op_engine.fusion_resplit_flushes")
+    if qplan is not None:
+        # per DISPATCH (cache hits included): the counters mirror what
+        # this program's collectives moved, not what compiling cost
+        m.inc("op_engine.quant_collectives", qplan[1])
+        m.inc("op_engine.quant_bytes_saved", qplan[2])
 
     for pos, res in zip(out_idx, results):
         node = order[pos]
@@ -1408,6 +1535,250 @@ def _flush_locked(root: _Node) -> None:
 # collective kind -> jax.lax combiner over the mesh axis
 _COLL_FNS = {"psum": jax.lax.psum, "pmax": jax.lax.pmax,
              "pmin": jax.lax.pmin}
+
+
+# ---------------------------------------------------------------------- #
+# quantized packed collectives (HEAT_TPU_QUANT_COLLECTIVES)              #
+# ---------------------------------------------------------------------- #
+def _numel(shape) -> int:
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n
+
+
+def _quant_dtype_ok(dt, codec) -> bool:
+    """Whether a psum payload of ``dt`` is quantizable under ``codec``.
+    Only additive float reductions quantize (pmax/pmin and integer/bool
+    payloads must stay exact); f64 is excluded (a user reaching for f64
+    asked for the precision); bf16/f16 payloads only gain under ``int8``
+    (the bf16 codec would be a no-op re-encode)."""
+    if codec == "int8":
+        return dt in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16),
+                      jnp.dtype(jnp.float16))
+    return dt == jnp.dtype(jnp.float32)
+
+
+def _quant_payload_numel(numels, codec, block) -> int:
+    """Wire-payload element count for a group of summands: the int8 codec
+    BLOCK-ALIGNS every part (a scale block must never span two packed
+    values — one spiky leaf's amax would crush a small-magnitude
+    neighbor's elements sharing its block), so each part pads to a block
+    multiple; bf16 packs raw."""
+    if codec != "int8":
+        return sum(numels)
+    return sum(n + ((-n) % block) for n in numels)
+
+
+def _quant_wire_bytes(numels, itemsize: int, codec: str,
+                      sizes, block: int) -> Tuple[int, int]:
+    """(exact, quantized) modeled ring-wire bytes for one float all-reduce
+    of the ``numels``-element summands over mesh axes of the given
+    ``sizes`` — the same per-kind formulas
+    :func:`heat_tpu.utils.hlo_audit.collective_bytes` applies to real HLO
+    dumps, so the ``quant_bytes_saved`` counter and the audit agree by
+    construction (up to the exchange's device-chunk tail padding, which
+    the model ignores). The EXACT baseline carries the raw concatenated
+    payload; only the int8 leg pays the per-part block alignment
+    (:func:`_quant_payload_numel`). Exact all-reduce rides reduce-scatter
+    + all-gather (2 passes of the payload) over the FULL group; the int8
+    codec's a2a/gather legs run over the LARGEST axis only (matching
+    :func:`_quant_allreduce_parts`'s primary-axis choice), plus the exact
+    f32 psum of the combined chunk over the remaining axes. NOTE for the
+    bf16 codec: the model reflects the INTENDED wire dtype — on backends
+    whose float normalization upcasts bf16 collectives back to f32
+    (XLA:CPU), the real wire saves nothing while the counter still ticks;
+    doc/fusion.md documents the caveat (the int8 legs are bitcast-guarded
+    precisely to avoid it)."""
+    group = 1
+    for s in sizes:
+        group *= s
+    raw = sum(numels)
+    exact = 2 * raw * itemsize * (group - 1) // group
+    if codec == "bf16":
+        quant = 2 * raw * 2 * (group - 1) // group
+    else:  # int8
+        padded = _quant_payload_numel(numels, codec, block)
+        p = max(sizes)           # the primary-axis size (a2a/gather legs)
+        r = group // p           # remaining-axes scope (exact chunk psum)
+        nblocks = -(-padded // block)
+        quant = ((padded + 2 * nblocks) * (p - 1) // p  # a2a s8+u16 scales
+                 + 2 * padded * (p - 1) // p)           # u16 gather
+        if r > 1:
+            # f32 psum of the 1/p-size combined chunk over the rest axes
+            quant += 2 * (padded * 4 // p) * (r - 1) // r
+    return exact, quant
+
+
+def _wire_u16(x):
+    """bf16 -> u16 bitcast for float wire legs: XLA:CPU's float
+    normalization upcasts bf16 collectives back to f32 (probed on this
+    jax — the convert folds THROUGH the collective), which would silently
+    un-save the bytes; integer collectives are left alone on every
+    backend. Bitwise free both ways."""
+    return jax.lax.bitcast_convert_type(x, jnp.uint16)
+
+
+def _unwire_u16(x):
+    return jax.lax.bitcast_convert_type(x, jnp.bfloat16)
+
+
+def _quant_bf16_allreduce(flat, axes):
+    """The bf16 codec: ONE all-reduce with the payload rounded to bf16 —
+    EQuARX's BF16 AR. The reduction itself runs at wire precision."""
+    return jax.lax.psum(flat.astype(jnp.bfloat16), axes).astype(flat.dtype)
+
+
+def _quant_int8_allreduce(flat, primary, size, rest, block):
+    """The int8 block-scaled codec over mesh axis ``primary`` (static size
+    ``size``; any ``rest`` axes combine the dequantized chunks exactly):
+
+    encode     per-(device-chunk, ``_QUANT_BLOCK``-block) bf16 scale =
+               amax/127,
+               payload rounded to s8;
+    exchange   reduce-scatter as ONE tiled ``all_to_all`` of the s8
+               payload (+ scales bitcast u16) — device i receives every
+               peer's i-th chunk;
+    combine    dequantize + sum in f32 (exact given s8 inputs);
+    return     bf16 ``all_gather`` (bitcast u16 on the wire) of the
+               combined chunks, decoded back to the payload dtype.
+
+    This is the arXiv:2004.09362 generalized-allreduce decomposition with
+    quantized phases (EQuARX, arXiv:2506.17615). Wire bytes: ~3/8 of the
+    exact f32 all-reduce (1 byte down + 2 bytes back vs 4 bytes each
+    way). Non-finite payload elements do not round-trip (inf amax zeroes
+    its block) — see the when-not-to table in doc/fusion.md."""
+    dt = flat.dtype
+    f = flat.astype(jnp.float32)
+    n = f.shape[0]
+    chunk = -(-n // size)
+    chunk = -(-chunk // block) * block
+    total = chunk * size
+    if total != n:
+        f = jnp.pad(f, (0, total - n))
+    m = f.reshape(size, chunk // block, block)
+    amax = jnp.max(jnp.abs(m), axis=-1, keepdims=True)
+    # the scale is rounded to bf16 BEFORE the encode divide, so encode and
+    # decode use the identical value — no scale-rounding skew
+    scale = (jnp.where(amax > 0, amax, 1.0) * (1.0 / 127.0)).astype(
+        jnp.bfloat16)
+    q = jnp.clip(jnp.round(m / scale.astype(jnp.float32)),
+                 -127, 127).astype(jnp.int8)
+    q = jax.lax.all_to_all(q, primary, split_axis=0, concat_axis=0,
+                           tiled=True)
+    s = jax.lax.all_to_all(_wire_u16(scale), primary, split_axis=0,
+                           concat_axis=0, tiled=True)
+    s = _unwire_u16(s).astype(jnp.float32)
+    part = jnp.sum(q.astype(jnp.float32) * s, axis=0)
+    if rest:
+        part = jax.lax.psum(part, rest)
+    g = jax.lax.all_gather(_wire_u16(part.astype(jnp.bfloat16)),
+                           primary, axis=0, tiled=True)
+    out = _unwire_u16(g).astype(jnp.float32).reshape(-1)
+    if total != n:
+        out = out[:n]
+    return out.astype(dt)
+
+
+def _quant_allreduce_parts(parts, axes, sizes, codec, block):
+    """Quantized all-reduce of mutually independent same-dtype shard-local
+    summands: flatten-concat (the int8 codec block-ALIGNS each part —
+    see :func:`_quant_payload_numel`), one quantized exchange, unpack.
+    The int8 exchange runs over the LARGEST axis (best chunking) with any
+    remaining axes combined exactly on the already-reduced chunks."""
+    if codec == "int8":
+        flats = []
+        for p in parts:
+            v = p.reshape(-1)
+            pad = (-_numel(p.shape)) % block
+            flats.append(jnp.pad(v, (0, pad)) if pad else v)
+        flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        k = max(range(len(axes)), key=lambda i: sizes[i])
+        rest = tuple(a for i, a in enumerate(axes)
+                     if i != k and sizes[i] > 1)
+        comb = _quant_int8_allreduce(flat, axes[k], sizes[k], rest, block)
+        stride = block
+    else:
+        flat = parts[0].reshape(-1) if len(parts) == 1 else \
+            jnp.concatenate([p.reshape(-1) for p in parts])
+        comb = _quant_bf16_allreduce(flat, tuple(axes))
+        stride = 1
+    out, off = [], 0
+    for p in parts:
+        n = _numel(p.shape)
+        out.append(comb[off:off + n].reshape(p.shape))
+        off += n + ((-n) % stride)
+    return out
+
+
+def reset_qinfo(qinfo: dict) -> None:
+    """Reset a ``packed_psum`` accounting dict at the START of a traced
+    body — runs once per trace, so the dict is stable (and idempotent
+    across retraces) by the time any dispatch completes."""
+    qinfo["collectives"] = 0
+    qinfo["bytes_saved"] = 0
+
+
+def tick_quant(qinfo: dict) -> None:
+    """Tick ``op_engine.quant_collectives`` / ``quant_bytes_saved`` from
+    a trace-time ``packed_psum`` accounting dict — call once per DISPATCH
+    of the program whose body filled it (the model-level step wrappers and
+    DASO's capture do; the flush path ticks from its static plan)."""
+    if qinfo.get("collectives"):
+        m = _metrics()
+        m.inc("op_engine.quant_collectives", qinfo["collectives"])
+        m.inc("op_engine.quant_bytes_saved", qinfo["bytes_saved"])
+
+
+def _quant_flush_plan(order, sm, comm):
+    """Static quant selection for one shard_map flush: ``(qsel, n,
+    bytes_saved, qkey)`` — the pending-psum node positions routed through
+    the quantized exchange, the rewritten-collective count, the modeled
+    wire bytes saved (both ticked per dispatch by ``_flush_locked``) and
+    the :func:`quant_key` captured AT PLANNING TIME (a concurrent
+    ``set_quant_codec`` between planning and build must not key or trace
+    the program with a different codec than the one the selection is
+    valid for) — or None when nothing qualifies. Mirrors ``emit_all``'s
+    phase grouping exactly (same (phase, kind, dtype) keys), so the
+    selection, the program key and the body agree by construction. The
+    ``fusion.quant.encode`` fault site fires here: a fault falls back to
+    the exact collectives (and, via the key, to any cached exact
+    program), counted in ``op_engine.quant_fallbacks``."""
+    qkey = quant_key()  # one coherent read of the codec configuration
+    codec, floor, block = qkey
+    if codec is None or comm.size < 2:
+        return None
+    sched, instrs, phases, _, _ = sm
+    groups: Dict[Tuple, list] = {}
+    for pos in sched:
+        ins = instrs[pos]
+        if ins[0] not in ("reduce", "contract") or ins[1] != "psum":
+            continue
+        dt = jnp.dtype(order[pos].aval.dtype)
+        groups.setdefault((phases[pos], str(dt)), []).append(pos)
+    sel, n, saved = set(), 0, 0
+    for (_ph, _dt), members in groups.items():
+        dt = jnp.dtype(_dt)
+        if not _quant_dtype_ok(dt, codec):
+            continue
+        mq = [p for p in members
+              if _numel(order[p].aval.shape) >= floor]
+        if not mq:
+            continue
+        e, q = _quant_wire_bytes(
+            [_numel(order[p].aval.shape) for p in mq], dt.itemsize, codec,
+            (comm.size,), block)
+        sel.update(mq)
+        n += 1
+        saved += max(0, e - q)
+    if not sel:
+        return None
+    try:
+        _faults().check("fusion.quant.encode")
+    except Exception:
+        _metrics().inc("op_engine.quant_fallbacks")
+        return None
+    return frozenset(sel), n, saved, qkey
 
 
 def _plan_sm(order, plan, leaves, leaf_splits, out_idx, comm):
@@ -1563,11 +1934,16 @@ def _plan_sm(order, plan, leaves, leaf_splits, out_idx, comm):
     return sched, instrs, phases, in_specs, out_specs
 
 
-def _sm_body(plan, sm, out_idx, comm):
+def _sm_body(plan, sm, out_idx, comm, qsel=frozenset(),
+             qcfg=(None, 0, 0)):
     """The shard_map replay body for a :func:`_plan_sm` plan: every value
     is a shard-local block (replicated values are full arrays), reduce
     partials accumulate per phase and combine in ONE flattened collective
-    per ``(kind, dtype)`` at each phase barrier."""
+    per ``(kind, dtype)`` at each phase barrier. Positions in ``qsel``
+    (:func:`_quant_flush_plan`) route through the quantized exchange for
+    the CAPTURED ``qcfg = (codec, floor, block)`` instead (never the live
+    globals — the trace may run after a toggle); sub-floor members of the
+    same group keep the exact flattened psum alongside."""
     sched, instrs, phases, _, _ = sm
     axn = comm.axis_name
     size = comm.size
@@ -1587,6 +1963,16 @@ def _sm_body(plan, sm, out_idx, comm):
             pend.clear()
             for (kind, _dt), members in groups.items():
                 coll = _COLL_FNS[kind]
+                if qsel:
+                    qm = [p2 for p2 in members if p2 in qsel]
+                    if qm:
+                        for p2, v in zip(qm, _quant_allreduce_parts(
+                                [vals[p2] for p2 in qm], (axn,), (size,),
+                                qcfg[0], qcfg[2])):
+                            vals[p2] = v
+                        members = [p2 for p2 in members if p2 not in qsel]
+                        if not members:
+                            continue
                 if len(members) == 1:
                     p2 = members[0]
                     vals[p2] = coll(vals[p2], axn)
@@ -1746,7 +2132,8 @@ def _is_arr(x) -> bool:
                           complex))
 
 
-def packed_psum(values, axes):
+def packed_psum(values, axes, qinfo: Optional[dict] = None,
+                quant: Optional[Tuple] = None):
     """ONE flattened all-reduce per dtype over mesh ``axes`` for a list of
     mutually independent shard-local partials — the train-step form of the
     flush body's phase-barrier packing (``_sm_body.emit_all``; the
@@ -1756,15 +2143,67 @@ def packed_psum(values, axes):
     collective is emitted for a 1-device reduction scope. Flatten-concat-
     psum is bitwise-equal to per-value solo psums (probed in PR 4: XLA
     neither tuple-fuses grouped psums itself nor re-associates the
-    concatenated reduce), so packing never moves the numerics."""
+    concatenated reduce), so packing never moves the numerics.
+
+    Under ``HEAT_TPU_QUANT_COLLECTIVES`` the qualifying float payloads
+    (additive, at/above the size floor) ride the quantized exchange
+    instead — sub-floor values (e.g. the packed scalar loss), integer
+    payloads and every value under a fault-injected encode keep the exact
+    flattened psum. ``qinfo`` (a dict the caller resets at body start)
+    accumulates ``collectives``/``bytes_saved`` at trace time so step
+    wrappers can tick the ``op_engine.quant_*`` counters per dispatch.
+    ``quant`` pins the configuration to a :func:`quant_key` tuple captured
+    when the caller BUILT (and cache-keyed) its program — jax traces
+    lazily at first dispatch, and a codec toggle in between must not
+    produce a program whose wire format contradicts its cache key; when
+    None (direct in-body use) the live configuration is read at trace
+    time."""
     values = list(values)
     if not axes:
         return values
+    axes = tuple(axes)
     groups: Dict[Any, list] = {}
     for i, v in enumerate(values):
         groups.setdefault(jnp.dtype(v.dtype), []).append(i)
     out = list(values)
+    codec, floor, block = quant if quant is not None else quant_key()
+    sizes, group_size = (), 1
+    quant_ok = codec is not None
+    if quant_ok:
+        # lax.psum of a python int is STATIC (the axis-size idiom):
+        # sizes are concrete here, usable for the int8 chunking. Only
+        # computed when a codec is armed — the exact path is untouched
+        sizes = tuple(jax.lax.psum(1, a) for a in axes)
+        for s in sizes:
+            group_size *= s
+        quant_ok = group_size > 1
+    if quant_ok:
+        try:
+            _faults().check("fusion.quant.encode")
+        except Exception:
+            _metrics().inc("op_engine.quant_fallbacks")
+            quant_ok = False
     for _dt, members in groups.items():
+        dt = jnp.dtype(_dt)
+        qm = []
+        if quant_ok and _quant_dtype_ok(dt, codec):
+            qm = [i for i in members
+                  if _numel(values[i].shape) >= floor]
+        if qm:
+            for i, v in zip(qm, _quant_allreduce_parts(
+                    [values[i] for i in qm], axes, sizes, codec, block)):
+                out[i] = v
+            if qinfo is not None:
+                e, q = _quant_wire_bytes(
+                    [_numel(values[i].shape) for i in qm], dt.itemsize,
+                    codec, sizes, block)
+                qinfo["collectives"] = qinfo.get("collectives", 0) + 1
+                qinfo["bytes_saved"] = (qinfo.get("bytes_saved", 0)
+                                        + max(0, e - q))
+            qset = set(qm)
+            members = [i for i in members if i not in qset]
+            if not members:
+                continue
         if len(members) == 1:
             i = members[0]
             out[i] = jax.lax.psum(values[i], axes)
@@ -2148,6 +2587,11 @@ def stats() -> dict:
         "ops_per_flush": round(ops / flushes, 3) if flushes else 0.0,
         "max_ops": _MAX_OPS,
         "min_ops": _MIN_OPS,
+        "quant_codec": _QUANT,
+        "quant_min_numel": _QUANT_FLOOR,
+        "quant_collectives": int(c.get("op_engine.quant_collectives", 0)),
+        "quant_bytes_saved": int(c.get("op_engine.quant_bytes_saved", 0)),
+        "quant_fallbacks": int(c.get("op_engine.quant_fallbacks", 0)),
         "program_cache": program_cache().stats(),
     }
 

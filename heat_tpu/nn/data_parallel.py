@@ -80,7 +80,11 @@ class DataParallel:
         self.seed = seed
         self.params = None
         self._train_step = None
-        self._packed_step = None
+        # fusion.quant_key() -> (packed step, its trace-time qinfo dict):
+        # codec toggles compile SIBLINGS and toggle-back re-hits the
+        # cached exact program (same discipline as TransformerLM's
+        # _step_cache; the key space is the handful of codec configs)
+        self._packed_steps = {}
         if loss_is_batch_mean is None:
             loss_is_batch_mean = loss_fn is None  # default CE is a mean
         self.loss_is_batch_mean = bool(loss_is_batch_mean)
@@ -133,7 +137,7 @@ class DataParallel:
 
         return jax.jit(train_step, donate_argnums=(0, 1))
 
-    def _build_packed_train_step(self):
+    def _build_packed_train_step(self, quant=None):
         """The packed-collective form of the train step: one ``shard_map``
         program computing each device's gradients on its LOCAL batch shard
         and combining every parameter cotangent — and the loss — in ONE
@@ -155,14 +159,22 @@ class DataParallel:
         tx = self.optimizer.tx
         comm = self.comm
         axis, p = comm.axis_name, comm.size
+        qinfo = {}
+        if quant is None:
+            quant = fusion.quant_key()
 
         def body(params, opt_state, bx, by):
+            # reset-then-accumulate runs once per trace; step() reads the
+            # stable dict per dispatch for the op_engine.quant_* counters
+            fusion.reset_qinfo(qinfo)
+
             def local_loss(prm):
                 return loss_fn(apply_fn(prm, bx), by)
 
             lval, grads = jax.value_and_grad(local_loss)(params)
             leaves, treedef = jax.tree_util.tree_flatten(grads)
-            packed = fusion.packed_psum(leaves + [lval], (axis,))
+            packed = fusion.packed_psum(leaves + [lval], (axis,),
+                                        qinfo=qinfo, quant=quant)
             grads = jax.tree_util.tree_unflatten(
                 treedef, [g / p for g in packed[:-1]])
             updates, opt_state = tx.update(grads, opt_state, params)
@@ -174,7 +186,7 @@ class DataParallel:
             in_specs=(P(), P(), P(axis), P(axis)),
             out_specs=(P(), P(), P()),
             check_vma=False)
-        return jax.jit(sm, donate_argnums=(0, 1))
+        return jax.jit(sm, donate_argnums=(0, 1)), qinfo
 
     def _pick_step(self, bx, by):
         """Packed step when it applies (fusion step tracing on, a
@@ -192,9 +204,13 @@ class DataParallel:
         if (fusion.step_enabled() and self.loss_is_batch_mean and size > 1
                 and bx.ndim >= 1 and bx.shape[0] % size == 0
                 and by.shape[:1] == bx.shape[:1]):
-            if self._packed_step is None:
-                self._packed_step = self._build_packed_train_step()
-            return self._packed_step
+            qk = fusion.quant_key()
+            if qk not in self._packed_steps:
+                # the KEY's tuple is also the traced wire config (jax
+                # traces at first dispatch; a toggle in between must not
+                # change the program out from under its key)
+                self._packed_steps[qk] = self._build_packed_train_step(qk)
+            return self._packed_steps[qk][0]
         if self._train_step is None:
             self._train_step = self._build_train_step()
         return self._train_step
@@ -219,10 +235,14 @@ class DataParallel:
         self.params, self.optimizer.opt_state, loss = step_fn(
             self.params, self.optimizer.opt_state, bx, by
         )
-        if step_fn is self._packed_step:
+        packed = next((rec for rec in self._packed_steps.values()
+                       if rec[0] is step_fn), None)
+        if packed is not None:
+            from ..core import fusion
             from ..utils import metrics
 
             metrics.inc("op_engine.fusion_step_flushes")
+            fusion.tick_quant(packed[1])
         return float(loss)
 
     def local_loss(self, x, y) -> float:

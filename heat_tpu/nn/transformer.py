@@ -461,22 +461,32 @@ class TransformerLM:
         return tuple(a for a, n in (("dp", self.dp), ("sp", self.sp))
                      if n > 1)
 
-    def _packed_loss_and_grad_body(self):
+    def _packed_loss_and_grad_body(self, qinfo=None, quant=None):
         """Per-device (params, toks) -> (loss, grads) with every gradient
         cotangent — and the loss — combined in ONE flattened all-reduce:
         local value_and_grad of the device's loss share, then
         :func:`heat_tpu.core.fusion.packed_psum` over the data axes (the
         generalized-allreduce packing, arXiv:2004.09362), instead of the
-        one-psum-per-parameter GSPMD emits for the transposed broadcast."""
+        one-psum-per-parameter GSPMD emits for the transposed broadcast.
+        Under ``HEAT_TPU_QUANT_COLLECTIVES`` the qualifying gradient
+        payloads ride the quantized exchange (the scalar loss is below
+        the size floor and stays exact); ``qinfo`` collects the rewrite
+        counts at trace time for the step wrapper's counters; ``quant``
+        pins the configuration the builder cache-keyed on (jax traces at
+        first dispatch — a codec toggle in between must not change the
+        traced wire format out from under the key)."""
         from ..core import fusion
 
         axes = self._batch_axes()
 
         def body(params, toks):
+            if qinfo is not None:
+                fusion.reset_qinfo(qinfo)
             lval, grads = jax.value_and_grad(
                 self._local_loss_device)(params, toks)
             leaves, treedef = jax.tree_util.tree_flatten(grads)
-            packed = fusion.packed_psum(leaves + [lval], axes)
+            packed = fusion.packed_psum(leaves + [lval], axes, qinfo=qinfo,
+                                        quant=quant)
             return packed[-1], jax.tree_util.tree_unflatten(
                 treedef, packed[:-1])
 
@@ -493,16 +503,37 @@ class TransformerLM:
         from ..core import fusion
 
         packed = self.packed_step_supported and fusion.step_enabled()
-        key = ("loss_and_grad", packed)
+        # the quant codec changes the packed program's collective wire
+        # format, so it keys the cache — toggling compiles a sibling
+        # program instead of poisoning the exact one (the legacy key
+        # stays 2-tuple: the check_vma path never quantizes)
+        qk = fusion.quant_key()
+        key = ("loss_and_grad", True, qk) if packed \
+            else ("loss_and_grad", False)
         fn = self._step_cache.get(key)
         if fn is None:
             specs = self.param_specs()
             if packed:
+                qinfo = {}
                 sm = shard_map(
-                    self._packed_loss_and_grad_body(), mesh=self.grid.mesh,
+                    self._packed_loss_and_grad_body(qinfo=qinfo, quant=qk),
+                    mesh=self.grid.mesh,
                     in_specs=(specs, self._data_spec()),
                     out_specs=(P(), specs),
                     check_vma=False)
+                jitted = jax.jit(sm)
+
+                def fn(params, toks, _jitted=jitted, _qinfo=qinfo):
+                    out = _jitted(params, toks)
+                    # per-dispatch counters, like the step wrappers —
+                    # runtime_stats must show quantization ran on THIS
+                    # surface too (doc/fusion.md counter contract)
+                    fusion.tick_quant(_qinfo)
+                    return out
+
+                fn.lower = jitted.lower
+                self._step_cache[key] = fn
+                return fn
             else:
                 def body(params, toks):
                     return jax.value_and_grad(self._loss_device)(params, toks)
@@ -561,7 +592,9 @@ class TransformerLM:
 
         if self.packed_step_supported and fusion.step_enabled():
             specs = self.param_specs()
-            lg_body = self._packed_loss_and_grad_body()
+            qinfo = {}
+            lg_body = self._packed_loss_and_grad_body(
+                qinfo=qinfo, quant=fusion.quant_key())
 
             def body(params, opt_state, toks):
                 loss, grads = lg_body(params, toks)
@@ -586,6 +619,7 @@ class TransformerLM:
                 from ..utils import metrics
 
                 metrics.inc("op_engine.fusion_step_flushes")
+                fusion.tick_quant(qinfo)
                 return out
 
             # the audit/steady-state surface of the underlying program

@@ -244,6 +244,10 @@ class DASO:
         self._pending = None  # (apply_at_batch, bf16 slow-tier average)
         self._avg_fn = None
         self._blend_fn = None
+        # fusion.quant_key() -> (packed capture program, its qinfo dict):
+        # codec toggles compile siblings, toggle-back re-hits the cached
+        # exact program (same discipline as the model step caches)
+        self._packed_avgs = {}
 
     @property
     def tx(self):
@@ -303,6 +307,63 @@ class DASO:
         self._blend_fn = jax.jit(
             lambda av, ps: jax.tree_util.tree_map(blend_leaf, av, ps))
 
+    def _build_packed_avg(self, quant=None):
+        """The packed (and quantizable) form of the slow-tier capture: ONE
+        ``shard_map`` over the ``"dcn"`` axis combining EVERY leaf's bf16
+        wire average in a single flattened collective
+        (:func:`heat_tpu.core.fusion.packed_psum` — which rewrites the
+        qualifying payloads under ``HEAT_TPU_QUANT_COLLECTIVES``), instead
+        of the one GSPMD all-reduce per parameter leaf the jitted
+        ``tree_map`` mean emits. Wire semantics match the reference DASO
+        contract exactly: parameters downcast to bf16 BEFORE the
+        inter-node reduction (``__prep_params_to_send`` ``:592``)."""
+        from ..core import fusion
+        from ..core._compat import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        cast = self.downcast_type
+        slow = self.slow_size
+        qinfo = {}
+        if quant is None:
+            quant = fusion.quant_key()
+
+        def body(params):
+            fusion.reset_qinfo(qinfo)
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            # local block is (1, ...): this device's replica in wire dtype
+            parts = [l[0].astype(cast) for l in leaves]
+            packed = fusion.packed_psum(parts, ("dcn",), qinfo=qinfo,
+                                        quant=quant)
+            return jax.tree_util.tree_unflatten(
+                treedef, [(p / slow).astype(cast) for p in packed])
+
+        sm = shard_map(body, mesh=self.grid.mesh,
+                       in_specs=(P("dcn"),), out_specs=P(),
+                       check_vma=False)
+        return jax.jit(sm), qinfo
+
+    def _capture(self, params):
+        """The slow-tier capture (the bf16 "send"): the packed/quantized
+        shard_map form when the fusion step engine is on and every leaf is
+        floating (non-float leaves need the legacy replica-0 pick), else
+        the historic per-leaf jitted mean. Keyed on
+        :func:`heat_tpu.core.fusion.quant_key` so a codec toggle rebuilds
+        instead of dispatching a stale wire format."""
+        from ..core import fusion
+
+        if (self.slow_size > 1 and fusion.step_enabled()
+                and all(jnp.issubdtype(l.dtype, jnp.floating)
+                        for l in jax.tree_util.tree_leaves(params)
+                        if hasattr(l, "dtype"))):
+            qk = fusion.quant_key()
+            if qk not in self._packed_avgs:
+                self._packed_avgs[qk] = self._build_packed_avg(qk)
+            fn, qinfo = self._packed_avgs[qk]
+            out = fn(params)
+            fusion.tick_quant(qinfo)
+            return out
+        return self._avg_fn(params)
+
     def _check_replicated(self, params):
         """Reject un-replicated params when the slow tier is real: the
         replica average would otherwise silently mean over a *parameter*
@@ -328,7 +389,7 @@ class DASO:
         if self._avg_fn is None:
             self._build_sync_fns()
         self._check_replicated(params)
-        return self._blend_fn(self._avg_fn(params), params)
+        return self._blend_fn(self._capture(params), params)
 
     def step(self, params):
         """Advance the DASO schedule by one batch (reference ``step``
@@ -347,7 +408,7 @@ class DASO:
             self._pending = None
         skip = max(1, self.global_skip)
         if self._batch % skip == 0:
-            avg = self._avg_fn(params)  # the bf16 "send"
+            avg = self._capture(params)  # the bf16 "send"
             wait = min(self.batches_to_wait, skip)
             if wait <= 0:
                 params = self._blend_fn(avg, params)

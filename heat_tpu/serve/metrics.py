@@ -156,9 +156,13 @@ def runtime_stats() -> dict:
 
     from . import executor as _executor
 
+    from ..utils.program_cache import ProgramCache
+
     depth = 0
-    cache_stats = {"hits": 0, "misses": 0, "compiles": 0, "evictions": 0,
-                   "entries": 0}
+    # init from the cache's own key set: a ProgramCache.stats() key this
+    # dict lacks would KeyError the += fold below with live executors
+    # (the PR 7 drift) — the stats-shape contract test pins both sides
+    cache_stats = {k: 0 for k in ProgramCache.STATS_KEYS}
     n_exec = 0
     caches = {}  # dedupe by identity: executors may SHARE a ProgramCache
     for ex in _executor.live_executors():

@@ -92,6 +92,11 @@ SITES: Dict[str, tuple] = {
     "fusion.step.dispatch": (
         FaultInjected,
         "trace_step dispatch of a PRIMED (previously successful) program"),
+    "fusion.quant.encode": (
+        FaultInjected,
+        "quantized-collective encode planning (flush packing and "
+        "packed_psum) — falls back to the exact collective, counted in "
+        "op_engine.quant_fallbacks"),
     # reshard planner (core/resharding.py)
     "reshard.plan.build": (
         FaultInjected,

@@ -20,7 +20,7 @@ import re
 from typing import Dict
 
 __all__ = ["collective_stats", "communicating_collective_stats",
-           "total_collective_bytes", "memory_stats",
+           "total_collective_bytes", "collective_bytes", "memory_stats",
            "entry_root_shapes", "COLLECTIVES"]
 
 COLLECTIVES = (
@@ -44,6 +44,22 @@ _INSTR_RE = re.compile(
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 
 
+def _result_bytes(result: str) -> int:
+    """Per-device payload bytes of one instruction's result-type text —
+    every ``dtype[dims]`` token summed (tuple results carry several).
+    The ONE shape/dtype byte fold; ``collective_stats`` and
+    ``collective_bytes`` both call it, so a dtype-table or shape-syntax
+    fix can never drift between the stats and the wire audit."""
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(result):
+        n = 1
+        for piece in dims.split(","):
+            if piece:
+                n *= int(piece)
+        total += n * _DTYPE_BYTES.get(dt, 4)
+    return total
+
+
 def collective_stats(hlo: str) -> Dict[str, Dict[str, int]]:
     """``{kind: {"count": int, "bytes": int}}`` over an optimized-HLO dump.
 
@@ -57,15 +73,8 @@ def collective_stats(hlo: str) -> Dict[str, Dict[str, int]]:
         if m is None:
             continue
         result, kind = m.groups()
-        total = 0
-        for dt, dims in _SHAPE_RE.findall(result):
-            n = 1
-            for piece in dims.split(","):
-                if piece:
-                    n *= int(piece)
-            total += n * _DTYPE_BYTES.get(dt, 4)
         stats[kind]["count"] += 1
-        stats[kind]["bytes"] += total
+        stats[kind]["bytes"] += _result_bytes(result)
     return {k: v for k, v in stats.items() if v["count"]}
 
 
@@ -82,20 +91,44 @@ def _moves_data(line: str) -> bool:
     over size-1 mesh axes lower to singleton-group all-reduces
     (``replica_groups={{0},{1},...}``) that move ZERO bytes — the
     packed-collective train-step audits must not count them, and must not
-    be fooled when another jax keeps them."""
+    be fooled when another jax keeps them. Thin wrapper over the ONE
+    replica-group parser (:func:`_group_size`): ``None`` — no/unparsable
+    annotation, or the empty all-replicas form with no ``world`` in hand —
+    stays the historic conservative "communicates"."""
+    size = _group_size(line, None)
+    return True if size is None else size > 1
+
+
+def communicating_collective_stats(hlo: str) -> Dict[str, Dict[str, int]]:
+    """:func:`collective_stats` restricted to instructions that move data
+    between devices (non-singleton replica groups)."""
+    kept = [line for line in hlo.splitlines()
+            if _INSTR_RE.match(_COMMENT_RE.sub("", line)) is not None
+            and _moves_data(_COMMENT_RE.sub("", line))]
+    return collective_stats("\n".join(kept))
+
+
+_IOTA_GROUP_RE = re.compile(r"\[(\d+),(\d+)\]")
+
+
+def _group_size(line: str, world=None):
+    """Largest communicating-group participant count on one collective
+    instruction line, handling every replica-group form
+    :func:`_moves_data` parses: brace-of-braces ``{{0,1},{2,3}}``, flat
+    ``{0,1,2,3}``, EMPTY ``{}`` (one group of ALL replicas — resolved by
+    ``world``), and iota ``[G,S]<=[N]`` (``S`` participants per group).
+    ``None`` when the line carries no annotation or ``world`` is needed
+    but unknown — callers fall back conservatively."""
     tag = "replica_groups="
     start = line.find(tag)
     if start < 0:
-        return True  # no group annotation: count conservatively
+        return None
     rest = line[start + len(tag):]
     if rest.startswith("["):
-        # iota form: replica_groups=[G,S]<=[...] — G groups of size S;
-        # singleton groups (S == 1) move nothing
-        m = re.match(r"\[(\d+),(\d+)\]", rest)
-        return True if m is None else int(m.group(2)) > 1
+        m = _IOTA_GROUP_RE.match(rest)
+        return None if m is None else int(m.group(2))
     if not rest.startswith("{"):
-        return True
-    # balanced-brace scan (groups nest one level: {{0},{1}} or flat {0,1})
+        return None
     depth = 0
     for j, ch in enumerate(rest):
         if ch == "{":
@@ -106,22 +139,83 @@ def _moves_data(line: str) -> bool:
                 body = rest[1:j]
                 groups = _ONE_GROUP_RE.findall(body)
                 if groups:
-                    return any("," in g for g in groups)
+                    return max(len([p for p in g.split(",") if p.strip()])
+                               for g in groups)
                 if not body.strip():
-                    # empty replica_groups = ONE group of all replicas —
-                    # that collective communicates
-                    return True
-                return "," in body  # flat single group: {0,1,2,3}
-    return True
+                    return world  # empty = one group of all replicas
+                return len([p for p in body.split(",") if p.strip()])
+    return None
 
 
-def communicating_collective_stats(hlo: str) -> Dict[str, Dict[str, int]]:
-    """:func:`collective_stats` restricted to instructions that move data
-    between devices (non-singleton replica groups)."""
-    kept = [line for line in hlo.splitlines()
-            if _INSTR_RE.match(_COMMENT_RE.sub("", line)) is not None
-            and _moves_data(_COMMENT_RE.sub("", line))]
-    return collective_stats("\n".join(kept))
+def collective_bytes(hlo: str, world: int = None) -> dict:
+    """Per-collective byte accounting over an optimized-HLO dump:
+    element type × result shape × communicating replica groups.
+
+    For every collective instruction this returns the per-device
+    result-shape payload bytes (tuple elements summed, like
+    :func:`collective_stats`), the communicating group size ``g`` and the
+    modeled per-device **ring wire bytes** — what the collective actually
+    moves, which the result shape alone misstates (an all-reduce passes
+    its payload twice: reduce-scatter + all-gather; an all-to-all passes
+    it once):
+
+    ==================  ======================================
+    kind                wire bytes (result payload ``R``)
+    ==================  ======================================
+    all-reduce          ``2 · R · (g-1)/g``
+    reduce-scatter      ``R · (g-1)``  (input is ``g·R``)
+    all-gather          ``R · (g-1)/g``
+    all-to-all          ``R · (g-1)/g``
+    collective-permute  ``R``
+    ==================  ======================================
+
+    Singleton groups (``g <= 1``) move ZERO wire bytes — identity psums
+    are excluded automatically, matching
+    :func:`communicating_collective_stats`. ``world`` resolves the empty
+    all-replicas replica-group form; lines with no parsable group fall
+    back to ``world`` (or a conservative 2 when unknown). The fusion
+    engine's ``op_engine.quant_bytes_saved`` counter applies these same
+    formulas, so the quantized-collective audit and the runtime counters
+    agree by construction (``doc/fusion.md``).
+
+    Returns ``{"per_instruction": [{kind, result_bytes, group_size,
+    wire_bytes}, ...], "by_kind": {kind: {count, result_bytes,
+    wire_bytes}}, "total_result_bytes", "total_wire_bytes"}``.
+    """
+    per = []
+    for line in hlo.splitlines():
+        stripped = _COMMENT_RE.sub("", line)
+        m = _INSTR_RE.match(stripped)
+        if m is None:
+            continue
+        result, kind = m.groups()
+        rbytes = _result_bytes(result)
+        g = _group_size(stripped, world)
+        if g is None:
+            g = world if world else 2
+        g = int(g)
+        if g <= 1:
+            wire = 0
+        elif kind == "all-reduce":
+            wire = 2 * rbytes * (g - 1) // g
+        elif kind == "reduce-scatter":
+            wire = rbytes * (g - 1)
+        elif kind in ("all-gather", "all-to-all"):
+            wire = rbytes * (g - 1) // g
+        else:  # collective-permute: one send of the payload
+            wire = rbytes
+        per.append({"kind": kind, "result_bytes": rbytes,
+                    "group_size": g, "wire_bytes": wire})
+    by_kind: Dict[str, Dict[str, int]] = {}
+    for rec in per:
+        agg = by_kind.setdefault(
+            rec["kind"], {"count": 0, "result_bytes": 0, "wire_bytes": 0})
+        agg["count"] += 1
+        agg["result_bytes"] += rec["result_bytes"]
+        agg["wire_bytes"] += rec["wire_bytes"]
+    return {"per_instruction": per, "by_kind": by_kind,
+            "total_result_bytes": sum(r["result_bytes"] for r in per),
+            "total_wire_bytes": sum(r["wire_bytes"] for r in per)}
 
 
 _ROOT_ASSIGN_RE = re.compile(r"^\s*ROOT\s+%?[\w.\-]+\s*=\s*")

@@ -38,6 +38,12 @@ __all__ = ["ProgramCache"]
 class ProgramCache:
     """Keyed cache of compiled programs with hit/miss/compile counters."""
 
+    # the exact key set :meth:`stats` returns — aggregators that fold many
+    # caches into one counter dict (serve/metrics.py runtime_stats) init
+    # from THIS tuple, so a new stats key can never KeyError them (the
+    # recurring stats()-shape drift the contract test pins at the source)
+    STATS_KEYS = ("hits", "misses", "compiles", "evictions", "entries")
+
     def __init__(self, name: str = "programs", aot: bool = True,
                  counter_prefix: str = None, max_entries: int = None):
         self.name = name
@@ -120,7 +126,8 @@ class ProgramCache:
         return jitted
 
     def stats(self) -> dict:
-        """Plain-dict counters (folded into metrics snapshots)."""
+        """Plain-dict counters (folded into metrics snapshots); keys are
+        exactly :data:`STATS_KEYS`."""
         with self._lock:
             return {"hits": self.hits, "misses": self.misses,
                     "compiles": self.compiles,

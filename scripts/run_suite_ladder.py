@@ -149,22 +149,34 @@ _FUSION_AB_TESTS = [
 ]
 
 
-def run_fusion_ab(n: int, timeout: float) -> dict:
-    """One suite leg with ``HEAT_TPU_FUSION=0`` vs ``1`` on a fast subset:
-    any test that passes eager but fails fused (or vice versa) is semantic
-    drift the fused engine introduced — exit-gating, like the serve smoke."""
-    legs = {}
-    for flag in ("0", "1"):
+# training-heavy subset for the quantized-collective A/B: the packed
+# train-step surfaces (trace_step, the TransformerLM/DataParallel packed
+# steps) plus the quant property/acceptance suite itself — the per-test
+# HEAT_TPU_LADDER_STATS log carries quant_collectives/quant_bytes_saved
+# so the A/B shows which tests actually moved quantized bytes
+_QUANT_AB_TESTS = [
+    "tests/test_trace_step.py", "tests/test_transformer.py",
+    "tests/test_nn_optim_data.py", "tests/test_quant_collectives.py",
+]
+
+
+def _run_env_ab(env_key: str, legs_spec, tests, n: int,
+                timeout: float) -> dict:
+    """Shared A/B mechanics for the env-flag gates: run ``tests`` once
+    per ``(label, env value)`` leg, both legs must pass (``agree``).
+    ``legs_spec`` is ``((label, value), (label, value))``."""
+    result = {}
+    for label, value in legs_spec:
         env = _env(n)
-        env["HEAT_TPU_FUSION"] = flag
+        env[env_key] = value
         t0 = time.time()
         try:
             out = subprocess.run(
-                [sys.executable, "-m", "pytest", *_FUSION_AB_TESTS, "-q"],
+                [sys.executable, "-m", "pytest", *tests, "-q"],
                 env=env, capture_output=True, text=True, timeout=timeout,
                 cwd=_REPO)
         except subprocess.TimeoutExpired:
-            legs[flag] = {"error": f"exceeded {timeout:.0f}s"}
+            result[label] = {"error": f"exceeded {timeout:.0f}s"}
             continue
         rec = {"rc": out.returncode, "wall_s": round(time.time() - t0, 1)}
         m = _SUMMARY_RE.search(out.stdout)
@@ -174,10 +186,30 @@ def run_fusion_ab(n: int, timeout: float) -> dict:
                        skipped=int(skipped or 0), errors=int(errors or 0))
         if out.returncode != 0:
             rec["tail"] = out.stdout.strip().splitlines()[-15:]
-        legs[flag] = rec
-    return {"eager": legs.get("0"), "fused": legs.get("1"),
-            "agree": bool(legs.get("0", {}).get("rc") == 0
-                          and legs.get("1", {}).get("rc") == 0)}
+        result[label] = rec
+    result["agree"] = all(
+        result.get(label, {}).get("rc") == 0 for label, _ in legs_spec)
+    return result
+
+
+def run_quant_ab(n: int, timeout: float) -> dict:
+    """``HEAT_TPU_QUANT_COLLECTIVES=0`` vs ``int8`` on the training-heavy
+    subset: the quant leg must keep every packed-step test green (the
+    codec may never change WHICH path runs, only its wire format, within
+    the documented error contract), and the exact leg proves the escape
+    hatch restores today's behavior — exit-gating, like the fusion A/B."""
+    return _run_env_ab("HEAT_TPU_QUANT_COLLECTIVES",
+                       (("exact", "0"), ("quant", "int8")),
+                       _QUANT_AB_TESTS, n, timeout)
+
+
+def run_fusion_ab(n: int, timeout: float) -> dict:
+    """One suite leg with ``HEAT_TPU_FUSION=0`` vs ``1`` on a fast subset:
+    any test that passes eager but fails fused (or vice versa) is semantic
+    drift the fused engine introduced — exit-gating, like the serve smoke."""
+    return _run_env_ab("HEAT_TPU_FUSION",
+                       (("eager", "0"), ("fused", "1")),
+                       _FUSION_AB_TESTS, n, timeout)
 
 
 _CHAOS_SITE_RE = re.compile(
@@ -272,6 +304,13 @@ def main():
     ap.add_argument("--no-fusion-ab", dest="fusion_ab", action="store_false",
                     help="skip the fusion on/off semantic-drift A/B")
     ap.add_argument("--fusion-ab-timeout", type=float, default=900.0)
+    ap.add_argument("--quant-ab", dest="quant_ab", action="store_true",
+                    default=True,
+                    help="run the HEAT_TPU_QUANT_COLLECTIVES=0 vs int8 "
+                         "A/B on the training-heavy subset (default on)")
+    ap.add_argument("--no-quant-ab", dest="quant_ab", action="store_false",
+                    help="skip the quantized-collective A/B")
+    ap.add_argument("--quant-ab-timeout", type=float, default=900.0)
     ap.add_argument("--serve-smoke", dest="serve_smoke", action="store_true",
                     default=True, help="run the serving smoke (default on)")
     ap.add_argument("--no-serve-smoke", dest="serve_smoke",
@@ -361,6 +400,17 @@ def main():
         fusion_bad = not ab.get("agree", False)
         print(json.dumps({"fusion_ab_ok": not fusion_bad}), flush=True)
 
+    quant_bad = False
+    if args.quant_ab and not args.examples_only:
+        # codec gate: the training-heavy subset must pass exact AND int8
+        # (4-device mesh — with the ladder's 8-dev full suites this
+        # covers the 4/8-dev acceptance pair)
+        print("=== quant collectives A/B (4 devices) ===", flush=True)
+        qab = run_quant_ab(4, args.quant_ab_timeout)
+        artifact["quant_ab"] = qab
+        quant_bad = not qab.get("agree", False)
+        print(json.dumps({"quant_ab_ok": not quant_bad}), flush=True)
+
     audit_bad = False
     if not (args.no_resplit_audit or args.examples_only):
         # re-check the reshard planner's collective bounds every round:
@@ -392,8 +442,8 @@ def main():
     print(f"wrote {args.out}")
     bad = ([r for r in ladder if r.get("rc") != 0]
            + [r for r in ex if r.get("rc") != 0])
-    sys.exit(1 if bad or audit_bad or serve_bad or fusion_bad or chaos_bad
-             else 0)
+    sys.exit(1 if bad or audit_bad or serve_bad or fusion_bad or quant_bad
+             or chaos_bad else 0)
 
 
 if __name__ == "__main__":

@@ -109,6 +109,12 @@ def pytest_runtest_teardown(item, nextitem):
                 c.get("op_engine.fusion_step_flushes", 0)),
             "fusion_step_fallbacks": int(
                 c.get("op_engine.fusion_step_fallbacks", 0)),
+            # quantized packed collectives: which tests actually moved
+            # quantized bytes (the QUANT=0/1 ladder A/B reads these)
+            "quant_collectives": int(
+                c.get("op_engine.quant_collectives", 0)),
+            "quant_bytes_saved": int(
+                c.get("op_engine.quant_bytes_saved", 0)),
             "zero_fills": int(c.get("op_engine.zero_fills", 0)),
             "fusion_ops": int(c.get("op_engine.fusion_ops", 0)),
             "fusion_program_compiles": int(

@@ -42,6 +42,7 @@ from heat_tpu.utils.checkpointing import CheckpointManager
 FALLBACK_COUNTERS = (
     "op_engine.fusion_flush_fallbacks",
     "op_engine.fusion_step_fallbacks",
+    "op_engine.quant_fallbacks",
     "resharding.plan_build_fallbacks",
     "resharding.dispatch_fallbacks",
     "serve.batch_retries",
@@ -64,6 +65,7 @@ MATRIX = {
     # loop count a fallback (documented in doc/robustness.md)
     "fusion.step.trace": ("train", "op_engine.fusion_step_fallbacks", 2),
     "fusion.step.dispatch": ("train", None, 0),
+    "fusion.quant.encode": ("quant", "op_engine.quant_fallbacks", 1),
     "reshard.plan.build": ("resplit", "resharding.plan_build_fallbacks", 1),
     "reshard.dispatch": ("resplit", "resharding.dispatch_fallbacks", 1),
     "serve.worker.batch": ("serve", "serve.worker_backstops", 1),
@@ -126,6 +128,36 @@ def _wl_train(tmp_path):
             absorbed += 1
             p = ts(p, g)
     return {"p": p.numpy()}, {"absorbed": absorbed}
+
+
+def _wl_quant(tmp_path):
+    """A quantized packed psum (int8 codec armed) whose payload is
+    engineered to round-trip the codec EXACTLY (power-of-two block
+    scales, sums representable in bf16), so the fault-free quantized run
+    and the faulted exact-collective fallback are value-identical — the
+    harness's allclose contract holds on both legs. The fresh shard_map
+    program traces per invocation, reaching the encode site each run."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from heat_tpu.core._compat import shard_map
+
+    comm = ht.get_comm()
+    block = fusion.quant_key()[2]
+    nblocks = max(8, comm.size)
+    v = np.zeros(nblocks * block, np.float32)
+    for b in range(nblocks):
+        v[b * block] = 127.0 / 16.0
+        v[b * block + 1:(b + 1) * block] = (np.arange(block - 1) % 8) / 16.0
+
+    def body(x):
+        return fusion.packed_psum([x], (comm.axis_name,))[0]
+
+    with fusion.quant_override("int8"):
+        fn = jax.jit(shard_map(body, mesh=comm.mesh, in_specs=(P(),),
+                               out_specs=P(), check_vma=False))
+        out = np.asarray(fn(v))
+    return {"psum": out}, {}
 
 
 def _wl_resplit(tmp_path):
@@ -203,8 +235,9 @@ def _wl_init(tmp_path):
     return {"size": np.asarray(comm.size)}, {"connects": calls["n"]}
 
 
-_WORKLOADS = {"ops": _wl_ops, "train": _wl_train, "resplit": _wl_resplit,
-              "serve": _wl_serve, "ckpt": _wl_ckpt, "init": _wl_init}
+_WORKLOADS = {"ops": _wl_ops, "train": _wl_train, "quant": _wl_quant,
+              "resplit": _wl_resplit, "serve": _wl_serve,
+              "ckpt": _wl_ckpt, "init": _wl_init}
 
 _BASELINES: dict = {}  # workload name -> fault-free payload (per session)
 
@@ -238,6 +271,9 @@ def test_chaos_site(site, tmp_path):
     wl_name, counter, expected = MATRIX[site]
     if site == "reshard.plan.build" and ht.get_comm().size == 1:
         pytest.skip("single-device mesh never builds an explicit plan")
+    if site == "fusion.quant.encode" and ht.get_comm().size == 1:
+        pytest.skip("single-device mesh emits no communicating psum to "
+                    "quantize")
     want = _baseline(wl_name, tmp_path)
     before = _snap()
     fires_before = _fires(site)

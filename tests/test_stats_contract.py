@@ -1,0 +1,108 @@
+"""The ``runtime_stats()`` shape contract (ISSUE 10 satellite).
+
+Recurring drift (bit PR 7, nearly bit this PR): a subsystem adds a key to
+``fusion.stats()`` / ``ProgramCache.stats()`` and the serve/metrics
+aggregation — or a dashboard reading the snapshot — KeyErrors later, far
+from the change. This module pins the WHOLE ``runtime_stats()`` schema as
+an exact key-set contract at every level, so adding a key without
+updating the pinned schema (and, deliberately, every aggregation that
+folds it) fails HERE, at the source, in tier-1.
+
+When this test fails on a key you just added: update the schema below
+AND check ``heat_tpu/serve/metrics.py``'s aggregation init plus
+``doc/serving.md``'s runtime_stats section — that is the point.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.serve import (Pow2Buckets, ServeConfig, ServeMetrics,
+                            ServingExecutor)
+from heat_tpu.utils.program_cache import ProgramCache
+
+# ---- the pinned schema: EXACT key sets per level ---------------------- #
+TOP_KEYS = {"serve", "resharding", "op_engine", "faults", "counters"}
+
+SERVE_KEYS = {"requests", "batches", "rows", "padded_rows", "shed",
+              "deadline_expired", "fallback_single", "errors",
+              "latency_ms", "batch_occupancy", "queue_depth", "executors",
+              "program_cache"}
+
+RESHARDING_KEYS = {"hits", "misses", "entries"}
+
+OP_ENGINE_KEYS = {"align_resplits", "fusion"}
+
+FUSION_KEYS = {
+    "enabled", "reduce_enabled", "contract_enabled", "resplit_enabled",
+    "step_enabled", "step_flushes", "step_fallbacks",
+    "flushes", "flush_fallbacks", "inline_flushes",
+    "reduce_flushes", "contract_flushes",
+    "resplit_flushes", "resplit_nodes", "resplit_fallbacks",
+    "fused_ops", "ops_per_flush", "max_ops", "min_ops",
+    "quant_codec", "quant_min_numel", "quant_collectives",
+    "quant_bytes_saved", "quant_fallbacks",
+    "program_cache",
+}
+
+FAULTS_KEYS = {"armed", "plan", "sites", "arms", "total_fires", "fires"}
+
+PROGRAM_CACHE_KEYS = set(ProgramCache.STATS_KEYS)
+
+
+def test_program_cache_stats_keys_are_the_declared_contract():
+    """``ProgramCache.stats()`` returns exactly ``STATS_KEYS`` — the
+    tuple the serve aggregation inits from. A stats key outside the
+    declared set would KeyError ``runtime_stats`` with live executors."""
+    assert set(ProgramCache("contract-probe").stats()) == \
+        PROGRAM_CACHE_KEYS == {"hits", "misses", "compiles", "evictions",
+                               "entries"}
+
+
+def test_runtime_stats_schema_pinned():
+    rt = ht.runtime_stats()
+    assert set(rt) == TOP_KEYS
+    assert set(rt["serve"]) == SERVE_KEYS
+    assert set(rt["serve"]["program_cache"]) == PROGRAM_CACHE_KEYS
+    assert set(rt["resharding"]) == RESHARDING_KEYS
+    assert set(rt["op_engine"]) == OP_ENGINE_KEYS
+    assert set(rt["op_engine"]["fusion"]) == FUSION_KEYS
+    assert set(rt["op_engine"]["fusion"]["program_cache"]) == \
+        PROGRAM_CACHE_KEYS
+    assert set(rt["faults"]) == FAULTS_KEYS
+    assert isinstance(rt["counters"], dict)
+
+
+def test_runtime_stats_value_types_pinned():
+    """Types every consumer (serve dashboards, the ladder artifact,
+    bench records) may rely on — json-serializable scalars throughout."""
+    import json
+
+    rt = ht.runtime_stats()
+    fu = rt["op_engine"]["fusion"]
+    for k in ("flushes", "fused_ops", "step_flushes", "quant_collectives",
+              "quant_bytes_saved", "quant_fallbacks", "quant_min_numel"):
+        assert isinstance(fu[k], int), k
+    assert fu["quant_codec"] in (None, "bf16", "int8")
+    for k in ("enabled", "reduce_enabled", "step_enabled"):
+        assert isinstance(fu[k], bool), k
+    # the whole snapshot must round-trip through json (dashboards)
+    json.dumps(rt)
+
+
+def test_runtime_stats_survives_live_executor():
+    """The aggregation fold with a LIVE executor — the exact code path
+    the PR 7 stats-key drift KeyError'd."""
+    comm = ht.get_comm()
+
+    def model(x):
+        return x * np.float32(2.0)
+
+    cfg = ServeConfig(
+        max_batch=4,
+        bucket_rows=Pow2Buckets(min_rows=comm.size, multiple_of=comm.size))
+    with ServingExecutor(model, cfg, metrics=ServeMetrics(),
+                         cache_token=comm.cache_key) as ex:
+        ex.predict(np.ones((comm.size, 3), np.float32), timeout=60)
+        rt = ht.runtime_stats()
+        assert rt["serve"]["executors"] >= 1
+        assert set(rt["serve"]["program_cache"]) == PROGRAM_CACHE_KEYS

@@ -362,8 +362,7 @@ class TestDataParallelPackedStep:
                                    ht.array(y, split=0))
                           for _ in range(4)]
             if packed and ht.get_comm().size > 1:
-                assert net._packed_step is not None, \
-                    "packed path not exercised"
+                assert net._packed_steps, "packed path not exercised"
             return losses
 
         np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
@@ -395,7 +394,7 @@ class TestDataParallelPackedStep:
             net = ht.nn.DataParallel(Net(), optimizer=opt, seed=0,
                                      loss_fn=loss_sum)
             net.step(ht.array(X, split=0), ht.array(y, split=0))
-            assert net._packed_step is None, \
+            assert not net._packed_steps, \
                 "sum-reduction loss silently took the packed step"
             opt2 = ht.optim.DataParallelOptimizer(ht.optim.SGD(lr=0.01))
             mean_net = ht.nn.DataParallel(
@@ -403,7 +402,7 @@ class TestDataParallelPackedStep:
                 loss_fn=lambda o, t: jnp.mean((o - 0.0) ** 2),
                 loss_is_batch_mean=True)
             mean_net.step(ht.array(X, split=0), ht.array(y, split=0))
-            assert mean_net._packed_step is not None
+            assert mean_net._packed_steps
 
     def test_packed_gradient_allreduce_is_packed(self):
         """The train-step HLO carries ONE communicating all-reduce total —
@@ -428,7 +427,7 @@ class TestDataParallelPackedStep:
         X = np.ones((comm.size * 4, 8), np.float32)
         y = np.zeros(comm.size * 4, np.int32)
         net.init(X)
-        packed = net._build_packed_train_step()
+        packed, _qinfo = net._build_packed_train_step()
         txt = packed.lower(net.params, net.optimizer.opt_state,
                            jnp.asarray(X), jnp.asarray(y)).compile().as_text()
         from heat_tpu.utils import hlo_audit

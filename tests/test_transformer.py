@@ -147,10 +147,16 @@ class TestPackedTrainStep:
         # the ladder's HEAT_TPU_FUSION=0 A/B leg must still exercise the
         # packed path asserted here (the legacy route needs vma tracking
         # and would skip/fail on this jax) — same override discipline as
-        # test_fusion.py
+        # test_fusion.py. Quant pinned OFF symmetrically: this class pins
+        # the EXACT packed plan (dense-reference parity at 2e-3, exactly
+        # one all-reduce) — the quantized forms of the same path have
+        # their own contract in tests/test_quant_collectives.py, and the
+        # ladder's QUANT=int8 A/B leg must not turn these exact-contract
+        # assertions red
         from heat_tpu.core import fusion
 
-        with fusion.override(True), fusion.step_override(True):
+        with fusion.override(True), fusion.step_override(True), \
+                fusion.quant_override(None):
             yield
 
     @staticmethod
@@ -265,7 +271,10 @@ class TestPackedTrainStep:
             model.loss_and_grad_fn()
         assert ("loss_and_grad", False) in model._step_cache
         model.loss_and_grad_fn()
-        assert ("loss_and_grad", True) in model._step_cache
+        # the packed key carries the quant configuration (codec toggles
+        # compile siblings instead of poisoning the exact program)
+        assert ("loss_and_grad", True, fusion.quant_key()) \
+            in model._step_cache
 
 
 class TestMoE:
