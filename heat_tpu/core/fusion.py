@@ -173,6 +173,49 @@ today's behavior. Counters: ``op_engine.quant_collectives`` /
 ``quant_fallbacks``. Error contract and the when-not-to table live in
 ``doc/fusion.md``.
 
+Chunked, double-buffered packed collectives (software pipelining)
+-----------------------------------------------------------------
+``HEAT_TPU_FUSION_CHUNKS=N`` (default 1 = off) splits every packed
+collective payload this engine emits — the flush body's phase-barrier
+packing and every :func:`packed_psum` call site — into up to N contiguous
+pipeline chunks, each a separate collective, chained with
+``lax.optimization_barrier`` so at most TWO chunks are ever in flight
+(double buffering): chunk k's reduce-scatter/all-gather legs can cross
+the wire while chunk k-1's combine and consumer compute runs — the
+pipelined form of the generalized-allreduce decomposition
+(arXiv:2004.09362; the PR 9 int8 exchange is already structured as
+RS→combine→AG legs that chunk naturally). Chunk boundaries are
+block-aligned per codec (exact/bf16: the communicating group size; int8:
+``primary_axis × HEAT_TPU_QUANT_BLOCK`` so no scale block ever spans a
+chunk), which makes the N-chunk emission VALUE-BITWISE-equal to the
+unchunked plan per codec and keeps total wire bytes identical (the
+``hlo_audit.collective_bytes`` ring model sums per chunk to the
+whole-payload figure — tail chunks are never double-charged for
+alignment padding). Payloads below ``HEAT_TPU_FUSION_CHUNK_MIN_NUMEL``
+(default 4096 elements) stay unchunked: small collectives are
+latency-bound and extra legs only add dispatches. The chunk
+configuration (:func:`chunk_key`) joins the flush program key and every
+model-level step cache next to :func:`quant_key`, so toggling N compiles
+SIBLINGS and ``HEAT_TPU_FUSION_CHUNKS=1`` is bitwise (and
+program-identical to) today's behavior. Counters:
+``op_engine.chunk_collectives`` / ``chunk_fallbacks``; fault site
+``fusion.chunk.dispatch`` degrades to the unchunked packed collective.
+
+Asynchronous train-step dispatch
+--------------------------------
+``trace_step(fn, donate_argnums, block=False)`` dispatches without the
+per-step host sync: on this jax, XLA DONATION of an in-flight buffer
+blocks the dispatching thread until the producer step completes, so
+back-to-back donated train steps serialize the host (probed: 10 chained
+donated dispatches cost the full compute wall, 10 plain ones cost
+~0.2 ms). The ``block=False`` sibling program compiles WITHOUT XLA
+donation and instead ``delete()``-s the donated input buffers right
+after dispatch — invalidation semantics preserved (``is_deleted()``,
+use-after raises) while the dispatch queue stays asynchronous, so
+queued steps run back-to-back with the host free between them.
+:func:`sync` blocks on the outstanding async results (or on any pytree
+of arrays passed to it) — the one explicit host barrier.
+
 Opt-out: ``HEAT_TPU_FUSION=0`` (or :func:`set_enabled` at runtime).
 Counters: ``op_engine.fusion_flushes``, ``op_engine.fusion_ops`` (their
 ratio is the ops-per-flush figure in ``ht.runtime_stats()``), plus the
@@ -225,6 +268,11 @@ __all__ = [
     "set_quant_codec",
     "quant_override",
     "quant_key",
+    "chunk_count",
+    "set_chunk_count",
+    "chunk_override",
+    "chunk_key",
+    "sync",
 ]
 
 
@@ -286,6 +334,16 @@ _QUANT_FLOOR = int(os.environ.get("HEAT_TPU_QUANT_MIN_NUMEL", "256"))
 # the edge of the documented 1e-2 rel-err contract where 128 leaves
 # ~15% margin (tests/test_quant_collectives.py pins the figure)
 _QUANT_BLOCK = int(os.environ.get("HEAT_TPU_QUANT_BLOCK", "128"))
+
+# pipeline-chunk count for packed collectives (1 = off, today's emission;
+# N splits every qualifying packed payload into up to N double-buffered
+# chunk collectives so chunk k's wire legs overlap chunk k-1's compute)
+_CHUNKS = int(os.environ.get("HEAT_TPU_FUSION_CHUNKS", "1"))
+# payloads below this many elements stay unchunked: a small collective is
+# latency-bound, and splitting it into N legs multiplies the latency
+# while overlapping nothing worth overlapping
+_CHUNK_FLOOR = int(os.environ.get("HEAT_TPU_FUSION_CHUNK_MIN_NUMEL",
+                                  "4096"))
 
 _PROGRAMS = None  # lazy singleton (utils imports back into core)
 
@@ -434,6 +492,51 @@ def quant_override(codec, min_numel: Optional[int] = None):
     finally:
         set_quant_codec(prev)
         _QUANT_FLOOR = prev_floor
+
+
+def chunk_count() -> int:
+    """The configured pipeline-chunk count for packed collectives
+    (``HEAT_TPU_FUSION_CHUNKS``; 1 = unchunked, today's emission)."""
+    return _CHUNKS
+
+
+def set_chunk_count(n) -> int:
+    """Select the packed-collective pipeline-chunk count at runtime;
+    returns the previous one. Cached programs stay valid — the chunk
+    configuration is part of every chunk-sensitive program key, so
+    toggling compiles siblings and toggling back re-hits."""
+    global _CHUNKS
+    prev = _CHUNKS
+    n = int(n)
+    if n < 1:
+        raise ValueError(f"HEAT_TPU_FUSION_CHUNKS={n}: expected >= 1")
+    _CHUNKS = n
+    return prev
+
+
+def chunk_key() -> Tuple:
+    """Hashable identity of the chunking configuration ``(count,
+    payload floor)`` — joins the flush program key and the model-level
+    step caches next to :func:`quant_key` so a chunk-count toggle
+    rebuilds instead of reusing a program with the wrong leg structure."""
+    return (_CHUNKS, _CHUNK_FLOOR)
+
+
+@contextlib.contextmanager
+def chunk_override(n, min_numel: Optional[int] = None):
+    """Context manager form of :func:`set_chunk_count`; ``min_numel``
+    optionally overrides the payload floor (the chunk property sweeps use
+    a low floor so small test payloads exercise the pipeline)."""
+    global _CHUNK_FLOOR
+    prev = set_chunk_count(n)
+    prev_floor = _CHUNK_FLOOR
+    if min_numel is not None:
+        _CHUNK_FLOOR = int(min_numel)
+    try:
+        yield
+    finally:
+        set_chunk_count(prev)
+        _CHUNK_FLOOR = prev_floor
 
 
 def capture_hlo(flag: bool) -> None:
@@ -1429,6 +1532,12 @@ def _flush_locked(root: _Node) -> None:
     # mismatches the selection or the program key
     qcfg = qplan[3] if qplan is not None else (None, 0, 0)
     qsel = qplan[0] if qplan is not None else frozenset()
+    # chunk selection under the same captured-key discipline: the plan
+    # fires the fault site, keys the program, and its (count, floor) is
+    # what the traced body reads — never the live globals
+    cplan = (_chunk_flush_plan(order, sm, comm, qsel, qcfg)
+             if sm is not None else None)
+    ccfg = cplan[0] if cplan is not None else (1, 0)
 
     leaf_descrs = tuple(
         (tuple(a.shape), str(a.dtype), bool(a.aval.weak_type),
@@ -1437,13 +1546,14 @@ def _flush_locked(root: _Node) -> None:
     key = (leaf_descrs, tuple(sig_nodes), out_idx, donate)
     if touching:
         qtag = qplan[3] if qplan is not None else None
+        ctag = cplan[0] if cplan is not None else None
         key = key + (("sm" if sm is not None else "gspmd"), comm.cache_key,
-                     qtag)
+                     qtag, ctag)
 
     def build():
         _faults().check("fusion.flush.compile")
         if sm is not None:
-            replay = _sm_body(plan, sm, out_idx, comm, qsel, qcfg)
+            replay = _sm_body(plan, sm, out_idx, comm, qsel, qcfg, ccfg)
             from ._compat import shard_map
 
             sched, instrs, phases, in_specs, out_specs = sm
@@ -1513,6 +1623,8 @@ def _flush_locked(root: _Node) -> None:
         # this program's collectives moved, not what compiling cost
         m.inc("op_engine.quant_collectives", qplan[1])
         m.inc("op_engine.quant_bytes_saved", qplan[2])
+    if cplan is not None:
+        m.inc("op_engine.chunk_collectives", cplan[1])
 
     for pos, res in zip(out_idx, results):
         node = order[pos]
@@ -1610,6 +1722,89 @@ def _quant_wire_bytes(numels, itemsize: int, codec: str,
     return exact, quant
 
 
+# ---------------------------------------------------------------------- #
+# chunked, double-buffered packed collectives (HEAT_TPU_FUSION_CHUNKS)   #
+# ---------------------------------------------------------------------- #
+def _chunk_bounds(total: int, n: int, align: int):
+    """``[(start, stop), ...]`` contiguous pieces of a ``total``-element
+    flat payload: up to ``n`` pieces, every boundary a multiple of
+    ``align`` (the tail piece carries any sub-``align`` remainder), sizes
+    as even as the alignment admits. ``None`` when fewer than two aligned
+    pieces exist — the caller emits the unchunked collective.
+
+    The alignment is what makes chunking VALUE- and BYTE-exact: with
+    boundaries on multiples of the communicating group size the per-chunk
+    ring-model wire bytes sum to exactly the whole-payload figure
+    (``floor((M·g + t)·c/g) == M·c + floor(t·c/g)``), and with the int8
+    codec's ``group × block`` alignment every scale block and device
+    chunk of each piece coincides with the unchunked exchange's — the
+    tail piece pays exactly the padding the unchunked payload would, so
+    the audit never double-charges it."""
+    if n <= 1 or align < 1:
+        return None
+    units = total // align
+    n = min(int(n), units)
+    if n <= 1:
+        return None
+    base, extra = divmod(units, n)
+    bounds, off = [], 0
+    for i in range(n):
+        stop = off + (base + (1 if i < extra else 0)) * align
+        if i == n - 1:
+            stop = total  # the tail carries the sub-align remainder
+        bounds.append((off, stop))
+        off = stop
+    return bounds
+
+
+def _pipe_gate(piece, prev_out):
+    """Double-buffer gate: make chunk k's input depend on chunk k-2's
+    combined output via ``lax.optimization_barrier`` (values untouched),
+    so the scheduler can hold at most TWO chunk collectives in flight —
+    chunk k issues while chunk k-1 crosses the wire and chunk k-2's
+    consumers compute. Without the gate XLA is free to launch all N legs
+    at once, which buys no overlap and N× the in-flight buffer peak."""
+    barrier = getattr(jax.lax, "optimization_barrier", None)
+    if barrier is None:  # ancient jax: ungated legs are still correct
+        return piece
+    return barrier((piece, prev_out))[0]
+
+
+def _chunked_exact(flat, axes, coll, bounds):
+    """The exact (or bf16-wire) packed collective over ``flat``, emitted
+    as one collective per ``bounds`` piece, double-buffered. Elementwise
+    reductions make each piece bitwise the matching slice of the
+    unchunked result."""
+    outs = []
+    for i, (a, b) in enumerate(bounds):
+        piece = jax.lax.slice_in_dim(flat, a, b, axis=0)
+        if i >= 2:
+            piece = _pipe_gate(piece, outs[i - 2])
+        outs.append(coll(piece, axes))
+    return jnp.concatenate(outs)
+
+
+def _quant_chunk_bounds(numels, sizes, codec, block, nchunks):
+    """Chunk boundaries for one quantized payload group (or ``None``):
+    the bf16 codec chunks the raw concatenated payload on group-size
+    boundaries like the exact path; the int8 codec chunks the
+    block-ALIGNED payload (:func:`_quant_payload_numel`) on
+    ``primary_axis_size × block`` boundaries, so every piece's device
+    chunks and scale blocks coincide with the unchunked exchange's."""
+    if nchunks <= 1:
+        return None
+    group = 1
+    for s in sizes:
+        group *= s
+    if codec == "int8":
+        total = _quant_payload_numel(numels, codec, block)
+        align = max(sizes) * block
+    else:
+        total = sum(numels)
+        align = group
+    return _chunk_bounds(total, nchunks, align)
+
+
 def _wire_u16(x):
     """bf16 -> u16 bitcast for float wire legs: XLA:CPU's float
     normalization upcasts bf16 collectives back to f32 (probed on this
@@ -1680,12 +1875,15 @@ def _quant_int8_allreduce(flat, primary, size, rest, block):
     return out.astype(dt)
 
 
-def _quant_allreduce_parts(parts, axes, sizes, codec, block):
+def _quant_allreduce_parts(parts, axes, sizes, codec, block, bounds=None):
     """Quantized all-reduce of mutually independent same-dtype shard-local
     summands: flatten-concat (the int8 codec block-ALIGNS each part —
     see :func:`_quant_payload_numel`), one quantized exchange, unpack.
     The int8 exchange runs over the LARGEST axis (best chunking) with any
-    remaining axes combined exactly on the already-reduced chunks."""
+    remaining axes combined exactly on the already-reduced chunks.
+    ``bounds`` (:func:`_quant_chunk_bounds`) splits the exchange into
+    double-buffered pipeline chunks — per-codec block alignment makes the
+    chunked exchange bitwise the unchunked one."""
     if codec == "int8":
         flats = []
         for p in parts:
@@ -1696,12 +1894,24 @@ def _quant_allreduce_parts(parts, axes, sizes, codec, block):
         k = max(range(len(axes)), key=lambda i: sizes[i])
         rest = tuple(a for i, a in enumerate(axes)
                      if i != k and sizes[i] > 1)
-        comb = _quant_int8_allreduce(flat, axes[k], sizes[k], rest, block)
+        if bounds is None:
+            comb = _quant_int8_allreduce(flat, axes[k], sizes[k], rest,
+                                         block)
+        else:
+            def int8_leg(piece, _axes):
+                return _quant_int8_allreduce(piece, axes[k], sizes[k],
+                                             rest, block)
+
+            comb = _chunked_exact(flat, None, int8_leg, bounds)
         stride = block
     else:
         flat = parts[0].reshape(-1) if len(parts) == 1 else \
             jnp.concatenate([p.reshape(-1) for p in parts])
-        comb = _quant_bf16_allreduce(flat, tuple(axes))
+        if bounds is None:
+            comb = _quant_bf16_allreduce(flat, tuple(axes))
+        else:
+            comb = _chunked_exact(flat, tuple(axes), _quant_bf16_allreduce,
+                                  bounds)
         stride = 1
     out, off = [], 0
     for p in parts:
@@ -1717,17 +1927,23 @@ def reset_qinfo(qinfo: dict) -> None:
     across retraces) by the time any dispatch completes."""
     qinfo["collectives"] = 0
     qinfo["bytes_saved"] = 0
+    qinfo["chunk_collectives"] = 0
 
 
 def tick_quant(qinfo: dict) -> None:
-    """Tick ``op_engine.quant_collectives`` / ``quant_bytes_saved`` from
-    a trace-time ``packed_psum`` accounting dict — call once per DISPATCH
-    of the program whose body filled it (the model-level step wrappers and
-    DASO's capture do; the flush path ticks from its static plan)."""
+    """Tick ``op_engine.quant_collectives`` / ``quant_bytes_saved`` (and
+    ``op_engine.chunk_collectives`` for chunk-pipelined payload groups)
+    from a trace-time ``packed_psum`` accounting dict — call once per
+    DISPATCH of the program whose body filled it (the model-level step
+    wrappers and DASO's capture do; the flush path ticks from its static
+    plan)."""
     if qinfo.get("collectives"):
         m = _metrics()
         m.inc("op_engine.quant_collectives", qinfo["collectives"])
         m.inc("op_engine.quant_bytes_saved", qinfo["bytes_saved"])
+    if qinfo.get("chunk_collectives"):
+        _metrics().inc("op_engine.chunk_collectives",
+                       qinfo["chunk_collectives"])
 
 
 def _quant_flush_plan(order, sm, comm):
@@ -1779,6 +1995,59 @@ def _quant_flush_plan(order, sm, comm):
         _metrics().inc("op_engine.quant_fallbacks")
         return None
     return frozenset(sel), n, saved, qkey
+
+
+def _chunk_flush_plan(order, sm, comm, qsel, qcfg):
+    """Static chunk selection for one shard_map flush: ``(ckey,
+    n_groups)`` — the :func:`chunk_key` captured AT PLANNING TIME (a
+    concurrent ``set_chunk_count`` between planning and the deferred jit
+    trace must not change the leg structure out from under the program
+    key) and the number of packed payload groups the body will emit
+    chunked (ticked per dispatch as ``op_engine.chunk_collectives``) —
+    or None when nothing qualifies. Mirrors ``emit_all``'s grouping and
+    its quant split exactly (same (phase, kind, dtype) keys, same
+    payload-floor and alignment predicates over the same static shapes),
+    so the selection, the program key and the traced body agree by
+    construction. The ``fusion.chunk.dispatch`` fault site fires here,
+    once per intended chunk leg: a fault degrades the WHOLE flush to the
+    unchunked packed emission — keyed as such, so it HITS any cached
+    unchunked program — counted in ``op_engine.chunk_fallbacks``."""
+    ckey = chunk_key()  # one coherent read of the chunk configuration
+    cn, cfloor = ckey
+    if cn <= 1 or comm.size < 2:
+        return None
+    sched, instrs, phases, _, _ = sm
+    groups: Dict[Tuple, list] = {}
+    for pos in sched:
+        ins = instrs[pos]
+        if ins[0] not in ("reduce", "contract") or ins[1] is None:
+            continue
+        dt = jnp.dtype(order[pos].aval.dtype)
+        groups.setdefault((phases[pos], ins[1], str(dt)), []).append(pos)
+    chunked = 0
+    for (_ph, _kind, _dt), members in groups.items():
+        qm = [p for p in members if p in qsel]
+        rest = [p for p in members if p not in qsel]
+        if qm:
+            numels = [_numel(order[p].aval.shape) for p in qm]
+            if sum(numels) >= cfloor and _quant_chunk_bounds(
+                    numels, (comm.size,), qcfg[0], qcfg[2],
+                    cn) is not None:
+                chunked += 1
+        if rest:
+            total = sum(_numel(order[p].aval.shape) for p in rest)
+            if total >= cfloor and _chunk_bounds(
+                    total, cn, comm.size) is not None:
+                chunked += 1
+    if not chunked:
+        return None
+    try:
+        for _ in range(cn):  # the site fires per intended chunk leg
+            _faults().check("fusion.chunk.dispatch")
+    except Exception:
+        _metrics().inc("op_engine.chunk_fallbacks")
+        return None
+    return ckey, chunked
 
 
 def _plan_sm(order, plan, leaves, leaf_splits, out_idx, comm):
@@ -1935,7 +2204,7 @@ def _plan_sm(order, plan, leaves, leaf_splits, out_idx, comm):
 
 
 def _sm_body(plan, sm, out_idx, comm, qsel=frozenset(),
-             qcfg=(None, 0, 0)):
+             qcfg=(None, 0, 0), ccfg=(1, 0)):
     """The shard_map replay body for a :func:`_plan_sm` plan: every value
     is a shard-local block (replicated values are full arrays), reduce
     partials accumulate per phase and combine in ONE flattened collective
@@ -1943,10 +2212,15 @@ def _sm_body(plan, sm, out_idx, comm, qsel=frozenset(),
     (:func:`_quant_flush_plan`) route through the quantized exchange for
     the CAPTURED ``qcfg = (codec, floor, block)`` instead (never the live
     globals — the trace may run after a toggle); sub-floor members of the
-    same group keep the exact flattened psum alongside."""
+    same group keep the exact flattened psum alongside. ``ccfg = (count,
+    floor)`` (:func:`_chunk_flush_plan`'s captured :func:`chunk_key`)
+    splits qualifying payload groups into double-buffered pipeline chunk
+    collectives — same floor/alignment predicates as the plan, so the
+    body emits exactly the leg structure the plan counted and keyed."""
     sched, instrs, phases, _, _ = sm
     axn = comm.axis_name
     size = comm.size
+    cn, cfloor = ccfg
     # lazy (utils/core cycle): the resplit branch reuses the planner's
     # pad helper so the blockwise translation shares its one source
     from . import resharding
@@ -1966,20 +2240,29 @@ def _sm_body(plan, sm, out_idx, comm, qsel=frozenset(),
                 if qsel:
                     qm = [p2 for p2 in members if p2 in qsel]
                     if qm:
+                        numels = [_numel(vals[p2].shape) for p2 in qm]
+                        bounds = None
+                        if cn > 1 and sum(numels) >= cfloor:
+                            bounds = _quant_chunk_bounds(
+                                numels, (size,), qcfg[0], qcfg[2], cn)
                         for p2, v in zip(qm, _quant_allreduce_parts(
                                 [vals[p2] for p2 in qm], (axn,), (size,),
-                                qcfg[0], qcfg[2])):
+                                qcfg[0], qcfg[2], bounds=bounds)):
                             vals[p2] = v
                         members = [p2 for p2 in members if p2 not in qsel]
                         if not members:
                             continue
-                if len(members) == 1:
+                total = sum(_numel(vals[p2].shape) for p2 in members)
+                bounds = (_chunk_bounds(total, cn, size)
+                          if cn > 1 and total >= cfloor else None)
+                if bounds is None and len(members) == 1:
                     p2 = members[0]
                     vals[p2] = coll(vals[p2], axn)
                     continue
                 packed = jnp.concatenate([vals[p2].reshape(-1)
                                           for p2 in members])
-                combined = coll(packed, axn)
+                combined = (coll(packed, axn) if bounds is None
+                            else _chunked_exact(packed, axn, coll, bounds))
                 off = 0
                 for p2 in members:
                     shp = vals[p2].shape
@@ -2133,7 +2416,8 @@ def _is_arr(x) -> bool:
 
 
 def packed_psum(values, axes, qinfo: Optional[dict] = None,
-                quant: Optional[Tuple] = None):
+                quant: Optional[Tuple] = None,
+                chunks: Optional[Tuple] = None):
     """ONE flattened all-reduce per dtype over mesh ``axes`` for a list of
     mutually independent shard-local partials — the train-step form of the
     flush body's phase-barrier packing (``_sm_body.emit_all``; the
@@ -2157,7 +2441,12 @@ def packed_psum(values, axes, qinfo: Optional[dict] = None,
     lazily at first dispatch, and a codec toggle in between must not
     produce a program whose wire format contradicts its cache key; when
     None (direct in-body use) the live configuration is read at trace
-    time."""
+    time. ``chunks`` pins the :func:`chunk_key` tuple the same way: under
+    ``HEAT_TPU_FUSION_CHUNKS=N`` every payload group at/above the chunk
+    floor splits into up to N double-buffered pipeline chunk collectives
+    (per-codec block-aligned boundaries — bitwise the unchunked packing);
+    the ``fusion.chunk.dispatch`` fault site degrades the call to the
+    unchunked emission, counted in ``op_engine.chunk_fallbacks``."""
     values = list(values)
     if not axes:
         return values
@@ -2167,22 +2456,45 @@ def packed_psum(values, axes, qinfo: Optional[dict] = None,
         groups.setdefault(jnp.dtype(v.dtype), []).append(i)
     out = list(values)
     codec, floor, block = quant if quant is not None else quant_key()
+    cn, cfloor = chunks if chunks is not None else chunk_key()
     sizes, group_size = (), 1
     quant_ok = codec is not None
-    if quant_ok:
+    if quant_ok or cn > 1:
         # lax.psum of a python int is STATIC (the axis-size idiom):
-        # sizes are concrete here, usable for the int8 chunking. Only
-        # computed when a codec is armed — the exact path is untouched
+        # sizes are concrete here, usable for the int8/pipeline chunking.
+        # Only computed when a codec or chunking is armed — the exact
+        # unchunked path is untouched
         sizes = tuple(jax.lax.psum(1, a) for a in axes)
         for s in sizes:
             group_size *= s
-        quant_ok = group_size > 1
+        quant_ok = quant_ok and group_size > 1
     if quant_ok:
         try:
             _faults().check("fusion.quant.encode")
         except Exception:
             _metrics().inc("op_engine.quant_fallbacks")
             quant_ok = False
+    chunk_state = {"ok": cn > 1 and group_size > 1, "checked": False}
+
+    def chunk_gate(bounds):
+        """Arm the ``fusion.chunk.dispatch`` site on the FIRST payload
+        group that actually qualifies (once per intended chunk leg,
+        matching ``_chunk_flush_plan``): a call whose payloads all stay
+        unchunked never fires the site nor ticks the fallback counter.
+        A raise degrades the WHOLE call to the unchunked emission."""
+        if bounds is None or not chunk_state["ok"]:
+            return None
+        if not chunk_state["checked"]:
+            chunk_state["checked"] = True
+            try:
+                for _ in range(cn):
+                    _faults().check("fusion.chunk.dispatch")
+            except Exception:
+                _metrics().inc("op_engine.chunk_fallbacks")
+                chunk_state["ok"] = False
+                return None
+        return bounds
+
     for _dt, members in groups.items():
         dt = jnp.dtype(_dt)
         qm = []
@@ -2190,26 +2502,42 @@ def packed_psum(values, axes, qinfo: Optional[dict] = None,
             qm = [i for i in members
                   if _numel(values[i].shape) >= floor]
         if qm:
+            numels = [_numel(values[i].shape) for i in qm]
+            bounds = None
+            if chunk_state["ok"] and sum(numels) >= cfloor:
+                bounds = chunk_gate(_quant_chunk_bounds(
+                    numels, sizes, codec, block, cn))
             for i, v in zip(qm, _quant_allreduce_parts(
-                    [values[i] for i in qm], axes, sizes, codec, block)):
+                    [values[i] for i in qm], axes, sizes, codec, block,
+                    bounds=bounds)):
                 out[i] = v
             if qinfo is not None:
-                e, q = _quant_wire_bytes(
-                    [_numel(values[i].shape) for i in qm], dt.itemsize,
-                    codec, sizes, block)
+                e, q = _quant_wire_bytes(numels, dt.itemsize,
+                                         codec, sizes, block)
                 qinfo["collectives"] = qinfo.get("collectives", 0) + 1
                 qinfo["bytes_saved"] = (qinfo.get("bytes_saved", 0)
                                         + max(0, e - q))
+                if bounds is not None:
+                    qinfo["chunk_collectives"] = \
+                        qinfo.get("chunk_collectives", 0) + 1
             qset = set(qm)
             members = [i for i in members if i not in qset]
             if not members:
                 continue
-        if len(members) == 1:
+        total = sum(_numel(values[i].shape) for i in members)
+        bounds = (chunk_gate(_chunk_bounds(total, cn, group_size))
+                  if chunk_state["ok"] and total >= cfloor else None)
+        if bounds is None and len(members) == 1:
             i = members[0]
             out[i] = jax.lax.psum(values[i], axes)
             continue
         packed = jnp.concatenate([values[i].reshape(-1) for i in members])
-        combined = jax.lax.psum(packed, axes)
+        combined = (jax.lax.psum(packed, axes) if bounds is None
+                    else _chunked_exact(packed, axes, jax.lax.psum,
+                                        bounds))
+        if bounds is not None and qinfo is not None:
+            qinfo["chunk_collectives"] = \
+                qinfo.get("chunk_collectives", 0) + 1
         off = 0
         for i in members:
             n = 1
@@ -2357,13 +2685,60 @@ def grad(fun, argnums=0, has_aux=False):
 
 class _StepRecord:
     """One compiled traced step: the jitted pure function plus the output
-    rebuild metadata captured during its first trace."""
+    rebuild metadata captured during its first trace. ``delete_slots``
+    (async siblings only) are the dynamic-argument slots whose buffers
+    the wrapper invalidates by hand after each dispatch — the
+    donation-semantics half of the ``block=False`` contract."""
 
-    __slots__ = ("jitted", "out_meta")
+    __slots__ = ("jitted", "out_meta", "delete_slots")
 
-    def __init__(self, jitted):
+    def __init__(self, jitted, delete_slots=()):
         self.jitted = jitted
         self.out_meta = None
+        self.delete_slots = tuple(delete_slots)
+
+
+# outstanding async trace_step results, for the no-argument sync():
+# device execution is FIFO per dispatch order, so a bounded recent window
+# is enough — blocking the newest results implies the older ones
+# finished. The window is deliberately SMALL: each entry pins its step's
+# output buffers (a full parameter tree for a train step) until sync()
+# or eviction, and 8 steps of lookback already covers every in-flight
+# execution a double-buffered device queue can hold
+_ASYNC_LOCK = threading.Lock()
+_ASYNC_PENDING: list = []
+_ASYNC_PENDING_CAP = 8
+
+
+def _note_async(results) -> None:
+    with _ASYNC_LOCK:
+        _ASYNC_PENDING.append(tuple(results))
+        if len(_ASYNC_PENDING) > _ASYNC_PENDING_CAP:
+            del _ASYNC_PENDING[:-_ASYNC_PENDING_CAP]
+
+
+def sync(*trees) -> None:
+    """The explicit host barrier of the async-dispatch path. With
+    arguments, block until every ``DNDarray`` / jax-array leaf of the
+    given pytrees is computed; with none, block on all outstanding
+    ``block=False`` :func:`trace_step` results (then forget them). Call
+    it before reading wall-clock time, checkpointing to host, or exiting
+    a training loop that queued steps asynchronously."""
+    if trees:
+        for t in trees:
+            for leaf in jax.tree_util.tree_leaves(t, is_leaf=_isdnd):
+                if _isdnd(leaf):
+                    jax.block_until_ready(leaf.larray)
+                elif isinstance(leaf, jnp.ndarray):
+                    jax.block_until_ready(leaf)
+        return
+    with _ASYNC_LOCK:
+        pending = list(_ASYNC_PENDING)
+        del _ASYNC_PENDING[:]
+    for res in pending:
+        for a in res:
+            if not getattr(a, "is_deleted", lambda: False)():
+                jax.block_until_ready(a)
 
 
 class _TracedStep:
@@ -2372,10 +2747,19 @@ class _TracedStep:
     :func:`program_cache` (steady-state repeat calls are a key lookup and
     one donated program dispatch — zero host round-trips)."""
 
-    def __init__(self, fn, donate_argnums=()):
+    def __init__(self, fn, donate_argnums=(), block=True):
         self.fn = fn
         self.donate_argnums = tuple(sorted(set(int(i)
                                                for i in donate_argnums)))
+        # block=False: the async-dispatch sibling. XLA donation of an
+        # in-flight buffer BLOCKS the dispatching thread until the
+        # producer completes (probed on this jax — chained donated
+        # dispatches serialize the host), so the async program compiles
+        # WITHOUT donate_argnums and the wrapper delete()s the donated
+        # input buffers after dispatch instead: invalidation semantics
+        # preserved, dispatch queue asynchronous. fusion.sync() is the
+        # explicit barrier.
+        self.block = bool(block)
         # signatures whose first call failed to trace/compile: those
         # stay eager. PER-SIGNATURE, not per-fn — one oversized batch
         # failing to compile must not un-fuse the signatures already
@@ -2393,7 +2777,13 @@ class _TracedStep:
         except _Untraceable:
             _metrics().inc("op_engine.fusion_step_fallbacks")
             return self.fn(*args, **kwargs)
-        key = ("step", self.fn, treedef, tuple(sig), self.donate_argnums)
+        # quant/chunk keys ride along: a step body may call packed_psum
+        # directly (trace-time config read), and a config toggle must
+        # compile a SIBLING instead of reusing a program traced under the
+        # other wire format / leg structure — the same discipline as the
+        # flush key's qtag/ctag
+        key = ("step", self.fn, treedef, tuple(sig), self.donate_argnums,
+               self.block, quant_key(), chunk_key())
         if key in self._eager_keys:
             _metrics().inc("op_engine.fusion_step_fallbacks")
             return self.fn(*args, **kwargs)
@@ -2419,6 +2809,19 @@ class _TracedStep:
             self._eager_keys.add(key)
             _metrics().inc("op_engine.fusion_step_fallbacks")
             return self.fn(*args, **kwargs)
+        if not self.block:
+            # the async sibling's manual donation: invalidate the donated
+            # input buffers now that the (non-donating) dispatch holds its
+            # own references — use-after raises exactly like XLA donation.
+            # Passthrough outputs are fresh buffers on this backend
+            # (probed), but an identity guard keeps a future aliasing
+            # backend from deleting its own result
+            out_ids = {id(r) for r in results}
+            for slot in record.delete_slots:
+                a = phys[slot]
+                if id(a) not in out_ids and not a.is_deleted():
+                    a.delete()
+            _note_async(results)
         _metrics().inc("op_engine.fusion_step_flushes")
         # out_meta is always set by the time jitted() returns: compiling
         # needs the jaxpr, the jaxpr needs pure() to complete, and pure()
@@ -2500,7 +2903,13 @@ class _TracedStep:
             return tuple(oarrs)
 
         donate = self._donate_slots(args, metas)
-        record[0] = _StepRecord(jax.jit(pure, donate_argnums=donate))
+        if self.block:
+            record[0] = _StepRecord(jax.jit(pure, donate_argnums=donate))
+        else:
+            # async sibling: no XLA donation (donating an in-flight
+            # buffer blocks the dispatching thread on this jax) — the
+            # wrapper invalidates these slots by hand after dispatch
+            record[0] = _StepRecord(jax.jit(pure), delete_slots=donate)
         return record[0]
 
     def _donate_slots(self, args, metas):
@@ -2529,10 +2938,20 @@ class _TracedStep:
         return tuple(out)
 
 
-def trace_step(fn, donate_argnums=()):
+def trace_step(fn, donate_argnums=(), block=True):
     """Compile a whole (functional) train step over ``DNDarray`` / jax
     pytrees as ONE cached executable — loss, backward and optimizer
     update in a single program with donated state.
+
+    ``block=False`` selects ASYNC dispatch: repeat calls return
+    device-resident results without a host sync, so back-to-back train
+    steps queue on the device and the host never sits between steps (XLA
+    donation of an in-flight buffer blocks the dispatching thread on
+    this jax — the async sibling program skips XLA donation and
+    invalidates the donated input buffers by hand instead, preserving
+    the use-after-donation contract). Read results through
+    :func:`sync` (or any materialization) when you actually need the
+    values; queued steps are bitwise the synchronous ones.
 
     ``fn`` must be functional: pytrees in, pytrees out, no host-side
     value inspection (``float()``, ``.numpy()``, value-dependent
@@ -2552,7 +2971,7 @@ def trace_step(fn, donate_argnums=()):
     Escape hatch: ``HEAT_TPU_FUSION_STEP=0`` (or
     :func:`step_override`) runs every wrapped step eagerly.
     """
-    return _TracedStep(fn, donate_argnums)
+    return _TracedStep(fn, donate_argnums, block=block)
 
 
 # ---------------------------------------------------------------------- #
@@ -2592,6 +3011,10 @@ def stats() -> dict:
         "quant_collectives": int(c.get("op_engine.quant_collectives", 0)),
         "quant_bytes_saved": int(c.get("op_engine.quant_bytes_saved", 0)),
         "quant_fallbacks": int(c.get("op_engine.quant_fallbacks", 0)),
+        "chunk_count": _CHUNKS,
+        "chunk_min_numel": _CHUNK_FLOOR,
+        "chunk_collectives": int(c.get("op_engine.chunk_collectives", 0)),
+        "chunk_fallbacks": int(c.get("op_engine.chunk_fallbacks", 0)),
         "program_cache": program_cache().stats(),
     }
 

@@ -80,10 +80,11 @@ class DataParallel:
         self.seed = seed
         self.params = None
         self._train_step = None
-        # fusion.quant_key() -> (packed step, its trace-time qinfo dict):
-        # codec toggles compile SIBLINGS and toggle-back re-hits the
-        # cached exact program (same discipline as TransformerLM's
-        # _step_cache; the key space is the handful of codec configs)
+        # (fusion.quant_key(), fusion.chunk_key()) -> (packed step, its
+        # trace-time qinfo dict): codec/chunk toggles compile SIBLINGS
+        # and toggle-back re-hits the cached exact/unchunked program
+        # (same discipline as TransformerLM's _step_cache; the key space
+        # is the handful of codec × chunk configs)
         self._packed_steps = {}
         if loss_is_batch_mean is None:
             loss_is_batch_mean = loss_fn is None  # default CE is a mean
@@ -137,7 +138,7 @@ class DataParallel:
 
         return jax.jit(train_step, donate_argnums=(0, 1))
 
-    def _build_packed_train_step(self, quant=None):
+    def _build_packed_train_step(self, quant=None, chunks=None):
         """The packed-collective form of the train step: one ``shard_map``
         program computing each device's gradients on its LOCAL batch shard
         and combining every parameter cotangent — and the loss — in ONE
@@ -162,6 +163,8 @@ class DataParallel:
         qinfo = {}
         if quant is None:
             quant = fusion.quant_key()
+        if chunks is None:
+            chunks = fusion.chunk_key()
 
         def body(params, opt_state, bx, by):
             # reset-then-accumulate runs once per trace; step() reads the
@@ -174,7 +177,8 @@ class DataParallel:
             lval, grads = jax.value_and_grad(local_loss)(params)
             leaves, treedef = jax.tree_util.tree_flatten(grads)
             packed = fusion.packed_psum(leaves + [lval], (axis,),
-                                        qinfo=qinfo, quant=quant)
+                                        qinfo=qinfo, quant=quant,
+                                        chunks=chunks)
             grads = jax.tree_util.tree_unflatten(
                 treedef, [g / p for g in packed[:-1]])
             updates, opt_state = tx.update(grads, opt_state, params)
@@ -204,13 +208,14 @@ class DataParallel:
         if (fusion.step_enabled() and self.loss_is_batch_mean and size > 1
                 and bx.ndim >= 1 and bx.shape[0] % size == 0
                 and by.shape[:1] == bx.shape[:1]):
-            qk = fusion.quant_key()
-            if qk not in self._packed_steps:
-                # the KEY's tuple is also the traced wire config (jax
-                # traces at first dispatch; a toggle in between must not
-                # change the program out from under its key)
-                self._packed_steps[qk] = self._build_packed_train_step(qk)
-            return self._packed_steps[qk][0]
+            key = (fusion.quant_key(), fusion.chunk_key())
+            if key not in self._packed_steps:
+                # the KEY's tuples are also the traced wire/leg config
+                # (jax traces at first dispatch; a toggle in between must
+                # not change the program out from under its key)
+                self._packed_steps[key] = \
+                    self._build_packed_train_step(*key)
+            return self._packed_steps[key][0]
         if self._train_step is None:
             self._train_step = self._build_train_step()
         return self._train_step

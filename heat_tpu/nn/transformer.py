@@ -461,7 +461,8 @@ class TransformerLM:
         return tuple(a for a, n in (("dp", self.dp), ("sp", self.sp))
                      if n > 1)
 
-    def _packed_loss_and_grad_body(self, qinfo=None, quant=None):
+    def _packed_loss_and_grad_body(self, qinfo=None, quant=None,
+                                   chunks=None):
         """Per-device (params, toks) -> (loss, grads) with every gradient
         cotangent — and the loss — combined in ONE flattened all-reduce:
         local value_and_grad of the device's loss share, then
@@ -472,9 +473,10 @@ class TransformerLM:
         payloads ride the quantized exchange (the scalar loss is below
         the size floor and stays exact); ``qinfo`` collects the rewrite
         counts at trace time for the step wrapper's counters; ``quant``
-        pins the configuration the builder cache-keyed on (jax traces at
-        first dispatch — a codec toggle in between must not change the
-        traced wire format out from under the key)."""
+        and ``chunks`` pin the configurations the builder cache-keyed on
+        (jax traces at first dispatch — a codec or chunk-count toggle in
+        between must not change the traced wire format or leg structure
+        out from under the key)."""
         from ..core import fusion
 
         axes = self._batch_axes()
@@ -486,7 +488,7 @@ class TransformerLM:
                 self._local_loss_device)(params, toks)
             leaves, treedef = jax.tree_util.tree_flatten(grads)
             packed = fusion.packed_psum(leaves + [lval], axes, qinfo=qinfo,
-                                        quant=quant)
+                                        quant=quant, chunks=chunks)
             return packed[-1], jax.tree_util.tree_unflatten(
                 treedef, packed[:-1])
 
@@ -504,11 +506,13 @@ class TransformerLM:
 
         packed = self.packed_step_supported and fusion.step_enabled()
         # the quant codec changes the packed program's collective wire
-        # format, so it keys the cache — toggling compiles a sibling
-        # program instead of poisoning the exact one (the legacy key
-        # stays 2-tuple: the check_vma path never quantizes)
+        # format and the chunk count its leg structure, so both key the
+        # cache — toggling compiles a sibling program instead of
+        # poisoning the exact/unchunked one (the legacy key stays
+        # 2-tuple: the check_vma path never quantizes or chunks)
         qk = fusion.quant_key()
-        key = ("loss_and_grad", True, qk) if packed \
+        ck = fusion.chunk_key()
+        key = ("loss_and_grad", True, qk, ck) if packed \
             else ("loss_and_grad", False)
         fn = self._step_cache.get(key)
         if fn is None:
@@ -516,7 +520,8 @@ class TransformerLM:
             if packed:
                 qinfo = {}
                 sm = shard_map(
-                    self._packed_loss_and_grad_body(qinfo=qinfo, quant=qk),
+                    self._packed_loss_and_grad_body(qinfo=qinfo, quant=qk,
+                                                    chunks=ck),
                     mesh=self.grid.mesh,
                     in_specs=(specs, self._data_spec()),
                     out_specs=(P(), specs),
@@ -594,7 +599,8 @@ class TransformerLM:
             specs = self.param_specs()
             qinfo = {}
             lg_body = self._packed_loss_and_grad_body(
-                qinfo=qinfo, quant=fusion.quant_key())
+                qinfo=qinfo, quant=fusion.quant_key(),
+                chunks=fusion.chunk_key())
 
             def body(params, opt_state, toks):
                 loss, grads = lg_body(params, toks)

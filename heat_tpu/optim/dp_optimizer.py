@@ -244,9 +244,10 @@ class DASO:
         self._pending = None  # (apply_at_batch, bf16 slow-tier average)
         self._avg_fn = None
         self._blend_fn = None
-        # fusion.quant_key() -> (packed capture program, its qinfo dict):
-        # codec toggles compile siblings, toggle-back re-hits the cached
-        # exact program (same discipline as the model step caches)
+        # (fusion.quant_key(), fusion.chunk_key()) -> (packed capture
+        # program, its qinfo dict): codec/chunk toggles compile siblings,
+        # toggle-back re-hits the cached exact/unchunked program (same
+        # discipline as the model step caches)
         self._packed_avgs = {}
 
     @property
@@ -307,7 +308,7 @@ class DASO:
         self._blend_fn = jax.jit(
             lambda av, ps: jax.tree_util.tree_map(blend_leaf, av, ps))
 
-    def _build_packed_avg(self, quant=None):
+    def _build_packed_avg(self, quant=None, chunks=None):
         """The packed (and quantizable) form of the slow-tier capture: ONE
         ``shard_map`` over the ``"dcn"`` axis combining EVERY leaf's bf16
         wire average in a single flattened collective
@@ -326,6 +327,8 @@ class DASO:
         qinfo = {}
         if quant is None:
             quant = fusion.quant_key()
+        if chunks is None:
+            chunks = fusion.chunk_key()
 
         def body(params):
             fusion.reset_qinfo(qinfo)
@@ -333,7 +336,7 @@ class DASO:
             # local block is (1, ...): this device's replica in wire dtype
             parts = [l[0].astype(cast) for l in leaves]
             packed = fusion.packed_psum(parts, ("dcn",), qinfo=qinfo,
-                                        quant=quant)
+                                        quant=quant, chunks=chunks)
             return jax.tree_util.tree_unflatten(
                 treedef, [(p / slow).astype(cast) for p in packed])
 
@@ -347,18 +350,20 @@ class DASO:
         shard_map form when the fusion step engine is on and every leaf is
         floating (non-float leaves need the legacy replica-0 pick), else
         the historic per-leaf jitted mean. Keyed on
-        :func:`heat_tpu.core.fusion.quant_key` so a codec toggle rebuilds
-        instead of dispatching a stale wire format."""
+        (:func:`heat_tpu.core.fusion.quant_key`,
+        :func:`heat_tpu.core.fusion.chunk_key`) so a codec or chunk-count
+        toggle rebuilds instead of dispatching a stale wire format or leg
+        structure."""
         from ..core import fusion
 
         if (self.slow_size > 1 and fusion.step_enabled()
                 and all(jnp.issubdtype(l.dtype, jnp.floating)
                         for l in jax.tree_util.tree_leaves(params)
                         if hasattr(l, "dtype"))):
-            qk = fusion.quant_key()
-            if qk not in self._packed_avgs:
-                self._packed_avgs[qk] = self._build_packed_avg(qk)
-            fn, qinfo = self._packed_avgs[qk]
+            key = (fusion.quant_key(), fusion.chunk_key())
+            if key not in self._packed_avgs:
+                self._packed_avgs[key] = self._build_packed_avg(*key)
+            fn, qinfo = self._packed_avgs[key]
             out = fn(params)
             fusion.tick_quant(qinfo)
             return out

@@ -97,6 +97,13 @@ SITES: Dict[str, tuple] = {
         "quantized-collective encode planning (flush packing and "
         "packed_psum) — falls back to the exact collective, counted in "
         "op_engine.quant_fallbacks"),
+    "fusion.chunk.dispatch": (
+        FaultInjected,
+        "chunked packed-collective leg planning (fires once per intended "
+        "chunk leg, flush plan and packed_psum) — degrades to the "
+        "UNCHUNKED packed collective (for flushes via the cache key, "
+        "hitting any cached unchunked program), counted in "
+        "op_engine.chunk_fallbacks"),
     # reshard planner (core/resharding.py)
     "reshard.plan.build": (
         FaultInjected,

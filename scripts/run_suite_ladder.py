@@ -212,6 +212,28 @@ def run_fusion_ab(n: int, timeout: float) -> dict:
                        _FUSION_AB_TESTS, n, timeout)
 
 
+# chunk-pipelined collectives gate: the training-heavy subset (the paths
+# whose packed collectives chunk) + the chunk contract module itself; the
+# HEAT_TPU_LADDER_STATS log carries chunk_collectives/chunk_fallbacks so
+# the A/B shows which tests actually dispatched chunked legs
+_CHUNK_AB_TESTS = [
+    "tests/test_trace_step.py", "tests/test_transformer.py",
+    "tests/test_nn_optim_data.py", "tests/test_chunk_collectives.py",
+]
+
+
+def run_chunk_ab(n: int, timeout: float) -> dict:
+    """``HEAT_TPU_FUSION_CHUNKS=1`` vs ``4`` on the training-heavy
+    subset: the chunked leg must keep every packed-step test green
+    (chunking may never change WHICH path runs or its values — the
+    N-chunk emission is value-bitwise the unchunked plan per codec), and
+    the CHUNKS=1 leg proves the default is bitwise today's behavior —
+    exit-gating, like the fusion/quant A/Bs."""
+    return _run_env_ab("HEAT_TPU_FUSION_CHUNKS",
+                       (("unchunked", "1"), ("chunked", "4")),
+                       _CHUNK_AB_TESTS, n, timeout)
+
+
 _CHAOS_SITE_RE = re.compile(
     r"test_chaos_site\[([^\]]+)\]\s+(PASSED|FAILED|ERROR|SKIPPED)")
 
@@ -311,6 +333,13 @@ def main():
     ap.add_argument("--no-quant-ab", dest="quant_ab", action="store_false",
                     help="skip the quantized-collective A/B")
     ap.add_argument("--quant-ab-timeout", type=float, default=900.0)
+    ap.add_argument("--chunk-ab", dest="chunk_ab", action="store_true",
+                    default=True,
+                    help="run the HEAT_TPU_FUSION_CHUNKS=1 vs 4 A/B on "
+                         "the training-heavy subset (default on)")
+    ap.add_argument("--no-chunk-ab", dest="chunk_ab", action="store_false",
+                    help="skip the chunked-collective A/B")
+    ap.add_argument("--chunk-ab-timeout", type=float, default=900.0)
     ap.add_argument("--serve-smoke", dest="serve_smoke", action="store_true",
                     default=True, help="run the serving smoke (default on)")
     ap.add_argument("--no-serve-smoke", dest="serve_smoke",
@@ -411,6 +440,17 @@ def main():
         quant_bad = not qab.get("agree", False)
         print(json.dumps({"quant_ab_ok": not quant_bad}), flush=True)
 
+    chunk_bad = False
+    if args.chunk_ab and not args.examples_only:
+        # chunk gate: the training-heavy subset must pass unchunked AND
+        # 4-chunked (4-device mesh) — chunking is value-exact per codec,
+        # so ANY leg disagreement is a leg-structure bug
+        print("=== chunk collectives A/B (4 devices) ===", flush=True)
+        cab = run_chunk_ab(4, args.chunk_ab_timeout)
+        artifact["chunk_ab"] = cab
+        chunk_bad = not cab.get("agree", False)
+        print(json.dumps({"chunk_ab_ok": not chunk_bad}), flush=True)
+
     audit_bad = False
     if not (args.no_resplit_audit or args.examples_only):
         # re-check the reshard planner's collective bounds every round:
@@ -443,7 +483,7 @@ def main():
     bad = ([r for r in ladder if r.get("rc") != 0]
            + [r for r in ex if r.get("rc") != 0])
     sys.exit(1 if bad or audit_bad or serve_bad or fusion_bad or quant_bad
-             or chaos_bad else 0)
+             or chunk_bad or chaos_bad else 0)
 
 
 if __name__ == "__main__":

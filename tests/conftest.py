@@ -115,6 +115,13 @@ def pytest_runtest_teardown(item, nextitem):
                 c.get("op_engine.quant_collectives", 0)),
             "quant_bytes_saved": int(
                 c.get("op_engine.quant_bytes_saved", 0)),
+            # chunk-pipelined packed collectives (the CHUNKS=1/4 ladder
+            # A/B reads these: which tests dispatched chunked legs, and
+            # whether any chunk plan degraded to the unchunked program)
+            "chunk_collectives": int(
+                c.get("op_engine.chunk_collectives", 0)),
+            "chunk_fallbacks": int(
+                c.get("op_engine.chunk_fallbacks", 0)),
             "zero_fills": int(c.get("op_engine.zero_fills", 0)),
             "fusion_ops": int(c.get("op_engine.fusion_ops", 0)),
             "fusion_program_compiles": int(

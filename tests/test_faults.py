@@ -43,6 +43,7 @@ FALLBACK_COUNTERS = (
     "op_engine.fusion_flush_fallbacks",
     "op_engine.fusion_step_fallbacks",
     "op_engine.quant_fallbacks",
+    "op_engine.chunk_fallbacks",
     "resharding.plan_build_fallbacks",
     "resharding.dispatch_fallbacks",
     "serve.batch_retries",
@@ -66,6 +67,7 @@ MATRIX = {
     "fusion.step.trace": ("train", "op_engine.fusion_step_fallbacks", 2),
     "fusion.step.dispatch": ("train", None, 0),
     "fusion.quant.encode": ("quant", "op_engine.quant_fallbacks", 1),
+    "fusion.chunk.dispatch": ("chunk", "op_engine.chunk_fallbacks", 1),
     "reshard.plan.build": ("resplit", "resharding.plan_build_fallbacks", 1),
     "reshard.dispatch": ("resplit", "resharding.dispatch_fallbacks", 1),
     "serve.worker.batch": ("serve", "serve.worker_backstops", 1),
@@ -160,6 +162,24 @@ def _wl_quant(tmp_path):
     return {"psum": out}, {}
 
 
+def _wl_chunk(tmp_path):
+    """A chunk-pipelined packed flush collective (CHUNKS=4 armed, low
+    floor so the modest payload qualifies): op chain into a split-axis
+    reduction whose packed psum the body splits into double-buffered
+    chunk legs. Chunking is VALUE-BITWISE-equal to the unchunked plan by
+    construction, so the fault-free chunked run and the faulted
+    unchunked fallback (degraded via the cache key) are identical — the
+    harness's allclose contract holds on both legs."""
+    fusion.reset()
+    with fusion.chunk_override(4, min_numel=8):
+        x = ht.arange(13 * 40, dtype=ht.float32, split=None)
+        x = x.reshape((13, 40)).resplit(0)
+        y = ht.exp(x * 0.001) + x * 0.5 - 1.25
+        y = y * y + 0.25
+        r = y.sum(axis=0)
+        return {"r": r.numpy()}, {}
+
+
 def _wl_resplit(tmp_path):
     """Eager planner path (fusion off so reshard() itself is exercised,
     plan cache reset so the build site is reached)."""
@@ -236,8 +256,8 @@ def _wl_init(tmp_path):
 
 
 _WORKLOADS = {"ops": _wl_ops, "train": _wl_train, "quant": _wl_quant,
-              "resplit": _wl_resplit, "serve": _wl_serve,
-              "ckpt": _wl_ckpt, "init": _wl_init}
+              "chunk": _wl_chunk, "resplit": _wl_resplit,
+              "serve": _wl_serve, "ckpt": _wl_ckpt, "init": _wl_init}
 
 _BASELINES: dict = {}  # workload name -> fault-free payload (per session)
 
@@ -274,6 +294,9 @@ def test_chaos_site(site, tmp_path):
     if site == "fusion.quant.encode" and ht.get_comm().size == 1:
         pytest.skip("single-device mesh emits no communicating psum to "
                     "quantize")
+    if site == "fusion.chunk.dispatch" and ht.get_comm().size == 1:
+        pytest.skip("single-device mesh emits no communicating psum to "
+                    "chunk")
     want = _baseline(wl_name, tmp_path)
     before = _snap()
     fires_before = _fires(site)

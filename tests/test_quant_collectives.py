@@ -604,15 +604,15 @@ class TestDataParallelQuant:
         net = self._net()
         with fusion.quant_override(None):
             net.step(X, Y)
-            exact_step = net._packed_steps[fusion.quant_key()][0]
+            exact_step = net._packed_steps[(fusion.quant_key(), fusion.chunk_key())][0]
         with fusion.quant_override("int8"):
             net.step(X, Y)
-            quant_step = net._packed_steps[fusion.quant_key()][0]
+            quant_step = net._packed_steps[(fusion.quant_key(), fusion.chunk_key())][0]
             assert quant_step is not exact_step  # sibling, not a reuse
         with fusion.quant_override(None):
             # toggle-back RE-HITS the cached exact program — no recompile
             net.step(X, Y)
-            assert net._packed_steps[fusion.quant_key()][0] is exact_step
+            assert net._packed_steps[(fusion.quant_key(), fusion.chunk_key())][0] is exact_step
         assert len(net._packed_steps) == 2
 
 

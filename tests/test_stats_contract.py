@@ -41,6 +41,8 @@ FUSION_KEYS = {
     "fused_ops", "ops_per_flush", "max_ops", "min_ops",
     "quant_codec", "quant_min_numel", "quant_collectives",
     "quant_bytes_saved", "quant_fallbacks",
+    "chunk_count", "chunk_min_numel", "chunk_collectives",
+    "chunk_fallbacks",
     "program_cache",
 }
 
@@ -80,7 +82,9 @@ def test_runtime_stats_value_types_pinned():
     rt = ht.runtime_stats()
     fu = rt["op_engine"]["fusion"]
     for k in ("flushes", "fused_ops", "step_flushes", "quant_collectives",
-              "quant_bytes_saved", "quant_fallbacks", "quant_min_numel"):
+              "quant_bytes_saved", "quant_fallbacks", "quant_min_numel",
+              "chunk_count", "chunk_min_numel", "chunk_collectives",
+              "chunk_fallbacks"):
         assert isinstance(fu[k], int), k
     assert fu["quant_codec"] in (None, "bf16", "int8")
     for k in ("enabled", "reduce_enabled", "step_enabled"):

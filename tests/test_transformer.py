@@ -152,11 +152,14 @@ class TestPackedTrainStep:
         # one all-reduce) — the quantized forms of the same path have
         # their own contract in tests/test_quant_collectives.py, and the
         # ladder's QUANT=int8 A/B leg must not turn these exact-contract
-        # assertions red
+        # assertions red. Chunking pinned to 1 for the same reason: the
+        # CHUNKS=4 A/B leg would split the ONE asserted all-reduce into
+        # chunk legs (that leg structure has its own contract in
+        # tests/test_chunk_collectives.py)
         from heat_tpu.core import fusion
 
         with fusion.override(True), fusion.step_override(True), \
-                fusion.quant_override(None):
+                fusion.quant_override(None), fusion.chunk_override(1):
             yield
 
     @staticmethod
@@ -273,7 +276,8 @@ class TestPackedTrainStep:
         model.loss_and_grad_fn()
         # the packed key carries the quant configuration (codec toggles
         # compile siblings instead of poisoning the exact program)
-        assert ("loss_and_grad", True, fusion.quant_key()) \
+        assert ("loss_and_grad", True, fusion.quant_key(),
+                fusion.chunk_key()) \
             in model._step_cache
 
 
