@@ -15,9 +15,14 @@ Usage (8 virtual devices):
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python gpt_parallel.py --grid 1,2,2,2 --steps 20
   python gpt_parallel.py --grid 2,2,2,1 --moe-experts 4   # with ep
+  python gpt_parallel.py --tiers dcn,ici --steps 20  # simulated 2-host
+      # (2, n/2) ("dcn", "ici") tier grid: the packed train step's
+      # gradient all-reduce decomposes as reduce-scatter(ici) ->
+      # all-reduce(dcn) -> all-gather(ici), HEAT_TPU_HIER
 """
 
 import argparse
+import os
 
 import numpy as np
 
@@ -47,13 +52,55 @@ def main():
     p.add_argument("--moe-experts", type=int, default=0)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--tiers", default=os.environ.get(
+        "HEAT_TPU_MESH_TIERS", ""),
+        help="declare mesh tiers (default: $HEAT_TPU_MESH_TIERS): "
+             "'dcn,ici' (or 'D,I' sizes) runs the dp grid 2-D — a "
+             "simulated 2-host (2, n/2) ('dcn','ici') split on CPU — "
+             "so the packed step's gradient all-reduce decomposes "
+             "hierarchically (RS over ici, AR over dcn, AG over ici)")
     args = p.parse_args()
 
     import optax
 
+    from heat_tpu.core import fusion
     from heat_tpu.nn.transformer import TransformerLM, TransformerLMConfig
 
-    if args.grid == "auto":
+    tiers = None
+    if args.tiers:
+        fusion.set_mesh_tiers(args.tiers)
+        tiers = fusion.mesh_tiers()
+
+    if tiers is not None and args.grid != "auto":
+        # the tier grid is dp-only by construction — silently dropping a
+        # requested pp/tp/sp layout would misreport what ran
+        raise SystemExit(
+            f"--tiers {args.tiers} builds its own (dcn, dp) grid and "
+            f"cannot honor --grid {args.grid}; pass one or the other")
+    if tiers is not None:
+        import jax
+
+        n = len(jax.devices())
+        if isinstance(tiers[0], int):
+            d, i = tiers
+            if d * i != n:
+                raise SystemExit(
+                    f"--tiers {args.tiers}: {d}x{i} != {n} devices")
+        else:
+            # name form ('dcn,ici'): simulate 2 hosts on this mesh
+            d, i = 2, n // 2
+            if n < 4 or n % 2:
+                raise SystemExit(
+                    f"--tiers {args.tiers}: needs an even mesh of >= 4 "
+                    f"devices to simulate a (2, n/2) pod, got {n}")
+        # tiered dp-only grid: dcn x dp both shard the batch, the
+        # packed-collective train step (PR 7) decomposes hierarchically
+        shape = (d, i, 1, 1, 1)
+        grid = ht.MeshGrid(shape, ("dcn",) + TransformerLM.AXES)
+        print(f"tiers {args.tiers}: simulated {d}-host x {i}-device "
+              f"('dcn', 'ici') grid — hierarchical packed collectives "
+              f"{'ON' if fusion.hier_enabled() else 'OFF (HEAT_TPU_HIER=0)'}")
+    elif args.grid == "auto":
         import jax
 
         n = len(jax.devices())
@@ -72,18 +119,19 @@ def main():
             print(f"grid auto: dp-only packed train step on {n} devices")
     else:
         shape = tuple(int(s) for s in args.grid.split(","))
-    grid = ht.MeshGrid(shape, ("dp", "pp", "tp", "sp"))
+    if tiers is None:
+        grid = ht.MeshGrid(shape, ("dp", "pp", "tp", "sp"))
     cfg = TransformerLMConfig(
         vocab=args.vocab, d_model=args.d_model, n_heads=args.heads,
         n_layers=args.layers, n_micro=args.n_micro,
         moe_experts=args.moe_experts)
     model = TransformerLM(grid, cfg)
-    print(f"grid {dict(zip(model.AXES, shape))}  layers/stage "
+    print(f"grid {dict(zip(grid.axis_names, grid.shape))}  layers/stage "
           f"{model.layers_per_stage}  heads/shard {cfg.n_heads // model.tp}")
 
     rng = np.random.default_rng(0)
-    # round the batch up so it divides dp x n_micro on any grid
-    unit = model.dp * cfg.n_micro
+    # round the batch up so it divides the dp world x n_micro on any grid
+    unit = model.dp_world * cfg.n_micro
     batch = -(-args.batch // unit) * unit
     base = np.arange(batch * args.seq_len).reshape(batch, args.seq_len)
     tokens = ((base + rng.integers(0, 2, base.shape)) % args.vocab)
@@ -103,8 +151,9 @@ def main():
     # MLP); skip the demo on pipelined / sequence-sharded / MoE configs
     if model.pp == 1 and model.sp == 1 and not cfg.moe_experts:
         # exactly dp prompt rows (tile if the training batch is smaller)
-        reps = -(-model.dp // tokens.shape[0])
-        prompt = np.tile(tokens, (reps, 1))[:model.dp, :8].astype(np.int32)
+        reps = -(-model.dp_world // tokens.shape[0])
+        prompt = np.tile(tokens, (reps, 1))[:model.dp_world,
+                                            :8].astype(np.int32)
         out = np.asarray(model.generate(params, prompt, max_new_tokens=12))
         print("prompt:   ", prompt[0].tolist())
         print("generated:", out[0, 8:].tolist())

@@ -57,6 +57,9 @@ from .pallas_kernels import pallas_enabled, set_pallas
 from . import pallas_kernels
 from . import fusion
 from .fusion import enabled as fusion_enabled, set_enabled as set_fusion
+# tier declaration for hierarchical packed collectives (ht.mesh_tiers):
+# a flat mesh's (dcn, ici) factorization or a named grid's slow axis
+from .fusion import mesh_tiers, set_mesh_tiers
 
 
 def __getattr__(name):

@@ -272,6 +272,12 @@ __all__ = [
     "set_chunk_count",
     "chunk_override",
     "chunk_key",
+    "hier_enabled",
+    "set_hier_enabled",
+    "hier_override",
+    "mesh_tiers",
+    "set_mesh_tiers",
+    "hier_key",
     "sync",
 ]
 
@@ -344,6 +350,78 @@ _CHUNKS = int(os.environ.get("HEAT_TPU_FUSION_CHUNKS", "1"))
 # while overlapping nothing worth overlapping
 _CHUNK_FLOOR = int(os.environ.get("HEAT_TPU_FUSION_CHUNK_MIN_NUMEL",
                                   "4096"))
+
+
+def _parse_tiers(val):
+    """``HEAT_TPU_MESH_TIERS`` value -> tier declaration or None.
+
+    Two declaration forms (arXiv:2004.09362's two-tier topology model):
+
+    * ``"2,4"`` (integers) — a ``(dcn, ici)`` FACTORIZATION for flat 1-D
+      meshes: the mesh's device order is dcn-major (``d`` hosts × ``i``
+      devices per host, device ``h*i + j`` = host ``h``, local slot
+      ``j``), exactly how ``jax.devices()`` orders a real multi-host pod.
+      Drives the flush path's grouped hierarchical exchange and the
+      default 2-D ``DataParallel`` grid.
+    * ``"dcn,ici"`` (names) — the axis-NAME declaration for named grids:
+      the FIRST name is the slow (DCN) tier's mesh-axis name, every other
+      axis in a reduction scope is the fast (ICI) tier. ``"dcn"`` alone
+      is equivalent (and is the built-in default: a grid that names an
+      axis ``"dcn"`` — DASO's ``MeshGrid``, a 5-axis ``TransformerLM``
+      grid — has declared its tiers by construction).
+
+    Unknown/mixed forms raise immediately: a typo'd declaration silently
+    running flat would defeat the whole DCN-byte-reduction intent."""
+    if val is None or val in ("", "0", "false", "False", "off", "none"):
+        return None
+    parts = tuple(p.strip() for p in str(val).split(",") if p.strip())
+    if not parts:
+        return None
+    if all(p.lstrip("-").isdigit() for p in parts):
+        ints = tuple(int(p) for p in parts)
+        if len(ints) != 2 or ints[0] < 1 or ints[1] < 1:
+            raise ValueError(
+                f"HEAT_TPU_MESH_TIERS={val!r}: factor form wants exactly "
+                "two positive sizes 'dcn,ici' (e.g. 2,4)")
+        return ints
+    if any(p.lstrip("-").isdigit() for p in parts):
+        raise ValueError(
+            f"HEAT_TPU_MESH_TIERS={val!r}: mix of names and sizes "
+            "(want 'dcn,ici' names or 'D,I' integer factors)")
+    return parts
+
+
+def _parse_ici_codec(val):
+    """``HEAT_TPU_HIER_ICI_CODEC`` -> ``None`` (exact) or ``"bf16"``.
+    ``int8`` is deliberately rejected for the fast tier: the ICI legs
+    include a reduce-scatter (a reduction, not pure data movement), and
+    EQuARX's tier-selective result is exactly that the cheap fast tier
+    should stay (near-)exact while the slow tier carries the aggressive
+    codec."""
+    if val is None or val in ("", "0", "false", "False", "off", "none"):
+        return None
+    if val in ("1", "bf16"):
+        return "bf16"
+    raise ValueError(
+        f"HEAT_TPU_HIER_ICI_CODEC={val!r}: expected 0, none or bf16 "
+        "(the DCN-tier codec is HEAT_TPU_QUANT_COLLECTIVES)")
+
+
+# master gate for tier-aware hierarchical packed collectives (default on;
+# inert until a mesh declares tiers — a "dcn"-named grid axis or the
+# HEAT_TPU_MESH_TIERS factorization — so the default is bitwise flat)
+_HIER = _env_on("HEAT_TPU_HIER")
+_TIERS = _parse_tiers(os.environ.get("HEAT_TPU_MESH_TIERS"))
+# fast-tier (ICI) wire codec for the hierarchical exchange's RS/AG legs
+# (None = exact; the slow-tier/DCN codec is the quant codec above)
+_HIER_ICI = _parse_ici_codec(os.environ.get("HEAT_TPU_HIER_ICI_CODEC"))
+# psum payload GROUPS below this many total elements keep the flat
+# collective: the decomposition trades one collective for three, which
+# only pays when the slow tier's bandwidth (not latency) dominates.
+# Default 0 = decompose everything — model-step gradient payloads are
+# large, and the tiny members (the packed scalar loss) ride the same
+# group as the gradients rather than paying their own legs
+_HIER_FLOOR = int(os.environ.get("HEAT_TPU_HIER_MIN_NUMEL", "0"))
 
 _PROGRAMS = None  # lazy singleton (utils imports back into core)
 
@@ -537,6 +615,90 @@ def chunk_override(n, min_numel: Optional[int] = None):
     finally:
         set_chunk_count(prev)
         _CHUNK_FLOOR = prev_floor
+
+
+def hier_enabled() -> bool:
+    """Whether tier-aware hierarchical packed collectives are on
+    (``HEAT_TPU_HIER``, default on). Inert without a tier declaration —
+    a reduction scope containing a slow-named (``"dcn"``) grid axis, or
+    a flat mesh with a declared ``HEAT_TPU_MESH_TIERS`` factorization."""
+    return _HIER
+
+
+def set_hier_enabled(flag: bool) -> bool:
+    """Toggle the hierarchical-collective extension alone; returns the
+    previous setting. Cached programs stay valid — :func:`hier_key` is
+    part of every hierarchy-sensitive program key, so toggling compiles
+    siblings and toggling back re-hits."""
+    global _HIER
+    prev = _HIER
+    _HIER = bool(flag)
+    return prev
+
+
+@contextlib.contextmanager
+def hier_override(flag: bool, tiers=_UNSET, ici_codec=_UNSET,
+                  min_numel=None):
+    """Context manager form of :func:`set_hier_enabled`; ``tiers`` /
+    ``ici_codec`` / ``min_numel`` optionally override the declaration,
+    the fast-tier codec and the payload floor for the block (the hier
+    property sweeps pin all of them). Arguments are VALIDATED before any
+    global is touched — a bad declaration raises with the configuration
+    untouched, never with a half-toggled gate leaked into later code."""
+    global _TIERS, _HIER_ICI
+    global _HIER_FLOOR
+    if tiers is not _UNSET:
+        parsed_tiers = _parse_tiers(
+            tiers if tiers is None or isinstance(tiers, str)
+            else ",".join(str(s) for s in tiers))
+    if ici_codec is not _UNSET:
+        parsed_ici = _parse_ici_codec(ici_codec)
+    if min_numel is not None:
+        min_numel = int(min_numel)
+    prev = set_hier_enabled(flag)
+    prev_tiers, prev_ici, prev_floor = _TIERS, _HIER_ICI, _HIER_FLOOR
+    try:
+        if tiers is not _UNSET:
+            _TIERS = parsed_tiers
+        if ici_codec is not _UNSET:
+            _HIER_ICI = parsed_ici
+        if min_numel is not None:
+            _HIER_FLOOR = min_numel
+        yield
+    finally:
+        set_hier_enabled(prev)
+        _TIERS, _HIER_ICI, _HIER_FLOOR = prev_tiers, prev_ici, prev_floor
+
+
+def mesh_tiers():
+    """The active tier declaration: ``None`` (undeclared), a ``(d, i)``
+    integer factorization for flat meshes, or a name tuple whose first
+    entry is the slow (DCN) axis name (``HEAT_TPU_MESH_TIERS``)."""
+    return _TIERS
+
+
+def set_mesh_tiers(spec):
+    """Declare (or clear) the mesh tier split at runtime; returns the
+    previous declaration. Accepts the env-var spellings (``None`` /
+    ``"2,4"`` / ``"dcn,ici"``) or ready tuples."""
+    global _TIERS
+    prev = _TIERS
+    if spec is None or isinstance(spec, str):
+        _TIERS = _parse_tiers(spec)
+    else:
+        _TIERS = _parse_tiers(",".join(str(s) for s in spec))
+    return prev
+
+
+def hier_key() -> Tuple:
+    """Hashable identity of the hierarchical-collective configuration
+    ``(enabled, tier declaration, ici codec, payload floor)`` — joins
+    the flush program key and every model-level step cache next to
+    :func:`quant_key` / :func:`chunk_key`, so toggling the hierarchy (or
+    re-declaring tiers) rebuilds siblings instead of reusing a program
+    with the wrong collective structure; toggling back re-hits the
+    cached sibling."""
+    return (_HIER, _TIERS, _HIER_ICI, _HIER_FLOOR)
 
 
 def capture_hlo(flag: bool) -> None:
@@ -1521,11 +1683,19 @@ def _flush_locked(root: _Node) -> None:
     # degrades to an internal recompile, never a wrong program. The
     # recorded split axes join the key because they pick the shard_map
     # in_specs; the reduce mode and comm identity key the collective form.
+    # tier-aware hierarchical decomposition (HEAT_TPU_HIER + declared
+    # HEAT_TPU_MESH_TIERS factorization): planned FIRST — the quant byte
+    # model follows the tiered legs — and captured like the quant/chunk
+    # keys below; a gate-off/undeclared/fault decision keys as None and
+    # HITS any cached flat program
+    hplan = _hier_flush_plan(order, sm, comm) if sm is not None else None
+    hcfg = hplan[0] if hplan is not None else None
     # quantized-collective selection (HEAT_TPU_QUANT_COLLECTIVES): static
     # per-flush, so the decision, the program key and the traced body all
     # agree; a fault/floor/codec-off decision keys as None and therefore
     # HITS any cached exact program instead of compiling a duplicate
-    qplan = _quant_flush_plan(order, sm, comm) if sm is not None else None
+    qplan = (_quant_flush_plan(order, sm, comm, hcfg=hcfg)
+             if sm is not None else None)
     # codec/block from the PLAN's captured key, never re-read from the
     # globals: a concurrent set_quant_codec between planning and build
     # (or the deferred jit trace) must not trace a body whose wire format
@@ -1535,7 +1705,7 @@ def _flush_locked(root: _Node) -> None:
     # chunk selection under the same captured-key discipline: the plan
     # fires the fault site, keys the program, and its (count, floor) is
     # what the traced body reads — never the live globals
-    cplan = (_chunk_flush_plan(order, sm, comm, qsel, qcfg)
+    cplan = (_chunk_flush_plan(order, sm, comm, qsel, qcfg, hcfg=hcfg)
              if sm is not None else None)
     ccfg = cplan[0] if cplan is not None else (1, 0)
 
@@ -1547,13 +1717,15 @@ def _flush_locked(root: _Node) -> None:
     if touching:
         qtag = qplan[3] if qplan is not None else None
         ctag = cplan[0] if cplan is not None else None
+        htag = hplan[1] if hplan is not None else None
         key = key + (("sm" if sm is not None else "gspmd"), comm.cache_key,
-                     qtag, ctag)
+                     qtag, ctag, htag)
 
     def build():
         _faults().check("fusion.flush.compile")
         if sm is not None:
-            replay = _sm_body(plan, sm, out_idx, comm, qsel, qcfg, ccfg)
+            replay = _sm_body(plan, sm, out_idx, comm, qsel, qcfg, ccfg,
+                              hcfg)
             from ._compat import shard_map
 
             sched, instrs, phases, in_specs, out_specs = sm
@@ -1625,6 +1797,8 @@ def _flush_locked(root: _Node) -> None:
         m.inc("op_engine.quant_bytes_saved", qplan[2])
     if cplan is not None:
         m.inc("op_engine.chunk_collectives", cplan[1])
+    if hplan is not None:
+        m.inc("op_engine.hier_collectives", hplan[2])
 
     for pos, res in zip(out_idx, results):
         node = order[pos]
@@ -1820,29 +1994,64 @@ def _unwire_u16(x):
 
 def _quant_bf16_allreduce(flat, axes):
     """The bf16 codec: ONE all-reduce with the payload rounded to bf16 —
-    EQuARX's BF16 AR. The reduction itself runs at wire precision."""
-    return jax.lax.psum(flat.astype(jnp.bfloat16), axes).astype(flat.dtype)
+    EQuARX's BF16 AR. The reduction itself runs at wire precision; the
+    downcast saturates (``_sat_bf16``) so a just-above-bf16-max payload
+    enters the wire at ±bf16max instead of inf."""
+    return jax.lax.psum(_sat_bf16(flat), axes).astype(flat.dtype)
 
 
-def _quant_int8_allreduce(flat, primary, size, rest, block):
+# largest finite bf16 value: the int8 codec's scales and combined chunks
+# travel bf16, and every downcast SATURATES into this range instead of
+# rounding to inf — a finite f32 sum just above bf16 max must round-trip
+# as the saturated value (0.3% off, inside the 1e-2 contract), never as
+# inf, and an inf block amax must not poison its scale into inf (whose
+# decode is 0*inf = NaN — the PR 10 drive gotcha, regression-pinned in
+# tests/test_quant_collectives.py)
+_BF16_MAX = 3.3895313892515355e38
+
+
+def _sat_bf16(x):
+    """Saturating f32 -> bf16 downcast (clip into finite bf16 range).
+    Identity for in-range values — the clip changes nothing below
+    ``_BF16_MAX`` — so in-range payloads stay bitwise the unclipped
+    cast. NaN propagates (clip keeps NaN): a NaN payload is the caller's
+    bug either way; only the overflow-to-inf poisoning is removed."""
+    return jnp.clip(x, -_BF16_MAX, _BF16_MAX).astype(jnp.bfloat16)
+
+
+def _quant_int8_allreduce(flat, primary, size, rest, block, groups=None,
+                          rest_size=1):
     """The int8 block-scaled codec over mesh axis ``primary`` (static size
     ``size``; any ``rest`` axes combine the dequantized chunks exactly):
 
     encode     per-(device-chunk, ``_QUANT_BLOCK``-block) bf16 scale =
-               amax/127,
+               amax/127, SATURATED into finite bf16 range,
                payload rounded to s8;
     exchange   reduce-scatter as ONE tiled ``all_to_all`` of the s8
                payload (+ scales bitcast u16) — device i receives every
                peer's i-th chunk;
-    combine    dequantize + sum in f32 (exact given s8 inputs);
+    combine    dequantize + sum in f32 (exact given s8 inputs; the
+               summands are pre-scaled down by a power of two so a
+               transient partial overflow cannot turn a finite total
+               into inf — the shift is exponent-exact, bitwise-neutral
+               for in-range payloads);
     return     bf16 ``all_gather`` (bitcast u16 on the wire) of the
-               combined chunks, decoded back to the payload dtype.
+               combined chunks — saturating downcast — decoded back to
+               the payload dtype.
 
     This is the arXiv:2004.09362 generalized-allreduce decomposition with
     quantized phases (EQuARX, arXiv:2506.17615). Wire bytes: ~3/8 of the
     exact f32 all-reduce (1 byte down + 2 bytes back vs 4 bytes each
-    way). Non-finite payload elements do not round-trip (inf amax zeroes
-    its block) — see the when-not-to table in doc/fusion.md."""
+    way). Values combine and return within bf16's finite range: payloads
+    whose true sum exceeds it SATURATE at ±bf16max (they no longer
+    round-trip as inf/NaN — doc/fusion.md when-not-to). ``groups``
+    optionally restricts the exchange to ``axis_index_groups`` subsets of
+    ``primary`` — the hierarchical decomposition's DCN leg on a flat
+    mesh, where ``size`` is the per-group participant count.
+    ``rest_size`` is the product of the ``rest`` axes' sizes: the
+    downscale covers the WHOLE summation scope (local combine and the
+    rest-axes psum), so the shift back to true magnitude happens only
+    after every addition has run."""
     dt = flat.dtype
     f = flat.astype(jnp.float32)
     n = f.shape[0]
@@ -1854,21 +2063,32 @@ def _quant_int8_allreduce(flat, primary, size, rest, block):
     m = f.reshape(size, chunk // block, block)
     amax = jnp.max(jnp.abs(m), axis=-1, keepdims=True)
     # the scale is rounded to bf16 BEFORE the encode divide, so encode and
-    # decode use the identical value — no scale-rounding skew
-    scale = (jnp.where(amax > 0, amax, 1.0) * (1.0 / 127.0)).astype(
-        jnp.bfloat16)
+    # decode use the identical value — no scale-rounding skew. Saturated:
+    # an inf amax (non-finite payload block) must yield a finite scale,
+    # or the decode's 0 * inf poisons the whole block as NaN
+    scale = _sat_bf16(jnp.where(amax > 0, amax, 1.0) * (1.0 / 127.0))
     q = jnp.clip(jnp.round(m / scale.astype(jnp.float32)),
                  -127, 127).astype(jnp.int8)
     q = jax.lax.all_to_all(q, primary, split_axis=0, concat_axis=0,
-                           tiled=True)
+                           tiled=True, axis_index_groups=groups)
     s = jax.lax.all_to_all(_wire_u16(scale), primary, split_axis=0,
-                           concat_axis=0, tiled=True)
+                           concat_axis=0, tiled=True,
+                           axis_index_groups=groups)
     s = _unwire_u16(s).astype(jnp.float32)
-    part = jnp.sum(q.astype(jnp.float32) * s, axis=0)
+    # combine with power-of-two downscaled summands: partial sums of
+    # `size * rest_size` terms each bounded by amax can transiently pass
+    # f32 max even when the total is representable (±1e38-magnitude
+    # gradients) — dividing the SCALES by 2^ceil(log2(scope)) bounds
+    # every partial (including the rest-axes psum's) by max|amax|, and
+    # the final shift back is exact (exponent arithmetic)
+    k = float(1 << max(0, (size * max(1, int(rest_size)) - 1)
+                       .bit_length()))
+    part = jnp.sum(q.astype(jnp.float32) * (s * (1.0 / k)), axis=0)
     if rest:
         part = jax.lax.psum(part, rest)
-    g = jax.lax.all_gather(_wire_u16(part.astype(jnp.bfloat16)),
-                           primary, axis=0, tiled=True)
+    part = part * k
+    g = jax.lax.all_gather(_wire_u16(_sat_bf16(part)), primary, axis=0,
+                           tiled=True, axis_index_groups=groups)
     out = _unwire_u16(g).astype(jnp.float32).reshape(-1)
     if total != n:
         out = out[:n]
@@ -1891,16 +2111,15 @@ def _quant_allreduce_parts(parts, axes, sizes, codec, block, bounds=None):
             pad = (-_numel(p.shape)) % block
             flats.append(jnp.pad(v, (0, pad)) if pad else v)
         flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
-        k = max(range(len(axes)), key=lambda i: sizes[i])
-        rest = tuple(a for i, a in enumerate(axes)
-                     if i != k and sizes[i] > 1)
+        k, rest, rest_size = _slow_primary(axes, sizes)
         if bounds is None:
             comb = _quant_int8_allreduce(flat, axes[k], sizes[k], rest,
-                                         block)
+                                         block, rest_size=rest_size)
         else:
             def int8_leg(piece, _axes):
                 return _quant_int8_allreduce(piece, axes[k], sizes[k],
-                                             rest, block)
+                                             rest, block,
+                                             rest_size=rest_size)
 
             comb = _chunked_exact(flat, None, int8_leg, bounds)
         stride = block
@@ -1921,6 +2140,377 @@ def _quant_allreduce_parts(parts, axes, sizes, codec, block, bounds=None):
     return out
 
 
+# ---------------------------------------------------------------------- #
+# tier-aware hierarchical packed collectives (HEAT_TPU_HIER)             #
+# ---------------------------------------------------------------------- #
+def _slow_axis_name(hk) -> str:
+    """The slow (DCN) tier's mesh-axis name under declaration ``hk[1]``:
+    the first name of a name-form declaration, else the built-in
+    ``"dcn"`` (a grid that names an axis ``"dcn"`` has declared it)."""
+    t = hk[1]
+    if isinstance(t, tuple) and t and isinstance(t[0], str):
+        return t[0]
+    return "dcn"
+
+
+def _hier_factor(size, hk):
+    """The declared ``(d, i)`` factorization when it exactly factors a
+    flat ``size``-device scope into d>1 hosts × i>1 devices, else None."""
+    t = hk[1]
+    if not (isinstance(t, tuple) and len(t) == 2
+            and all(isinstance(v, int) for v in t)):
+        return None
+    d, i = t
+    if d > 1 and i > 1 and d * i == int(size):
+        return (d, i)
+    return None
+
+
+def _hier_dtype_ok(dt) -> bool:
+    """bool payloads keep the flat collective (a reduce-scattered pred
+    reduction is not portably expressible); every other dtype decomposes
+    exactly (sum reassociation: bitwise for ints, few-ulp for floats)."""
+    return dt != jnp.dtype(jnp.bool_)
+
+
+def _hier_subgroups(members, qset, numel_of, dt, dcn_codec, ici_codec,
+                    ici_floor):
+    """The qm/im/rest tier-subgroup split — ONE source for the
+    predicates the plan/key/body-agreement argument depends on, shared
+    by the flush body (``_sm_body.emit_all``), :func:`packed_psum` and
+    :func:`_chunk_flush_plan`: quant-selected members (``qset``) carry
+    the DCN codec plus the ICI codec on the fast legs; with the ICI
+    codec armed but no DCN selection, floor-qualifying f32 members still
+    ride the bf16 fast legs; everything else goes exact. Returns
+    ``((qm, dcn_codec, ici), (im, None, ici), (rest, None, None))``."""
+    qm = [m for m in members if m in qset]
+    im = []
+    if ici_codec == "bf16" and dt == jnp.dtype(jnp.float32):
+        im = [m for m in members if m not in qset
+              and numel_of(m) >= ici_floor]
+    taken = set(qm) | set(im)
+    rest = [m for m in members if m not in taken]
+    return ((qm, dcn_codec, ici_codec), (im, None, ici_codec),
+            (rest, None, None))
+
+
+def _slow_primary(axes, sizes):
+    """``(primary index, rest axis names, rest size product)`` — the
+    largest-axis primary selection of the int8 exchange, shared by
+    :func:`_quant_allreduce_parts` and ``_TierComm.slow_allreduce`` so
+    the axis the a2a/gather legs ride (and the overflow downscale's
+    scope) can never drift between the flat and tiered paths."""
+    k = max(range(len(axes)), key=lambda j: sizes[j])
+    rest = tuple(a for j, a in enumerate(axes)
+                 if j != k and sizes[j] > 1)
+    rest_size = 1
+    for j, s in enumerate(sizes):
+        if j != k and s > 1:
+            rest_size *= s
+    return k, rest, rest_size
+
+
+class _TierComm:
+    """Static leg descriptor for ONE hierarchical packed exchange: how to
+    reduce-scatter / all-gather over the fast (ICI) tier and all-reduce
+    over the slow (DCN) tier. Two forms share the interface:
+
+    * **named** — the scope's mesh axes split by name into fast/slow
+      tiers (a ``MeshGrid`` with a ``"dcn"`` axis: the 5-axis
+      ``TransformerLM`` grid, ``DataParallel``'s 2-D tier grid, DASO);
+    * **flat** — a single mesh axis with a declared ``(d, i)``
+      factorization, tiers expressed as ``axis_index_groups`` (the flush
+      path's 1-D communicator; device order is dcn-major, matching
+      ``jax.devices()`` on a real pod).
+
+    ``replicated=True`` marks values already replicated over the fast
+    tier (DASO's slow-tier capture): the reduce-scatter degenerates to a
+    zero-collective static slice of each device's own tile."""
+
+    __slots__ = ("pf", "ps", "fast_axes", "fast_sizes", "slow_axes",
+                 "slow_sizes", "axn", "fast_groups", "slow_groups",
+                 "replicated")
+
+    def __init__(self):
+        self.axn = None
+        self.fast_groups = self.slow_groups = None
+        self.replicated = False
+
+    @classmethod
+    def named(cls, fast_axes, fast_sizes, slow_axes, slow_sizes,
+              replicated=False):
+        tc = cls()
+        tc.fast_axes = tuple(fast_axes)
+        tc.fast_sizes = tuple(int(s) for s in fast_sizes)
+        tc.slow_axes = tuple(slow_axes)
+        tc.slow_sizes = tuple(int(s) for s in slow_sizes)
+        tc.pf = 1
+        for s in tc.fast_sizes:
+            tc.pf *= s
+        tc.ps = 1
+        for s in tc.slow_sizes:
+            tc.ps *= s
+        tc.replicated = bool(replicated)
+        return tc
+
+    @classmethod
+    def flat(cls, axn, d, i):
+        tc = cls()
+        tc.axn = axn
+        tc.pf, tc.ps = int(i), int(d)
+        tc.fast_sizes, tc.slow_sizes = (int(i),), (int(d),)
+        tc.fast_axes = tc.slow_axes = ()
+        # dcn-major device order: device h*i + j = host h, local slot j
+        tc.fast_groups = tuple(tuple(h * i + j for j in range(i))
+                               for h in range(d))
+        tc.slow_groups = tuple(tuple(h * i + j for h in range(d))
+                               for j in range(i))
+        return tc
+
+    # -- fast (ICI) tier legs ----------------------------------------- #
+    def rs(self, x):
+        """Tiled reduce-scatter of a flat payload over the fast tier."""
+        if self.axn is not None:
+            return jax.lax.psum_scatter(
+                x, self.axn, scatter_dimension=0, tiled=True,
+                axis_index_groups=self.fast_groups)
+        return jax.lax.psum_scatter(x, self.fast_axes,
+                                    scatter_dimension=0, tiled=True)
+
+    def ag(self, x):
+        """Tiled all-gather of the combined shard over the fast tier."""
+        if self.axn is not None:
+            return jax.lax.all_gather(x, self.axn, axis=0, tiled=True,
+                                      axis_index_groups=self.fast_groups)
+        return jax.lax.all_gather(x, self.fast_axes, axis=0, tiled=True)
+
+    def fast_index(self):
+        """This device's flattened index along the fast tier (the tile
+        the replicated form slices in place of the reduce-scatter)."""
+        if self.axn is not None:
+            return jax.lax.axis_index(self.axn) % self.pf
+        idx = None
+        for a, s in zip(self.fast_axes, self.fast_sizes):
+            ai = jax.lax.axis_index(a)
+            idx = ai if idx is None else idx * s + ai
+        return idx
+
+    # -- slow (DCN) tier leg ------------------------------------------ #
+    def _slow_psum(self, x):
+        if self.axn is not None:
+            return jax.lax.psum(x, self.axn,
+                                axis_index_groups=self.slow_groups)
+        return jax.lax.psum(x, self.slow_axes)
+
+    def slow_allreduce(self, x, codec, block, bounds=None):
+        """All-reduce of the 1/pf shard across the slow tier with the
+        DCN wire codec; ``bounds`` pipelines this leg into
+        double-buffered chunks (the PR 10 chunking composed onto the
+        slow tier — the legs worth overlapping are the slow ones)."""
+        if codec == "int8":
+            if self.axn is not None:
+                def leg(piece, _axes):
+                    return _quant_int8_allreduce(
+                        piece, self.axn, self.ps, (), block,
+                        groups=self.slow_groups)
+            else:
+                k, rest, rest_size = _slow_primary(self.slow_axes,
+                                                   self.slow_sizes)
+
+                def leg(piece, _axes):
+                    return _quant_int8_allreduce(
+                        piece, self.slow_axes[k], self.slow_sizes[k],
+                        rest, block, rest_size=rest_size)
+        elif codec == "bf16":
+            def leg(piece, _axes):
+                return self._slow_psum(_sat_bf16(piece)).astype(piece.dtype)
+        else:
+            def leg(piece, _axes):
+                return self._slow_psum(piece)
+        if bounds is None:
+            return leg(x, None)
+        return _chunked_exact(x, None, leg, bounds)
+
+
+def _tier_scope(axes, sizes, hk, replicated=()):
+    """A :class:`_TierComm` for a ``packed_psum`` reduction scope, or
+    None when no hierarchy applies: the REPLICATED form when the caller
+    declares fast axes its values are replicated over (DASO), the named
+    split when the scope contains the slow-named axis plus fast axes
+    (tiered model grids), or the flat ``(d, i)`` factorization when the
+    scope is one axis of exactly that size."""
+    if replicated:
+        rep = tuple(replicated)
+        rsizes = tuple(int(jax.lax.psum(1, a)) for a in rep)
+        pf = 1
+        for s in rsizes:
+            pf *= s
+        if pf > 1:
+            return _TierComm.named(rep, rsizes, axes, sizes,
+                                   replicated=True)
+        return None
+    slow_name = _slow_axis_name(hk)
+    slow = tuple(j for j, a in enumerate(axes)
+                 if a == slow_name and sizes[j] > 1)
+    fast = tuple(j for j, a in enumerate(axes)
+                 if a != slow_name and sizes[j] > 1)
+    if slow and fast:
+        return _TierComm.named(
+            tuple(axes[j] for j in fast), tuple(sizes[j] for j in fast),
+            tuple(axes[j] for j in slow), tuple(sizes[j] for j in slow))
+    if len(axes) == 1:
+        f = _hier_factor(sizes[0], hk)
+        if f is not None:
+            return _TierComm.flat(axes[0], f[0], f[1])
+    return None
+
+
+def _hier_leg_bounds(numels, codec, block, pf, ps, cn):
+    """Pipeline-chunk bounds for the DCN leg of one hierarchical payload
+    group (PR 10 chunking composed onto the slow tier), or None: the
+    1/pf shard splits on ps-aligned (int8: ps×block-aligned) boundaries
+    so every piece's device chunks and scale blocks coincide with the
+    unchunked slow exchange's — value- and byte-exact per the
+    ``_chunk_bounds`` lemma."""
+    stride = pf * (block if codec == "int8" else 1)
+    shard_total = sum(n + ((-n) % stride) for n in numels) // pf
+    align = ps * (block if codec == "int8" else 1)
+    return _chunk_bounds(shard_total, cn, align)
+
+
+def _hier_allreduce_parts(parts, tc, dcn_codec, block, ici_codec,
+                          bounds=None):
+    """Hierarchical all-reduce of mutually independent same-dtype
+    shard-local summands: flatten-concat (each part padded so every
+    fast-tier tile boundary — and, under the int8 DCN codec, every scale
+    block — stays within one part), reduce-scatter over the fast (ICI)
+    tier, all-reduce of the 1/pf shard over the slow (DCN) tier with the
+    DCN wire codec (``bounds`` pipelines THIS leg), then all-gather back
+    over the fast tier — the generalized-allreduce decomposition
+    (arXiv:2004.09362) with EQuARX's tier-selective codecs
+    (arXiv:2506.17615): full-precision bytes cross the fast wire, only
+    the 1/pf shard (optionally block-scaled int8) crosses the slow one.
+
+    ``ici_codec="bf16"`` rounds the payload to bf16 for the fast legs
+    (native on TPU ICI; the all-gather travels bitcast u16 so XLA:CPU
+    float normalization cannot upcast it — the reduce-scatter is a
+    reduction and keeps the usual bf16-collective CPU caveat). With
+    ``tc.replicated`` the reduce-scatter degenerates to each device's
+    zero-collective static slice of its own tile (values already agree
+    across the fast tier — DASO's capture).
+
+    Value contract: the decomposition re-associates the flat psum —
+    bitwise for integer payloads, few-ulp for floats (the documented
+    psum-reassociation freedom); tier codecs add their documented error
+    on top, on their tier only."""
+    dt = parts[0].dtype
+    pf = tc.pf
+    stride = pf * (block if dcn_codec == "int8" else 1)
+    flats = []
+    for p in parts:
+        v = p.reshape(-1)
+        pad = (-v.shape[0]) % stride
+        flats.append(jnp.pad(v, (0, pad)) if pad else v)
+    flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+    wire_bf16 = ici_codec == "bf16" and flat.dtype == jnp.dtype(jnp.float32)
+    if tc.replicated:
+        chunkn = flat.shape[0] // pf
+        shard = jax.lax.dynamic_slice_in_dim(
+            flat, tc.fast_index() * chunkn, chunkn, axis=0)
+        if wire_bf16:
+            shard = _sat_bf16(shard).astype(flat.dtype)
+    elif wire_bf16:
+        shard = tc.rs(_sat_bf16(flat)).astype(flat.dtype)
+    else:
+        shard = tc.rs(flat)
+    comb = tc.slow_allreduce(shard, dcn_codec, block, bounds=bounds)
+    if wire_bf16:
+        out_flat = _unwire_u16(tc.ag(_wire_u16(_sat_bf16(comb)))).astype(dt)
+    else:
+        out_flat = tc.ag(comb)
+    out, off = [], 0
+    for p in parts:
+        n = _numel(p.shape)
+        out.append(out_flat[off:off + n].reshape(p.shape))
+        off += n + ((-n) % stride)
+    return out
+
+
+def _hier_wire_bytes(numels, itemsize: int, dcn_codec, ici_codec,
+                     pf: int, ps: int, block: int) -> Tuple[int, int]:
+    """(flat exact, hierarchical) modeled ring-wire bytes for one psum
+    payload group under the tier decomposition — the same per-kind
+    formulas :func:`heat_tpu.utils.hlo_audit.collective_bytes` applies
+    to real HLO (AR = 2R(g-1)/g, RS = R_out(g-1), AG = R_out(g-1)/g),
+    so the counters and the audit agree by construction. The exact
+    baseline is the flat full-mesh all-reduce of the raw payload; the
+    hierarchical figure sums the fast RS+AG legs (bf16-halved under the
+    ICI codec) and the slow leg at 1/pf payload with the DCN codec."""
+    g = pf * ps
+    raw = sum(numels)
+    exact = 2 * raw * itemsize * (g - 1) // g
+    if dcn_codec == "int8":
+        padded = sum(n + ((-n) % (pf * block)) for n in numels)
+    else:
+        padded = sum(n + ((-n) % pf) for n in numels)
+    item_fast = 2 if ici_codec == "bf16" else itemsize
+    hier = 2 * padded * item_fast * (pf - 1) // pf  # RS + AG over ici
+    shard = padded // pf
+    if dcn_codec == "int8":
+        nblocks = -(-shard // block)
+        hier += ((shard + 2 * nblocks) * (ps - 1) // ps  # a2a s8 + scales
+                 + 2 * shard * (ps - 1) // ps)           # u16 gather
+    elif dcn_codec == "bf16":
+        hier += 2 * shard * 2 * (ps - 1) // ps
+    else:
+        hier += 2 * shard * itemsize * (ps - 1) // ps
+    return exact, hier
+
+
+def _hier_flush_plan(order, sm, comm):
+    """Static hierarchical-decomposition selection for one shard_map
+    flush: ``(hcfg, htag, n_groups)`` — the ``(d, i, ici_codec,
+    ici_floor)`` leg configuration captured AT PLANNING TIME (a
+    concurrent ``set_mesh_tiers``/``set_hier_enabled``/floor change
+    between planning and the deferred jit trace must not change the
+    collective structure out from under the program key; the floor
+    selects which payloads ride the bf16 fast legs when no quant codec
+    is armed), the tag that keys the program, and the number of psum
+    payload groups the body decomposes (ticked per dispatch as
+    ``op_engine.hier_collectives``) — or None when the hierarchy does
+    not apply (gate off, no/mismatched factorization for this flat
+    communicator, no qualifying psum group). The ``fusion.hier.exchange``
+    fault site fires here: a fault degrades the WHOLE flush to the flat
+    packed emission — keyed as such, so it HITS any cached flat program
+    — counted in ``op_engine.hier_fallbacks``."""
+    hkey = hier_key()
+    if not hkey[0]:
+        return None
+    f = _hier_factor(comm.size, hkey)
+    if f is None:
+        return None
+    sched, instrs, phases, _, _ = sm
+    totals: Dict[Tuple, int] = {}
+    for pos in sched:
+        ins = instrs[pos]
+        if ins[0] in ("reduce", "contract") and ins[1] == "psum" \
+                and _hier_dtype_ok(jnp.dtype(order[pos].aval.dtype)):
+            key = (phases[pos], str(jnp.dtype(order[pos].aval.dtype)))
+            totals[key] = totals.get(key, 0) + _numel(order[pos].aval.shape)
+    # the hier payload floor gates per GROUP total (hkey[3], captured):
+    # latency-bound tiny groups keep the flat collective
+    n = sum(1 for v in totals.values() if v >= hkey[3])
+    if not n:
+        return None
+    try:
+        _faults().check("fusion.hier.exchange")
+    except Exception:
+        _metrics().inc("op_engine.hier_fallbacks")
+        return None
+    floor = _QUANT_FLOOR
+    return (f[0], f[1], hkey[2], floor, hkey[3]), (hkey, floor), n
+
+
 def reset_qinfo(qinfo: dict) -> None:
     """Reset a ``packed_psum`` accounting dict at the START of a traced
     body — runs once per trace, so the dict is stable (and idempotent
@@ -1928,6 +2518,7 @@ def reset_qinfo(qinfo: dict) -> None:
     qinfo["collectives"] = 0
     qinfo["bytes_saved"] = 0
     qinfo["chunk_collectives"] = 0
+    qinfo["hier_collectives"] = 0
 
 
 def tick_quant(qinfo: dict) -> None:
@@ -1944,9 +2535,12 @@ def tick_quant(qinfo: dict) -> None:
     if qinfo.get("chunk_collectives"):
         _metrics().inc("op_engine.chunk_collectives",
                        qinfo["chunk_collectives"])
+    if qinfo.get("hier_collectives"):
+        _metrics().inc("op_engine.hier_collectives",
+                       qinfo["hier_collectives"])
 
 
-def _quant_flush_plan(order, sm, comm):
+def _quant_flush_plan(order, sm, comm, hcfg=None):
     """Static quant selection for one shard_map flush: ``(qsel, n,
     bytes_saved, qkey)`` — the pending-psum node positions routed through
     the quantized exchange, the rewritten-collective count, the modeled
@@ -1981,9 +2575,16 @@ def _quant_flush_plan(order, sm, comm):
               if _numel(order[p].aval.shape) >= floor]
         if not mq:
             continue
-        e, q = _quant_wire_bytes(
-            [_numel(order[p].aval.shape) for p in mq], dt.itemsize, codec,
-            (comm.size,), block)
+        numels = [_numel(order[p].aval.shape) for p in mq]
+        if hcfg is not None:
+            # hierarchical flush: the byte model follows the tiered legs
+            # (pf = hcfg[1] ici, ps = hcfg[0] dcn, ici codec hcfg[2]),
+            # not the flat exchange the body no longer emits
+            e, q = _hier_wire_bytes(numels, dt.itemsize, codec, hcfg[2],
+                                    hcfg[1], hcfg[0], block)
+        else:
+            e, q = _quant_wire_bytes(numels, dt.itemsize, codec,
+                                     (comm.size,), block)
         sel.update(mq)
         n += 1
         saved += max(0, e - q)
@@ -1997,7 +2598,7 @@ def _quant_flush_plan(order, sm, comm):
     return frozenset(sel), n, saved, qkey
 
 
-def _chunk_flush_plan(order, sm, comm, qsel, qcfg):
+def _chunk_flush_plan(order, sm, comm, qsel, qcfg, hcfg=None):
     """Static chunk selection for one shard_map flush: ``(ckey,
     n_groups)`` — the :func:`chunk_key` captured AT PLANNING TIME (a
     concurrent ``set_chunk_count`` between planning and the deferred jit
@@ -2026,16 +2627,35 @@ def _chunk_flush_plan(order, sm, comm, qsel, qcfg):
         groups.setdefault((phases[pos], ins[1], str(dt)), []).append(pos)
     chunked = 0
     for (_ph, _kind, _dt), members in groups.items():
+        numel_of = lambda p: _numel(order[p].aval.shape)  # noqa: E731
+        hier_grp = (hcfg is not None and _kind == "psum"
+                    and _hier_dtype_ok(jnp.dtype(_dt))
+                    and sum(numel_of(p) for p in members) >= hcfg[4])
+        if hier_grp:
+            # hierarchical group: chunking rides the DCN leg of each
+            # subgroup — the SAME shared split + bounds predicates the
+            # body applies (_hier_subgroups / _hier_leg_bounds)
+            for sub, sub_codec, _si in _hier_subgroups(
+                    members, qsel, numel_of, jnp.dtype(_dt), qcfg[0],
+                    hcfg[2], hcfg[3]):
+                if not sub:
+                    continue
+                numels = [numel_of(p) for p in sub]
+                if sum(numels) >= cfloor and _hier_leg_bounds(
+                        numels, sub_codec, qcfg[2], hcfg[1], hcfg[0],
+                        cn) is not None:
+                    chunked += 1
+            continue
         qm = [p for p in members if p in qsel]
         rest = [p for p in members if p not in qsel]
         if qm:
-            numels = [_numel(order[p].aval.shape) for p in qm]
+            numels = [numel_of(p) for p in qm]
             if sum(numels) >= cfloor and _quant_chunk_bounds(
                     numels, (comm.size,), qcfg[0], qcfg[2],
                     cn) is not None:
                 chunked += 1
         if rest:
-            total = sum(_numel(order[p].aval.shape) for p in rest)
+            total = sum(numel_of(p) for p in rest)
             if total >= cfloor and _chunk_bounds(
                     total, cn, comm.size) is not None:
                 chunked += 1
@@ -2204,7 +2824,7 @@ def _plan_sm(order, plan, leaves, leaf_splits, out_idx, comm):
 
 
 def _sm_body(plan, sm, out_idx, comm, qsel=frozenset(),
-             qcfg=(None, 0, 0), ccfg=(1, 0)):
+             qcfg=(None, 0, 0), ccfg=(1, 0), hcfg=None):
     """The shard_map replay body for a :func:`_plan_sm` plan: every value
     is a shard-local block (replicated values are full arrays), reduce
     partials accumulate per phase and combine in ONE flattened collective
@@ -2216,11 +2836,20 @@ def _sm_body(plan, sm, out_idx, comm, qsel=frozenset(),
     floor)`` (:func:`_chunk_flush_plan`'s captured :func:`chunk_key`)
     splits qualifying payload groups into double-buffered pipeline chunk
     collectives — same floor/alignment predicates as the plan, so the
-    body emits exactly the leg structure the plan counted and keyed."""
+    body emits exactly the leg structure the plan counted and keyed.
+    ``hcfg = (d, i, ici_codec)`` (:func:`_hier_flush_plan`'s captured
+    tier factorization) routes every psum payload group through the
+    hierarchical decomposition instead — reduce-scatter inside each
+    i-device ICI group, all-reduce of the 1/i shard across the d DCN
+    peers (quant members with the DCN codec, chunk bounds on this leg),
+    all-gather back — so full-precision bytes never cross the slow tier
+    whole. pmax/pmin (and bool) groups keep the flat collective."""
     sched, instrs, phases, _, _ = sm
     axn = comm.axis_name
     size = comm.size
     cn, cfloor = ccfg
+    tc = _TierComm.flat(axn, hcfg[0], hcfg[1]) if hcfg is not None else None
+    hier_ici = hcfg[2] if hcfg is not None else None
     # lazy (utils/core cycle): the resplit branch reuses the planner's
     # pad helper so the blockwise translation shares its one source
     from . import resharding
@@ -2237,6 +2866,36 @@ def _sm_body(plan, sm, out_idx, comm, qsel=frozenset(),
             pend.clear()
             for (kind, _dt), members in groups.items():
                 coll = _COLL_FNS[kind]
+                if tc is not None and kind == "psum" \
+                        and _hier_dtype_ok(_dt) \
+                        and sum(_numel(vals[p2].shape)
+                                for p2 in members) >= hcfg[4]:
+                    # hierarchical decomposition (group total at/above
+                    # the captured hier floor): the shared subgroup
+                    # split — qsel members carry the DCN codec (and the
+                    # ICI codec on the fast legs); with no quant codec
+                    # armed the ICI codec still applies to the
+                    # floor-qualifying f32 payloads (the plan's
+                    # CAPTURED floor, mirroring packed_psum); the rest
+                    # ride exact tiered legs. PR 10 chunk bounds
+                    # pipeline each DCN sub-leg
+                    for sub, sub_codec, sub_ici in _hier_subgroups(
+                            members, qsel,
+                            lambda p2: _numel(vals[p2].shape), _dt,
+                            qcfg[0], hier_ici, hcfg[3]):
+                        if not sub:
+                            continue
+                        numels = [_numel(vals[p2].shape) for p2 in sub]
+                        bounds = None
+                        if cn > 1 and sum(numels) >= cfloor:
+                            bounds = _hier_leg_bounds(
+                                numels, sub_codec, qcfg[2], tc.pf,
+                                tc.ps, cn)
+                        for p2, v in zip(sub, _hier_allreduce_parts(
+                                [vals[p2] for p2 in sub], tc, sub_codec,
+                                qcfg[2], sub_ici, bounds=bounds)):
+                            vals[p2] = v
+                    continue
                 if qsel:
                     qm = [p2 for p2 in members if p2 in qsel]
                     if qm:
@@ -2417,7 +3076,9 @@ def _is_arr(x) -> bool:
 
 def packed_psum(values, axes, qinfo: Optional[dict] = None,
                 quant: Optional[Tuple] = None,
-                chunks: Optional[Tuple] = None):
+                chunks: Optional[Tuple] = None,
+                hier: Optional[Tuple] = None,
+                replicated: Tuple = ()):
     """ONE flattened all-reduce per dtype over mesh ``axes`` for a list of
     mutually independent shard-local partials — the train-step form of the
     flush body's phase-barrier packing (``_sm_body.emit_all``; the
@@ -2446,7 +3107,24 @@ def packed_psum(values, axes, qinfo: Optional[dict] = None,
     floor splits into up to N double-buffered pipeline chunk collectives
     (per-codec block-aligned boundaries — bitwise the unchunked packing);
     the ``fusion.chunk.dispatch`` fault site degrades the call to the
-    unchunked emission, counted in ``op_engine.chunk_fallbacks``."""
+    unchunked emission, counted in ``op_engine.chunk_fallbacks``.
+
+    Under ``HEAT_TPU_HIER`` with declared tiers, every psum payload
+    group whose reduction scope splits into a slow (DCN) and a fast
+    (ICI) tier — the scope contains the slow-named axis plus fast axes,
+    or is one flat axis with the declared ``(d, i)`` factorization —
+    rides the HIERARCHICAL exchange instead
+    (:func:`_hier_allreduce_parts`): reduce-scatter over the fast tier,
+    all-reduce of the 1/pf shard over the slow tier with the DCN codec
+    (the quant codec above; chunk bounds pipeline this leg), all-gather
+    back with the ICI codec on the fast legs. ``hier`` pins the
+    :func:`hier_key` tuple the way ``quant``/``chunks`` do;
+    ``replicated`` names fast axes the values are already replicated
+    over (DASO's slow-tier capture) — the reduce-scatter then
+    degenerates to each device's zero-collective slice of its own tile,
+    so only 1/pf of the payload ever crosses the slow tier per device.
+    The ``fusion.hier.exchange`` fault site degrades the call to the
+    flat emission, counted in ``op_engine.hier_fallbacks``."""
     values = list(values)
     if not axes:
         return values
@@ -2457,17 +3135,21 @@ def packed_psum(values, axes, qinfo: Optional[dict] = None,
     out = list(values)
     codec, floor, block = quant if quant is not None else quant_key()
     cn, cfloor = chunks if chunks is not None else chunk_key()
+    hk = hier if hier is not None else hier_key()
     sizes, group_size = (), 1
     quant_ok = codec is not None
-    if quant_ok or cn > 1:
+    if quant_ok or cn > 1 or hk[0]:
         # lax.psum of a python int is STATIC (the axis-size idiom):
-        # sizes are concrete here, usable for the int8/pipeline chunking.
-        # Only computed when a codec or chunking is armed — the exact
-        # unchunked path is untouched
+        # sizes are concrete here, usable for the int8/pipeline chunking
+        # and the tier split. Only computed when a codec, chunking or
+        # the hierarchy is armed — the exact flat path is untouched
         sizes = tuple(jax.lax.psum(1, a) for a in axes)
         for s in sizes:
             group_size *= s
         quant_ok = quant_ok and group_size > 1
+    tc = None
+    if hk[0] and group_size > 1:
+        tc = _tier_scope(axes, sizes, hk, replicated)
     if quant_ok:
         try:
             _faults().check("fusion.quant.encode")
@@ -2475,6 +3157,25 @@ def packed_psum(values, axes, qinfo: Optional[dict] = None,
             _metrics().inc("op_engine.quant_fallbacks")
             quant_ok = False
     chunk_state = {"ok": cn > 1 and group_size > 1, "checked": False}
+    hier_state = {"ok": tc is not None, "checked": False}
+
+    def hier_gate():
+        """Arm the ``fusion.hier.exchange`` site on the FIRST payload
+        group that would actually decompose (matching
+        ``_hier_flush_plan``): a call with no qualifying group neither
+        fires the site nor ticks the fallback counter. A raise degrades
+        the WHOLE call to the flat packed emission."""
+        if not hier_state["ok"]:
+            return None
+        if not hier_state["checked"]:
+            hier_state["checked"] = True
+            try:
+                _faults().check("fusion.hier.exchange")
+            except Exception:
+                _metrics().inc("op_engine.hier_fallbacks")
+                hier_state["ok"] = False
+                return None
+        return tc
 
     def chunk_gate(bounds):
         """Arm the ``fusion.chunk.dispatch`` site on the FIRST payload
@@ -2497,6 +3198,61 @@ def packed_psum(values, axes, qinfo: Optional[dict] = None,
 
     for _dt, members in groups.items():
         dt = jnp.dtype(_dt)
+        tcg = None
+        if _hier_dtype_ok(dt) and sum(
+                _numel(values[i].shape) for i in members) >= hk[3]:
+            tcg = hier_gate()
+        if tcg is not None:
+            # hierarchical decomposition for this payload group (total
+            # at/above the hier floor): the SHARED subgroup split —
+            # codec-qualifying members carry the DCN codec on the slow
+            # leg (and the ICI codec on the fast legs), floor-qualifying
+            # f32 members ride bf16 fast legs when only the ICI codec is
+            # armed, the rest go exact; PR 10 chunk bounds pipeline the
+            # DCN leg of each subgroup
+            qset = set()
+            if quant_ok and _quant_dtype_ok(dt, codec):
+                qset = {i for i in members
+                        if _numel(values[i].shape) >= floor}
+            nhier = 0
+            for sub, sub_codec, sub_ici in _hier_subgroups(
+                    members, qset,
+                    lambda i: _numel(values[i].shape), dt,
+                    codec if qset else None, hk[2], floor):
+                if not sub:
+                    continue
+                numels = [_numel(values[i].shape) for i in sub]
+                bounds = None
+                if chunk_state["ok"] and sum(numels) >= cfloor:
+                    bounds = chunk_gate(_hier_leg_bounds(
+                        numels, sub_codec, block, tcg.pf, tcg.ps, cn))
+                for i, v in zip(sub, _hier_allreduce_parts(
+                        [values[i] for i in sub], tcg, sub_codec, block,
+                        sub_ici, bounds=bounds)):
+                    out[i] = v
+                nhier += 1
+                if qinfo is not None:
+                    if sub_codec is not None:
+                        # only DCN-codec rewrites tick the quant
+                        # counters: ici-bf16-only savings belong to the
+                        # hier feature, not the quant one (stats
+                        # attribution — a dashboard reading
+                        # quant_collectives with quant_codec None would
+                        # otherwise see phantom rewrites)
+                        e, q = _hier_wire_bytes(
+                            numels, dt.itemsize, sub_codec, sub_ici,
+                            tcg.pf, tcg.ps, block)
+                        qinfo["collectives"] = \
+                            qinfo.get("collectives", 0) + 1
+                        qinfo["bytes_saved"] = (qinfo.get("bytes_saved", 0)
+                                                + max(0, e - q))
+                    if bounds is not None:
+                        qinfo["chunk_collectives"] = \
+                            qinfo.get("chunk_collectives", 0) + 1
+            if qinfo is not None and nhier:
+                qinfo["hier_collectives"] = \
+                    qinfo.get("hier_collectives", 0) + 1
+            continue
         qm = []
         if quant_ok and _quant_dtype_ok(dt, codec):
             qm = [i for i in members
@@ -2777,13 +3533,13 @@ class _TracedStep:
         except _Untraceable:
             _metrics().inc("op_engine.fusion_step_fallbacks")
             return self.fn(*args, **kwargs)
-        # quant/chunk keys ride along: a step body may call packed_psum
-        # directly (trace-time config read), and a config toggle must
-        # compile a SIBLING instead of reusing a program traced under the
-        # other wire format / leg structure — the same discipline as the
-        # flush key's qtag/ctag
+        # quant/chunk/hier keys ride along: a step body may call
+        # packed_psum directly (trace-time config read), and a config
+        # toggle must compile a SIBLING instead of reusing a program
+        # traced under the other wire format / leg structure — the same
+        # discipline as the flush key's qtag/ctag/htag
         key = ("step", self.fn, treedef, tuple(sig), self.donate_argnums,
-               self.block, quant_key(), chunk_key())
+               self.block, quant_key(), chunk_key(), hier_key())
         if key in self._eager_keys:
             _metrics().inc("op_engine.fusion_step_fallbacks")
             return self.fn(*args, **kwargs)
@@ -3015,6 +3771,11 @@ def stats() -> dict:
         "chunk_min_numel": _CHUNK_FLOOR,
         "chunk_collectives": int(c.get("op_engine.chunk_collectives", 0)),
         "chunk_fallbacks": int(c.get("op_engine.chunk_fallbacks", 0)),
+        "hier_enabled": _HIER,
+        "mesh_tiers": list(_TIERS) if _TIERS is not None else None,
+        "hier_ici_codec": _HIER_ICI,
+        "hier_collectives": int(c.get("op_engine.hier_collectives", 0)),
+        "hier_fallbacks": int(c.get("op_engine.hier_fallbacks", 0)),
         "program_cache": program_cache().stats(),
     }
 

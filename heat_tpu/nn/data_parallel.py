@@ -80,11 +80,12 @@ class DataParallel:
         self.seed = seed
         self.params = None
         self._train_step = None
-        # (fusion.quant_key(), fusion.chunk_key()) -> (packed step, its
-        # trace-time qinfo dict): codec/chunk toggles compile SIBLINGS
-        # and toggle-back re-hits the cached exact/unchunked program
-        # (same discipline as TransformerLM's _step_cache; the key space
-        # is the handful of codec × chunk configs)
+        # (fusion.quant_key(), fusion.chunk_key(), fusion.hier_key()) ->
+        # (packed step, its trace-time qinfo dict): codec/chunk/tier
+        # toggles compile SIBLINGS and toggle-back re-hits the cached
+        # exact/unchunked/flat program (same discipline as
+        # TransformerLM's _step_cache; the key space is the handful of
+        # codec × chunk × tier configs)
         self._packed_steps = {}
         if loss_is_batch_mean is None:
             loss_is_batch_mean = loss_fn is None  # default CE is a mean
@@ -138,7 +139,32 @@ class DataParallel:
 
         return jax.jit(train_step, donate_argnums=(0, 1))
 
-    def _build_packed_train_step(self, quant=None, chunks=None):
+    def _tier_factor(self, hier=None):
+        """The declared ``(dcn, ici)`` factorization of this trainer's
+        flat mesh, or None: the ``HEAT_TPU_MESH_TIERS`` integer form when
+        it exactly factors the device count, else — on a real multi-host
+        pod with no explicit declaration — the process boundary itself
+        (``jax.process_count()`` hosts × devices-per-host). Gated on the
+        hierarchy master switch. ``hier`` pins the :func:`hier_key` the
+        caller cache-keyed on (captured-key discipline: a concurrent
+        declaration change between keying and building must not produce
+        a program whose grid contradicts its key); None keeps the
+        historic flat 1-D grid."""
+        from ..core import fusion
+
+        hk = hier if hier is not None else fusion.hier_key()
+        if not hk[0]:
+            return None
+        n = self.comm.size
+        f = fusion._hier_factor(n, hk)
+        if f is not None:
+            return f
+        pc = jax.process_count()
+        if hk[1] is None and 1 < pc < n and n % pc == 0:
+            return (pc, n // pc)
+        return None
+
+    def _build_packed_train_step(self, quant=None, chunks=None, hier=None):
         """The packed-collective form of the train step: one ``shard_map``
         program computing each device's gradients on its LOCAL batch shard
         and combining every parameter cotangent — and the loss — in ONE
@@ -148,23 +174,42 @@ class DataParallel:
         instead of the one-all-reduce-per-parameter GSPMD places for the
         transposed batch sharding. Exact for batch-mean losses (equal
         canonical shards): the global mean is the mean of per-shard means,
-        plus any replicated additive terms (regularizers)."""
+        plus any replicated additive terms (regularizers).
+
+        With tiers declared (:meth:`_tier_factor`) the flat dp grid
+        defaults to 2-D — ``MeshGrid((d, i), ("dcn", "ici"))`` over the
+        SAME devices in the same order, so per-device batch shards are
+        identical to the flat layout — and the packed all-reduce
+        decomposes hierarchically: reduce-scatter inside each ICI group,
+        all-reduce of the 1/i shard across DCN (with the DCN wire
+        codec), all-gather back (``HEAT_TPU_HIER``)."""
         import optax
 
         from ..core import fusion
         from ..core._compat import shard_map
+        from ..core.communication import MeshGrid
         from jax.sharding import PartitionSpec as P
 
         apply_fn = self.module.apply
         loss_fn = self.loss_fn
         tx = self.optimizer.tx
         comm = self.comm
-        axis, p = comm.axis_name, comm.size
+        p = comm.size
         qinfo = {}
         if quant is None:
             quant = fusion.quant_key()
         if chunks is None:
             chunks = fusion.chunk_key()
+        if hier is None:
+            hier = fusion.hier_key()
+        f = self._tier_factor(hier)
+        if f is not None:
+            grid = MeshGrid(f, ("dcn", "ici"), devices=comm.devices)
+            mesh, axes = grid.mesh, ("dcn", "ici")
+            batch_spec = P(("dcn", "ici"))
+        else:
+            mesh, axes = comm.mesh, (comm.axis_name,)
+            batch_spec = P(comm.axis_name)
 
         def body(params, opt_state, bx, by):
             # reset-then-accumulate runs once per trace; step() reads the
@@ -176,9 +221,9 @@ class DataParallel:
 
             lval, grads = jax.value_and_grad(local_loss)(params)
             leaves, treedef = jax.tree_util.tree_flatten(grads)
-            packed = fusion.packed_psum(leaves + [lval], (axis,),
+            packed = fusion.packed_psum(leaves + [lval], axes,
                                         qinfo=qinfo, quant=quant,
-                                        chunks=chunks)
+                                        chunks=chunks, hier=hier)
             grads = jax.tree_util.tree_unflatten(
                 treedef, [g / p for g in packed[:-1]])
             updates, opt_state = tx.update(grads, opt_state, params)
@@ -186,8 +231,8 @@ class DataParallel:
             return params, opt_state, packed[-1] / p
 
         sm = shard_map(
-            body, mesh=comm.mesh,
-            in_specs=(P(), P(), P(axis), P(axis)),
+            body, mesh=mesh,
+            in_specs=(P(), P(), batch_spec, batch_spec),
             out_specs=(P(), P(), P()),
             check_vma=False)
         return jax.jit(sm, donate_argnums=(0, 1)), qinfo
@@ -208,7 +253,8 @@ class DataParallel:
         if (fusion.step_enabled() and self.loss_is_batch_mean and size > 1
                 and bx.ndim >= 1 and bx.shape[0] % size == 0
                 and by.shape[:1] == bx.shape[:1]):
-            key = (fusion.quant_key(), fusion.chunk_key())
+            key = (fusion.quant_key(), fusion.chunk_key(),
+                   fusion.hier_key())
             if key not in self._packed_steps:
                 # the KEY's tuples are also the traced wire/leg config
                 # (jax traces at first dispatch; a toggle in between must

@@ -125,11 +125,26 @@ class TransformerLM:
     AXES = ("dp", "pp", "tp", "sp")
 
     def __init__(self, grid: MeshGrid, config: TransformerLMConfig):
-        if tuple(grid.axis_names) != self.AXES:
-            raise ValueError(f"grid axes must be {self.AXES}, got {grid.axis_names}")
+        names = tuple(grid.axis_names)
+        # an optional LEADING "dcn" axis declares the slow inter-host
+        # tier of a 2-level dp grid (dcn x dp both shard the batch):
+        # parameters stay replicated over it (the specs never name it),
+        # and the packed train step's gradient all-reduce decomposes
+        # hierarchically — reduce-scatter inside the fast tier,
+        # all-reduce of the 1/p_ici shard across dcn, all-gather back
+        # (heat_tpu.core.fusion.packed_psum, HEAT_TPU_HIER)
+        if names == self.AXES:
+            self._has_dcn = False
+        elif names == ("dcn",) + self.AXES:
+            self._has_dcn = True
+        else:
+            raise ValueError(
+                f"grid axes must be {self.AXES} (optionally with a "
+                f"leading 'dcn' tier axis), got {grid.axis_names}")
         self.grid = grid
         self.cfg = config
         c = config
+        self.dcn = grid.mesh.shape["dcn"] if self._has_dcn else 1
         self.pp = grid.mesh.shape["pp"]
         self.tp = grid.mesh.shape["tp"]
         self.dp = grid.mesh.shape["dp"]
@@ -150,8 +165,14 @@ class TransformerLM:
                 f"ulysses schedule needs local heads ({c.n_heads}//{self.tp}"
                 f"={c.n_heads // self.tp}) divisible by sp ({self.sp})")
         self.layers_per_stage = c.n_layers // self.pp
-        self.mesh_size = self.dp * self.pp * self.tp * self.sp
+        self.mesh_size = self.dcn * self.dp * self.pp * self.tp * self.sp
         self._step_cache: Dict = {}
+
+    @property
+    def dp_world(self) -> int:
+        """Total data-parallel replication: the dp axis times the
+        optional dcn tier axis above it (batch rows shard over both)."""
+        return self.dcn * self.dp
 
     # ------------------------------------------------------------- #
     # parameters                                                    #
@@ -424,18 +445,28 @@ class TransformerLM:
         # the count is static — B_global rows each lose one position —
         # which also keeps it out of the vma system (a mask-sum would be
         # invarying over dp and unreducible there)
-        count = B_local * self.dp * (S_local * sp - 1)
+        count = B_local * self.dp_world * (S_local * sp - 1)
         return jnp.sum(nll * mask) / count
+
+    def _data_axes(self):
+        """The data axes (the loss psum scope): dp and sp, plus the dcn
+        tier axis when the grid declares one."""
+        return (("dcn", "dp", "sp") if self._has_dcn else ("dp", "sp"))
 
     def _loss_device(self, params, toks):
         """Per-device code: toks (B_local, S_local) -> replicated global loss."""
-        return lax.psum(self._local_loss_device(params, toks), ("dp", "sp"))
+        return lax.psum(self._local_loss_device(params, toks),
+                        self._data_axes())
 
     # ------------------------------------------------------------- #
     # jitted steps                                                  #
     # ------------------------------------------------------------- #
 
     def _data_spec(self):
+        if self._has_dcn:
+            # batch rows shard over BOTH data-parallel tiers (dcn-major,
+            # like jax.devices() orders a real pod's hosts)
+            return P(("dcn", "dp"), "sp")
         return P("dp", "sp")
 
     def shard_batch(self, toks: np.ndarray) -> jax.Array:
@@ -457,12 +488,15 @@ class TransformerLM:
 
     def _batch_axes(self):
         """Non-trivial data axes — the reduction scope of the packed
-        gradient all-reduce (empty on a 1-device grid: no collective)."""
-        return tuple(a for a, n in (("dp", self.dp), ("sp", self.sp))
+        gradient all-reduce (empty on a 1-device grid: no collective).
+        The dcn tier axis leads: packed_psum's tier split sees it as the
+        slow tier and dp/sp as the fast one."""
+        return tuple(a for a, n in (("dcn", self.dcn), ("dp", self.dp),
+                                    ("sp", self.sp))
                      if n > 1)
 
     def _packed_loss_and_grad_body(self, qinfo=None, quant=None,
-                                   chunks=None):
+                                   chunks=None, hier=None):
         """Per-device (params, toks) -> (loss, grads) with every gradient
         cotangent — and the loss — combined in ONE flattened all-reduce:
         local value_and_grad of the device's loss share, then
@@ -488,7 +522,8 @@ class TransformerLM:
                 self._local_loss_device)(params, toks)
             leaves, treedef = jax.tree_util.tree_flatten(grads)
             packed = fusion.packed_psum(leaves + [lval], axes, qinfo=qinfo,
-                                        quant=quant, chunks=chunks)
+                                        quant=quant, chunks=chunks,
+                                        hier=hier)
             return packed[-1], jax.tree_util.tree_unflatten(
                 treedef, packed[:-1])
 
@@ -506,13 +541,15 @@ class TransformerLM:
 
         packed = self.packed_step_supported and fusion.step_enabled()
         # the quant codec changes the packed program's collective wire
-        # format and the chunk count its leg structure, so both key the
-        # cache — toggling compiles a sibling program instead of
-        # poisoning the exact/unchunked one (the legacy key stays
-        # 2-tuple: the check_vma path never quantizes or chunks)
+        # format, the chunk count its leg structure and the hier config
+        # its collective decomposition, so all three key the cache —
+        # toggling compiles a sibling program instead of poisoning the
+        # exact/unchunked/flat one (the legacy key stays 2-tuple: the
+        # check_vma path never quantizes, chunks or decomposes)
         qk = fusion.quant_key()
         ck = fusion.chunk_key()
-        key = ("loss_and_grad", True, qk, ck) if packed \
+        hk = fusion.hier_key()
+        key = ("loss_and_grad", True, qk, ck, hk) if packed \
             else ("loss_and_grad", False)
         fn = self._step_cache.get(key)
         if fn is None:
@@ -521,7 +558,7 @@ class TransformerLM:
                 qinfo = {}
                 sm = shard_map(
                     self._packed_loss_and_grad_body(qinfo=qinfo, quant=qk,
-                                                    chunks=ck),
+                                                    chunks=ck, hier=hk),
                     mesh=self.grid.mesh,
                     in_specs=(specs, self._data_spec()),
                     out_specs=(P(), specs),
@@ -600,7 +637,7 @@ class TransformerLM:
             qinfo = {}
             lg_body = self._packed_loss_and_grad_body(
                 qinfo=qinfo, quant=fusion.quant_key(),
-                chunks=fusion.chunk_key())
+                chunks=fusion.chunk_key(), hier=fusion.hier_key())
 
             def body(params, opt_state, toks):
                 loss, grads = lg_body(params, toks)
@@ -676,9 +713,10 @@ class TransformerLM:
             raise NotImplementedError("generate supports the dense MLP only")
         prompts = jnp.asarray(prompts, jnp.int32)
         B, S0 = prompts.shape
-        if B % self.dp:
+        if B % self.dp_world:
             raise ValueError(
-                f"prompt batch ({B}) must divide over dp ({self.dp})")
+                f"prompt batch ({B}) must divide over the data-parallel "
+                f"world ({self.dp_world})")
         max_new_tokens = int(max_new_tokens)
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -706,10 +744,13 @@ class TransformerLM:
 
         def body(params, toks, key):
             Bl = toks.shape[0]
-            # independent sampling noise per dp shard — a replicated key
-            # would draw IDENTICAL continuations for equal logits across
-            # the dp batch shards
-            key = jax.random.fold_in(key, lax.axis_index("dp"))
+            # independent sampling noise per data-parallel shard — a
+            # replicated key would draw IDENTICAL continuations for equal
+            # logits across the batch shards (both dp tiers count)
+            dp_idx = lax.axis_index("dp")
+            if self._has_dcn:
+                dp_idx = lax.axis_index("dcn") * self.dp + dp_idx
+            key = jax.random.fold_in(key, dp_idx)
             stage_params = jax.tree.map(lambda a: a[0], params["stages"])
             dtype = c.compute_dtype
             caches_k = jnp.zeros((c.n_layers, Bl, S_max, Hs, c.head_dim), dtype)
@@ -767,7 +808,8 @@ class TransformerLM:
                 [jnp.swapaxes(toks_out, 0, 1), last[:, None]], axis=1)
             return jnp.concatenate([toks, gen], axis=1)
 
-        data_spec = P("dp", None)
+        data_spec = P(("dcn", "dp"), None) if self._has_dcn \
+            else P("dp", None)
         cache_key = ("generate", B, S0, max_new_tokens, float(temperature))
         fn = self._step_cache.get(cache_key)
         if fn is None:

@@ -244,10 +244,11 @@ class DASO:
         self._pending = None  # (apply_at_batch, bf16 slow-tier average)
         self._avg_fn = None
         self._blend_fn = None
-        # (fusion.quant_key(), fusion.chunk_key()) -> (packed capture
-        # program, its qinfo dict): codec/chunk toggles compile siblings,
-        # toggle-back re-hits the cached exact/unchunked program (same
-        # discipline as the model step caches)
+        # (fusion.quant_key(), fusion.chunk_key(), fusion.hier_key()) ->
+        # (packed capture program, its qinfo dict): codec/chunk/tier
+        # toggles compile siblings, toggle-back re-hits the cached
+        # exact/unchunked/flat program (same discipline as the model
+        # step caches)
         self._packed_avgs = {}
 
     @property
@@ -308,7 +309,7 @@ class DASO:
         self._blend_fn = jax.jit(
             lambda av, ps: jax.tree_util.tree_map(blend_leaf, av, ps))
 
-    def _build_packed_avg(self, quant=None, chunks=None):
+    def _build_packed_avg(self, quant=None, chunks=None, hier=None):
         """The packed (and quantizable) form of the slow-tier capture: ONE
         ``shard_map`` over the ``"dcn"`` axis combining EVERY leaf's bf16
         wire average in a single flattened collective
@@ -317,7 +318,15 @@ class DASO:
         of the one GSPMD all-reduce per parameter leaf the jitted
         ``tree_map`` mean emits. Wire semantics match the reference DASO
         contract exactly: parameters downcast to bf16 BEFORE the
-        inter-node reduction (``__prep_params_to_send`` ``:592``)."""
+        inter-node reduction (``__prep_params_to_send`` ``:592``).
+
+        Under ``HEAT_TPU_HIER`` the replicas are declared REPLICATED over
+        the fast ``"ici"`` axis (every device in a node group holds the
+        same replica), so the hierarchical exchange shards the DCN wire
+        payload over the node's devices: each device slices its own 1/ici
+        tile (zero collectives — the data already agrees), all-reduces
+        only that tile across DCN, and an ICI all-gather reassembles —
+        per-device DCN bytes drop by the ici factor."""
         from ..core import fusion
         from ..core._compat import shard_map
         from jax.sharding import PartitionSpec as P
@@ -329,6 +338,9 @@ class DASO:
             quant = fusion.quant_key()
         if chunks is None:
             chunks = fusion.chunk_key()
+        if hier is None:
+            hier = fusion.hier_key()
+        replicated = ("ici",) if (hier[0] and self.fast_size > 1) else ()
 
         def body(params):
             fusion.reset_qinfo(qinfo)
@@ -336,7 +348,8 @@ class DASO:
             # local block is (1, ...): this device's replica in wire dtype
             parts = [l[0].astype(cast) for l in leaves]
             packed = fusion.packed_psum(parts, ("dcn",), qinfo=qinfo,
-                                        quant=quant, chunks=chunks)
+                                        quant=quant, chunks=chunks,
+                                        hier=hier, replicated=replicated)
             return jax.tree_util.tree_unflatten(
                 treedef, [(p / slow).astype(cast) for p in packed])
 
@@ -360,7 +373,8 @@ class DASO:
                 and all(jnp.issubdtype(l.dtype, jnp.floating)
                         for l in jax.tree_util.tree_leaves(params)
                         if hasattr(l, "dtype"))):
-            key = (fusion.quant_key(), fusion.chunk_key())
+            key = (fusion.quant_key(), fusion.chunk_key(),
+                   fusion.hier_key())
             if key not in self._packed_avgs:
                 self._packed_avgs[key] = self._build_packed_avg(*key)
             fn, qinfo = self._packed_avgs[key]

@@ -79,7 +79,7 @@ def serve_transformer(model, params, seq_len: int,
     if config is None:
         # the forward runs the model's microbatch schedule, so every
         # bucket's per-device batch (bucket / dp) must divide n_micro too
-        q = model.dp * max(1, c.n_micro)
+        q = getattr(model, "dp_world", model.dp) * max(1, c.n_micro)
         config = ServeConfig(bucket_rows=Pow2Buckets(min_rows=q,
                                                      multiple_of=q))
     token = ("transformer", c.vocab, c.d_model, c.n_layers, seq_len,

@@ -104,6 +104,12 @@ SITES: Dict[str, tuple] = {
         "UNCHUNKED packed collective (for flushes via the cache key, "
         "hitting any cached unchunked program), counted in "
         "op_engine.chunk_fallbacks"),
+    "fusion.hier.exchange": (
+        FaultInjected,
+        "tier-aware hierarchical packed-collective planning (flush plan "
+        "and packed_psum) — degrades to the FLAT packed collective (for "
+        "flushes via the cache key, hitting any cached flat program), "
+        "counted in op_engine.hier_fallbacks"),
     # reshard planner (core/resharding.py)
     "reshard.plan.build": (
         FaultInjected,

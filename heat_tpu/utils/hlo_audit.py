@@ -116,9 +116,35 @@ def _group_size(line: str, world=None):
     instruction line, handling every replica-group form
     :func:`_moves_data` parses: brace-of-braces ``{{0,1},{2,3}}``, flat
     ``{0,1,2,3}``, EMPTY ``{}`` (one group of ALL replicas — resolved by
-    ``world``), and iota ``[G,S]<=[N]`` (``S`` participants per group).
-    ``None`` when the line carries no annotation or ``world`` is needed
-    but unknown — callers fall back conservatively."""
+    ``world``), and iota ``[G,S]<=[N]`` (``S`` participants per group,
+    transposed or not — a permutation changes membership, never group
+    size). ``None`` when the line carries no annotation or ``world`` is
+    needed but unknown — callers fall back conservatively. Brace forms
+    delegate to the ONE group-list parser (:func:`_group_list`)."""
+    tag = "replica_groups="
+    start = line.find(tag)
+    if start < 0:
+        return None
+    rest = line[start + len(tag):]
+    if rest.startswith("["):
+        # size directly from the iota shape — valid even for the
+        # transposed forms _group_list declines (it needs MEMBERSHIP)
+        m = _IOTA_GROUP_RE.match(rest)
+        return None if m is None else int(m.group(2))
+    groups = _group_list(line, world)
+    if groups is None:
+        return None
+    return max((len(g) for g in groups), default=None)
+
+
+def _group_list(line: str, world=None):
+    """ALL replica groups on one collective instruction line as a list
+    of participant-id tuples, or None when unparsable: brace-of-braces
+    ``{{0,1},{2,3}}``, flat ``{0,1,2,3}`` (one group), EMPTY ``{}`` (one
+    group of all replicas — resolved by ``world``), and the untransposed
+    iota form ``[G,S]<=[N]`` (G contiguous groups of S). The per-tier
+    classifier (:func:`_tier_of`) compares these against the declared
+    tier factorization's expected group sets."""
     tag = "replica_groups="
     start = line.find(tag)
     if start < 0:
@@ -126,7 +152,17 @@ def _group_size(line: str, world=None):
     rest = line[start + len(tag):]
     if rest.startswith("["):
         m = _IOTA_GROUP_RE.match(rest)
-        return None if m is None else int(m.group(2))
+        if m is None:
+            return None
+        # a transpose suffix (``[G,S]<=[N]T(1,0)``) permutes the iota —
+        # the contiguous reconstruction below would be WRONG for it, so
+        # those lines decline (tier "other"); the suffix sits right
+        # after the closing bracket of ``<=[N]``
+        close = rest.find("]", m.end())
+        if close >= 0 and rest[close + 1:close + 3] == "T(":
+            return None
+        g, s = int(m.group(1)), int(m.group(2))
+        return [tuple(range(k * s, (k + 1) * s)) for k in range(g)]
     if not rest.startswith("{"):
         return None
     depth = 0
@@ -139,15 +175,58 @@ def _group_size(line: str, world=None):
                 body = rest[1:j]
                 groups = _ONE_GROUP_RE.findall(body)
                 if groups:
-                    return max(len([p for p in g.split(",") if p.strip()])
-                               for g in groups)
+                    return [tuple(int(p) for p in g.split(",") if p.strip())
+                            for g in groups]
                 if not body.strip():
-                    return world  # empty = one group of all replicas
-                return len([p for p in body.split(",") if p.strip()])
+                    return None if world is None \
+                        else [tuple(range(int(world)))]
+                return [tuple(int(p) for p in body.split(",")
+                              if p.strip())]
     return None
 
 
-def collective_bytes(hlo: str, world: int = None) -> dict:
+def _tier_of(groups, d: int, i: int, world: int) -> str:
+    """Classify one collective's replica groups against a declared
+    ``(d, i)`` tier factorization (device order dcn-major, like
+    ``jax.devices()`` on a pod): ``"ici"`` = the d contiguous i-device
+    host groups (the fast tier), ``"dcn"`` = the i strided d-device
+    cross-host groups (the slow tier), ``"full"`` = one group spanning
+    the whole mesh, ``"none"`` = singleton groups (identity collectives,
+    zero wire), ``"other"`` = anything else (sub-mesh programs)."""
+    gs = {tuple(g) for g in groups}
+    if all(len(g) <= 1 for g in gs):
+        return "none"
+    if gs == {tuple(range(h * i, (h + 1) * i)) for h in range(d)}:
+        return "ici"
+    if gs == {tuple(range(j, world, i)) for j in range(i)}:
+        return "dcn"
+    if len(gs) == 1 and len(next(iter(gs))) == world:
+        return "full"
+    return "other"
+
+
+def _dcn_wire(kind: str, rbytes: int, tier: str, d: int) -> int:
+    """Modeled per-device bytes CROSSING THE SLOW (DCN) TIER for one
+    collective instruction. A ``"dcn"``-tier instruction's whole ring
+    wire is slow-tier traffic; an ``"ici"``/``"none"`` instruction's is
+    zero; a ``"full"``-mesh (or unclassified) collective is charged the
+    ring formula evaluated at group size ``d`` — the payload that must
+    cross between the d host groups however the flat ring is laid out
+    (for an all-reduce, ``2R(d-1)/d``: the standard hierarchical lower
+    bound the tiered decomposition then beats by shrinking ``R``)."""
+    if tier in ("ici", "none") or d <= 1:
+        return 0
+    g = d
+    if kind == "all-reduce":
+        return 2 * rbytes * (g - 1) // g
+    if kind == "reduce-scatter":
+        return rbytes * (g - 1)
+    if kind in ("all-gather", "all-to-all"):
+        return rbytes * (g - 1) // g
+    return rbytes  # collective-permute
+
+
+def collective_bytes(hlo: str, world: int = None, tiers=None) -> dict:
     """Per-collective byte accounting over an optimized-HLO dump:
     element type × result shape × communicating replica groups.
 
@@ -181,7 +260,25 @@ def collective_bytes(hlo: str, world: int = None) -> dict:
     Returns ``{"per_instruction": [{kind, result_bytes, group_size,
     wire_bytes}, ...], "by_kind": {kind: {count, result_bytes,
     wire_bytes}}, "total_result_bytes", "total_wire_bytes"}``.
+
+    With ``tiers=(d, i)`` (a declared dcn×ici factorization of ``world``
+    — the simulated 2-host mesh, or ``HEAT_TPU_MESH_TIERS`` on a real
+    pod) every instruction additionally carries its ``tier``
+    (``"ici"``/``"dcn"``/``"full"``/``"none"``/``"other"``, classified
+    by replica-group structure — :func:`_tier_of`) and
+    ``dcn_wire_bytes`` (the modeled slow-tier crossing, :func:`_dcn_wire`
+    — a flat full-mesh all-reduce is charged ``2R(d-1)/d``), plus
+    ``by_tier`` aggregates and ``total_dcn_wire_bytes``: the DCN column
+    the hierarchical-collective acceptance audits compare flat vs
+    decomposed plans on.
     """
+    if tiers is not None:
+        d, i = int(tiers[0]), int(tiers[1])
+        if world is None:
+            world = d * i
+        elif d * i != int(world):
+            raise ValueError(
+                f"tiers {tiers} do not factor world {world}")
     per = []
     for line in hlo.splitlines():
         stripped = _COMMENT_RE.sub("", line)
@@ -204,8 +301,15 @@ def collective_bytes(hlo: str, world: int = None) -> dict:
             wire = rbytes * (g - 1) // g
         else:  # collective-permute: one send of the payload
             wire = rbytes
-        per.append({"kind": kind, "result_bytes": rbytes,
-                    "group_size": g, "wire_bytes": wire})
+        rec = {"kind": kind, "result_bytes": rbytes,
+               "group_size": g, "wire_bytes": wire}
+        if tiers is not None:
+            groups = _group_list(stripped, world)
+            tier = ("other" if groups is None
+                    else _tier_of(groups, d, i, int(world)))
+            rec["tier"] = tier
+            rec["dcn_wire_bytes"] = _dcn_wire(kind, rbytes, tier, d)
+        per.append(rec)
     by_kind: Dict[str, Dict[str, int]] = {}
     for rec in per:
         agg = by_kind.setdefault(
@@ -213,9 +317,22 @@ def collective_bytes(hlo: str, world: int = None) -> dict:
         agg["count"] += 1
         agg["result_bytes"] += rec["result_bytes"]
         agg["wire_bytes"] += rec["wire_bytes"]
-    return {"per_instruction": per, "by_kind": by_kind,
-            "total_result_bytes": sum(r["result_bytes"] for r in per),
-            "total_wire_bytes": sum(r["wire_bytes"] for r in per)}
+    out = {"per_instruction": per, "by_kind": by_kind,
+           "total_result_bytes": sum(r["result_bytes"] for r in per),
+           "total_wire_bytes": sum(r["wire_bytes"] for r in per)}
+    if tiers is not None:
+        by_tier: Dict[str, Dict[str, int]] = {}
+        for rec in per:
+            agg = by_tier.setdefault(
+                rec["tier"],
+                {"count": 0, "wire_bytes": 0, "dcn_wire_bytes": 0})
+            agg["count"] += 1
+            agg["wire_bytes"] += rec["wire_bytes"]
+            agg["dcn_wire_bytes"] += rec["dcn_wire_bytes"]
+        out["by_tier"] = by_tier
+        out["total_dcn_wire_bytes"] = sum(
+            r["dcn_wire_bytes"] for r in per)
+    return out
 
 
 _ROOT_ASSIGN_RE = re.compile(r"^\s*ROOT\s+%?[\w.\-]+\s*=\s*")

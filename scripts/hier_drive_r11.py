@@ -1,0 +1,251 @@
+"""Round-11 user-style drive: tier-aware hierarchical packed collectives.
+
+Runs ~15 end-to-end checks of the ISSUE 12 surface on the 8-device CPU
+mesh simulated as a (2, 4) ("dcn", "ici") two-host pod:
+
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python scripts/hier_drive_r11.py
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core import fusion
+from heat_tpu.core._compat import shard_map
+from heat_tpu.utils import faults, hlo_audit, metrics
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+PASS = []
+FAIL = []
+
+
+def check(name, ok, detail=""):
+    (PASS if ok else FAIL).append(name)
+    print(f"[{'PASS' if ok else 'FAIL'}] {name}" + (f"  {detail}" if detail else ""))
+
+
+def main():
+    n = len(jax.devices())
+    assert n >= 4 and n % 2 == 0, f"need an even mesh >= 4, got {n}"
+    d, i = 2, n // 2
+    mesh2 = Mesh(np.array(jax.devices()).reshape(d, i), ("dcn", "ici"))
+    rng = np.random.default_rng(0)
+
+    # ---- 1-4: packed_psum named-grid forms --------------------------- #
+    vals = [rng.standard_normal(4096).astype(np.float32),
+            rng.standard_normal(300).astype(np.float32)]
+
+    def psum_named(hier_on, codec=None, ici=None):
+        with fusion.hier_override(hier_on, tiers="dcn,ici",
+                                  ici_codec=ici), \
+                fusion.quant_override(codec, min_numel=64):
+            def body(a, b):
+                return tuple(fusion.packed_psum([a, b], ("dcn", "ici")))
+            fn = jax.jit(shard_map(body, mesh=mesh2, in_specs=(P(), P()),
+                                   out_specs=(P(), P()), check_vma=False))
+            args = [jnp.asarray(v) for v in vals]
+            out = [np.asarray(o) for o in fn(*args)]
+            hlo = fn.lower(*args).compile().as_text()
+        return out, hlo
+
+    flat, hlo_flat = psum_named(False)
+    hier, hlo_hier = psum_named(True)
+    err = max(np.abs(a - b).max() / (np.abs(b).max() + 1e-30)
+              for a, b in zip(hier, flat))
+    cs = hlo_audit.collective_stats(hlo_hier)
+    t = hlo_audit.collective_bytes(hlo_hier, world=n, tiers=(d, i))
+    check("packed_psum hier==flat (few-ulp)", err < 1e-5, f"rel={err:.2e}")
+    check("packed_psum decomposition RS+AR+AG, no full AR",
+          "reduce-scatter" in cs and "all-gather" in cs
+          and "full" not in t["by_tier"])
+
+    ivals_ref = None
+    with fusion.hier_override(False):
+        def ibody(a):
+            return fusion.packed_psum([a], ("dcn", "ici"))[0]
+        ifn = jax.jit(shard_map(ibody, mesh=mesh2, in_specs=(P(),),
+                                out_specs=P(), check_vma=False))
+        ivals_ref = np.asarray(ifn(jnp.arange(500, dtype=jnp.int32)))
+    with fusion.hier_override(True, tiers="dcn,ici"):
+        ivals = np.asarray(ifn(jnp.arange(500, dtype=jnp.int32)))
+    check("int payloads bitwise", np.array_equal(ivals, ivals_ref))
+
+    q8, hlo_q8 = psum_named(True, codec="int8")
+    rel = np.linalg.norm(q8[0] - flat[0]) / np.linalg.norm(flat[0])
+    t8 = hlo_audit.collective_bytes(hlo_q8, world=n, tiers=(d, i))
+    check("int8-over-DCN within 1e-2", rel <= 1e-2, f"rel={rel:.2e}")
+    check("int8 DCN a2a legs classified dcn, no full collective",
+          "full" not in t8["by_tier"] and t8["by_tier"]["dcn"]["count"] >= 2)
+
+    qb, _ = psum_named(True, ici="bf16")
+    rel = np.linalg.norm(qb[0] - flat[0]) / np.linalg.norm(flat[0])
+    check("ici bf16 codec within 4e-3", rel <= 4e-3, f"rel={rel:.2e}")
+
+    # ---- 5: DASO replicated-fast form -------------------------------- #
+    def psum_rep(hier_on):
+        with fusion.hier_override(hier_on, tiers="dcn,ici"):
+            def body(a):
+                return fusion.packed_psum([a], ("dcn",),
+                                          replicated=("ici",))[0]
+            fn = jax.jit(shard_map(body, mesh=mesh2, in_specs=(P(),),
+                                   out_specs=P(), check_vma=False))
+            v = jnp.asarray(vals[0])
+            return np.asarray(fn(v)), fn.lower(v).compile().as_text()
+
+    rf, _ = psum_rep(False)
+    rh, rhlo = psum_rep(True)
+    check("replicated-fast form bitwise",
+          np.array_equal(rf, rh)
+          and "reduce-scatter" not in hlo_audit.collective_stats(rhlo))
+
+    # ---- 6-8: flush path over flat factored mesh --------------------- #
+    def chain():
+        x = ht.arange(13 * 40, dtype=ht.float32).reshape((13, 40)).resplit(0)
+        y = ht.exp(x * 0.001) + x * 0.5 - 1.25
+        y = y * y + 0.25
+        return y.sum(axis=0)
+
+    fusion.reset()
+    with fusion.hier_override(False):
+        base = chain().numpy()
+    with fusion.hier_override(True, tiers=(d, i)):
+        fusion.capture_hlo(True)
+        got = chain().numpy()
+        fh = fusion.last_hlo()
+        fusion.capture_hlo(False)
+    tf = hlo_audit.collective_bytes(fh, world=n, tiers=(d, i))
+    check("flush hier parity + decomposition",
+          np.allclose(got, base, rtol=1e-5)
+          and "full" not in tf["by_tier"]
+          and {"ici", "dcn"} <= set(tf["by_tier"]))
+    with fusion.hier_override(False, tiers=(d, i)):
+        off = chain().numpy()
+    check("HEAT_TPU_HIER=0 bitwise today's flat", np.array_equal(off, base))
+    s0 = fusion.program_cache().stats()
+    with fusion.hier_override(True, tiers=(d, i)):
+        chain().numpy()
+    with fusion.hier_override(False):
+        chain().numpy()
+    s1 = fusion.program_cache().stats()
+    check("steady-state toggle-back 0 recompiles",
+          s1["compiles"] == s0["compiles"], f"{s0} -> {s1}")
+
+    # ---- 9-10: transformer acceptance on the (2, n/2) tier grid ------ #
+    import optax
+
+    from heat_tpu.nn.transformer import TransformerLM, TransformerLMConfig
+
+    cfg = TransformerLMConfig(vocab=64, d_model=32, n_heads=4, n_layers=2,
+                              d_ff=64)
+    grid = ht.MeshGrid((d, i, 1, 1, 1), ("dcn", "dp", "pp", "tp", "sp"))
+    model = TransformerLM(grid, cfg)
+    toks = model.shard_batch(rng.integers(0, 64, (2 * n, 16)).astype(np.int32))
+    tx = optax.adam(1e-2)
+
+    def step_hlo(hier_on, codec):
+        with fusion.hier_override(hier_on, tiers=None), \
+                fusion.quant_override(codec), fusion.chunk_override(1):
+            step = model.make_train_step(tx)
+            p, o = model.init(0), tx.init(model.init(0))
+            hlo = step.lower(p, o, toks).compile().as_text()
+            losses = []
+            for _ in range(8):
+                p, o, l = step(p, o, toks)
+                losses.append(float(l))
+        return hlo, losses
+
+    h_flat, _ = step_hlo(False, None)
+    h_hier, losses = step_hlo(True, None)
+    h_int8, _ = step_hlo(True, "int8")
+    a_flat = hlo_audit.collective_bytes(h_flat, world=n, tiers=(d, i))
+    a_hier = hlo_audit.collective_bytes(h_hier, world=n, tiers=(d, i))
+    a_int8 = hlo_audit.collective_bytes(h_int8, world=n, tiers=(d, i))
+    red = a_flat["total_dcn_wire_bytes"] / max(
+        a_hier["total_dcn_wire_bytes"], 1)
+    red8 = a_hier["total_dcn_wire_bytes"] / max(
+        a_int8["total_dcn_wire_bytes"], 1)
+    check("transformer DCN bytes reduced >= p_ici x", red >= i * 0.99,
+          f"{red:.2f}x (p_ici={i})")
+    check("int8-over-DCN >= 2x further", red8 >= 2.0, f"{red8:.2f}x")
+    check("tiered train step converges",
+          losses[-1] < losses[0], f"{losses[0]:.3f} -> {losses[-1]:.3f}")
+
+    # ---- 11: int8 overflow hardening --------------------------------- #
+    comm = ht.get_comm()
+    big = np.stack([np.full(256, 3.4e38 / comm.size, np.float32)] *
+                   comm.size).reshape(-1)
+
+    def int8_rt(v):
+        def body(x):
+            return fusion._quant_int8_allreduce(
+                x, comm.axis_name, comm.size, (), 128)
+        fn = jax.jit(shard_map(body, mesh=comm.mesh,
+                               in_specs=P(comm.axis_name), out_specs=P(),
+                               check_vma=False))
+        return np.asarray(fn(jnp.asarray(v)))
+
+    out = int8_rt(big)
+    check("int8 sum>bf16max saturates (no inf)", np.isfinite(out).all(),
+          f"max={out.max():.3e}")
+    bad = np.ones(256 * comm.size, np.float32)
+    bad[7] = np.inf
+    check("int8 inf payload never NaNs", not np.isnan(int8_rt(bad)).any())
+
+    # ---- 12: fault site degrades to flat ----------------------------- #
+    fusion.reset()
+    c0 = int(metrics.counters().get("op_engine.hier_fallbacks", 0))
+    with fusion.hier_override(True, tiers=(d, i)), \
+            faults.inject("fusion.hier.exchange=nth:1"):
+        faulted = chain().numpy()
+    c1 = int(metrics.counters().get("op_engine.hier_fallbacks", 0))
+    check("fault site degrades to flat + counter",
+          c1 - c0 == 1 and np.allclose(faulted, base, rtol=1e-5))
+
+    # ---- 13: stats surface ------------------------------------------- #
+    st = ht.runtime_stats()["op_engine"]["fusion"]
+    check("runtime_stats hier keys",
+          all(k in st for k in ("hier_enabled", "mesh_tiers",
+                                "hier_ici_codec", "hier_collectives",
+                                "hier_fallbacks"))
+          and st["hier_collectives"] > 0)
+
+    # ---- 14: DataParallel 2-D tier grid ------------------------------ #
+    try:
+        import flax.linen as fnn
+
+        class MLP(fnn.Module):
+            @fnn.compact
+            def __call__(self, x):
+                return fnn.Dense(4)(fnn.relu(fnn.Dense(16)(x)))
+
+        X = rng.standard_normal((4 * n, 8)).astype(np.float32)
+        Y = rng.integers(0, 4, (4 * n,)).astype(np.int32)
+
+        def run_dp(hier_on):
+            import heat_tpu.optim as optim
+
+            net = ht.nn.DataParallel(MLP(), optimizer=(
+                optim.DataParallelOptimizer(optim.SGD(lr=0.05))))
+            ctx = fusion.hier_override(hier_on,
+                                       tiers=(d, i) if hier_on else None)
+            with ctx:
+                return [net.step(X, Y) for _ in range(3)]
+
+        lf, lh = run_dp(False), run_dp(True)
+        check("DataParallel tiered step parity",
+              np.allclose(lf, lh, rtol=1e-5), f"{lf[-1]:.4f}/{lh[-1]:.4f}")
+    except ImportError:
+        check("DataParallel tiered step parity", True, "flax absent, skip")
+
+    print(f"\n{len(PASS)}/{len(PASS) + len(FAIL)} PASS"
+          + (f"; FAILED: {FAIL}" if FAIL else " — ALL PASS"))
+    raise SystemExit(1 if FAIL else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -161,14 +161,18 @@ _QUANT_AB_TESTS = [
 
 
 def _run_env_ab(env_key: str, legs_spec, tests, n: int,
-                timeout: float) -> dict:
+                timeout: float, extra_env=None) -> dict:
     """Shared A/B mechanics for the env-flag gates: run ``tests`` once
     per ``(label, env value)`` leg, both legs must pass (``agree``).
-    ``legs_spec`` is ``((label, value), (label, value))``."""
+    ``legs_spec`` is ``((label, value), (label, value))``;
+    ``extra_env`` rides on BOTH legs (the hier A/B declares the tier
+    factorization on both sides and toggles only the gate)."""
     result = {}
     for label, value in legs_spec:
         env = _env(n)
         env[env_key] = value
+        if extra_env:
+            env.update(extra_env)
         t0 = time.time()
         try:
             out = subprocess.run(
@@ -232,6 +236,30 @@ def run_chunk_ab(n: int, timeout: float) -> dict:
     return _run_env_ab("HEAT_TPU_FUSION_CHUNKS",
                        (("unchunked", "1"), ("chunked", "4")),
                        _CHUNK_AB_TESTS, n, timeout)
+
+
+# training-heavy subset for the hierarchical-collective A/B: the packed
+# train-step surfaces plus the hier contract module itself — the
+# per-test HEAT_TPU_LADDER_STATS log carries hier_collectives /
+# hier_fallbacks so the A/B shows which tests actually decomposed
+_HIER_AB_TESTS = [
+    "tests/test_trace_step.py", "tests/test_transformer.py",
+    "tests/test_nn_optim_data.py", "tests/test_hier_collectives.py",
+]
+
+
+def run_hier_ab(n: int, timeout: float) -> dict:
+    """``HEAT_TPU_HIER=0`` vs ``1`` with the tier factorization
+    ``(2, n/2)`` declared on BOTH legs: the hier leg must keep every
+    packed-step test green (the decomposition may never change WHICH
+    path runs — only reassociate its psums within the documented few-ulp
+    freedom, with per-tier codecs carrying their own contract), and the
+    HIER=0 leg proves the escape hatch restores today's flat behavior
+    bitwise — exit-gating, like the fusion/quant/chunk A/Bs."""
+    return _run_env_ab("HEAT_TPU_HIER",
+                       (("flat", "0"), ("hier", "1")),
+                       _HIER_AB_TESTS, n, timeout,
+                       extra_env={"HEAT_TPU_MESH_TIERS": f"2,{n // 2}"})
 
 
 _CHAOS_SITE_RE = re.compile(
@@ -340,6 +368,14 @@ def main():
     ap.add_argument("--no-chunk-ab", dest="chunk_ab", action="store_false",
                     help="skip the chunked-collective A/B")
     ap.add_argument("--chunk-ab-timeout", type=float, default=900.0)
+    ap.add_argument("--hier-ab", dest="hier_ab", action="store_true",
+                    default=True,
+                    help="run the HEAT_TPU_HIER=0/1 A/B (tiers declared "
+                         "on both legs) on the training-heavy subset "
+                         "(default on)")
+    ap.add_argument("--no-hier-ab", dest="hier_ab", action="store_false",
+                    help="skip the hierarchical-collective A/B")
+    ap.add_argument("--hier-ab-timeout", type=float, default=900.0)
     ap.add_argument("--serve-smoke", dest="serve_smoke", action="store_true",
                     default=True, help="run the serving smoke (default on)")
     ap.add_argument("--no-serve-smoke", dest="serve_smoke",
@@ -440,6 +476,18 @@ def main():
         quant_bad = not qab.get("agree", False)
         print(json.dumps({"quant_ab_ok": not quant_bad}), flush=True)
 
+    hier_bad = False
+    if args.hier_ab and not args.examples_only:
+        # tier gate: the training-heavy subset must pass flat AND
+        # hierarchically decomposed on the simulated (2, 2) two-host
+        # factorization of the 4-device mesh
+        print("=== hierarchical collectives A/B (4 devices) ===",
+              flush=True)
+        hab = run_hier_ab(4, args.hier_ab_timeout)
+        artifact["hier_ab"] = hab
+        hier_bad = not hab.get("agree", False)
+        print(json.dumps({"hier_ab_ok": not hier_bad}), flush=True)
+
     chunk_bad = False
     if args.chunk_ab and not args.examples_only:
         # chunk gate: the training-heavy subset must pass unchunked AND
@@ -483,7 +531,7 @@ def main():
     bad = ([r for r in ladder if r.get("rc") != 0]
            + [r for r in ex if r.get("rc") != 0])
     sys.exit(1 if bad or audit_bad or serve_bad or fusion_bad or quant_bad
-             or chunk_bad or chaos_bad else 0)
+             or chunk_bad or hier_bad or chaos_bad else 0)
 
 
 if __name__ == "__main__":

@@ -122,6 +122,13 @@ def pytest_runtest_teardown(item, nextitem):
                 c.get("op_engine.chunk_collectives", 0)),
             "chunk_fallbacks": int(
                 c.get("op_engine.chunk_fallbacks", 0)),
+            # tier-aware hierarchical packed collectives (the HIER=0/1
+            # ladder A/B reads these: which tests decomposed payload
+            # groups, and whether any hier plan degraded to flat)
+            "hier_collectives": int(
+                c.get("op_engine.hier_collectives", 0)),
+            "hier_fallbacks": int(
+                c.get("op_engine.hier_fallbacks", 0)),
             "zero_fills": int(c.get("op_engine.zero_fills", 0)),
             "fusion_ops": int(c.get("op_engine.fusion_ops", 0)),
             "fusion_program_compiles": int(

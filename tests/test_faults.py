@@ -44,6 +44,7 @@ FALLBACK_COUNTERS = (
     "op_engine.fusion_step_fallbacks",
     "op_engine.quant_fallbacks",
     "op_engine.chunk_fallbacks",
+    "op_engine.hier_fallbacks",
     "resharding.plan_build_fallbacks",
     "resharding.dispatch_fallbacks",
     "serve.batch_retries",
@@ -68,6 +69,7 @@ MATRIX = {
     "fusion.step.dispatch": ("train", None, 0),
     "fusion.quant.encode": ("quant", "op_engine.quant_fallbacks", 1),
     "fusion.chunk.dispatch": ("chunk", "op_engine.chunk_fallbacks", 1),
+    "fusion.hier.exchange": ("hier", "op_engine.hier_fallbacks", 1),
     "reshard.plan.build": ("resplit", "resharding.plan_build_fallbacks", 1),
     "reshard.dispatch": ("resplit", "resharding.dispatch_fallbacks", 1),
     "serve.worker.batch": ("serve", "serve.worker_backstops", 1),
@@ -180,6 +182,27 @@ def _wl_chunk(tmp_path):
         return {"r": r.numpy()}, {}
 
 
+def _wl_hier(tmp_path):
+    """A hierarchically decomposed packed flush collective (tiers
+    ``(2, n/2)`` declared over the flat mesh): op chain into a
+    split-axis reduction whose packed psum the body emits as
+    reduce-scatter(ici) → all-reduce(dcn) → all-gather(ici). The faulted
+    leg degrades to the FLAT packed collective via the cache key; the
+    decomposition is a pure psum reassociation (few-ulp on floats), so
+    both legs agree within the harness's allclose contract."""
+    fusion.reset()
+    comm = ht.get_comm()
+    # (2, 1) parses but never decomposes — the workload stays runnable
+    # (flat) on meshes the chaos row skips (size < 4 / odd)
+    with fusion.hier_override(True, tiers=(2, max(1, comm.size // 2))):
+        x = ht.arange(13 * 40, dtype=ht.float32, split=None)
+        x = x.reshape((13, 40)).resplit(0)
+        y = ht.exp(x * 0.001) + x * 0.5 - 1.25
+        y = y * y + 0.25
+        r = y.sum(axis=0)
+        return {"r": r.numpy()}, {}
+
+
 def _wl_resplit(tmp_path):
     """Eager planner path (fusion off so reshard() itself is exercised,
     plan cache reset so the build site is reached)."""
@@ -256,7 +279,8 @@ def _wl_init(tmp_path):
 
 
 _WORKLOADS = {"ops": _wl_ops, "train": _wl_train, "quant": _wl_quant,
-              "chunk": _wl_chunk, "resplit": _wl_resplit,
+              "chunk": _wl_chunk, "hier": _wl_hier,
+              "resplit": _wl_resplit,
               "serve": _wl_serve, "ckpt": _wl_ckpt, "init": _wl_init}
 
 _BASELINES: dict = {}  # workload name -> fault-free payload (per session)
@@ -297,6 +321,10 @@ def test_chaos_site(site, tmp_path):
     if site == "fusion.chunk.dispatch" and ht.get_comm().size == 1:
         pytest.skip("single-device mesh emits no communicating psum to "
                     "chunk")
+    if site == "fusion.hier.exchange" and (
+            ht.get_comm().size < 4 or ht.get_comm().size % 2):
+        pytest.skip("hierarchical decomposition needs a (2, n/2) "
+                    "factorable mesh (n >= 4, even)")
     want = _baseline(wl_name, tmp_path)
     before = _snap()
     fires_before = _fires(site)

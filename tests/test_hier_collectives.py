@@ -1,0 +1,732 @@
+"""Tier-aware hierarchical packed collectives (``HEAT_TPU_HIER`` +
+``HEAT_TPU_MESH_TIERS``, ISSUE 12).
+
+The contract under test (doc/fusion.md "Hierarchical collectives"):
+
+* with tiers declared — a named grid's ``"dcn"`` axis or a flat mesh's
+  ``(d, i)`` factorization — every packed psum decomposes as
+  reduce-scatter(ici) → all-reduce(dcn) on the 1/p_ici shard →
+  all-gather(ici), with NO flat full-mesh all-reduce left (the
+  generalized-allreduce decomposition, arXiv:2004.09362);
+* the wire codec is selected PER TIER (EQuARX, arXiv:2506.17615): the
+  DCN leg carries the quant codec (int8 block-scaled / bf16), the ICI
+  legs stay exact (or bf16 under ``HEAT_TPU_HIER_ICI_CODEC``);
+* per-tier ``hlo_audit.collective_bytes(..., tiers=(d, i))`` shows
+  DCN-tier wire bytes reduced ≥ p_ici× vs the flat plan at the same
+  codec, and ≥ 2× further with int8-over-DCN, while gradients stay
+  within the pinned 1e-2 contract;
+* ``HEAT_TPU_HIER=0`` (and an undeclared mesh) is bitwise today's flat
+  behavior; the hier configuration keys every program cache next to
+  ``quant_key()``/``chunk_key()`` — toggling compiles siblings, toggling
+  back re-hits (steady-state recompiles 0 including codec/tier toggling);
+* values: the decomposition re-associates the flat psum — bitwise for
+  integer payloads, few-ulp for floats; DASO's replicated-fast form is
+  value-bitwise (no reassociation: each element still reduces over
+  exactly its dcn group);
+* counters (``op_engine.hier_collectives`` / ``hier_fallbacks``) tick
+  per dispatch and surface in ``runtime_stats()``.
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.core import fusion
+from heat_tpu.core._compat import shard_map
+from heat_tpu.utils import hlo_audit, metrics
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _tiers_or_skip():
+    """The simulated (2, n/2) two-host factorization of this mesh."""
+    n = ht.MESH_WORLD.size
+    if n < 4 or n % 2:
+        pytest.skip("hierarchical decomposition needs a (2, n/2) "
+                    "factorable mesh (n >= 4, even)")
+    return 2, n // 2
+
+
+def _counters(*keys):
+    c = metrics.counters()
+    return tuple(int(c.get(k, 0)) for k in keys)
+
+
+def _ulp_equal(a, b, ulps=8):
+    a, b = np.asarray(a), np.asarray(b)
+    if a.dtype.kind in "iub":
+        np.testing.assert_array_equal(a, b)
+        return
+    ai = a.view({2: np.int16, 4: np.int32, 8: np.int64}[a.dtype.itemsize])
+    bi = b.view(ai.dtype)
+    assert np.all(np.abs(ai.astype(np.int64) - bi.astype(np.int64))
+                  <= ulps), float(np.abs(a - b).max())
+
+
+# --------------------------------------------------------------------- #
+# declaration grammar + pure-model units (no compiles)                   #
+# --------------------------------------------------------------------- #
+class TestTierSpec:
+    def test_parse_factor_and_name_forms(self):
+        assert fusion._parse_tiers("2,4") == (2, 4)
+        assert fusion._parse_tiers("dcn,ici") == ("dcn", "ici")
+        assert fusion._parse_tiers("dcn") == ("dcn",)
+        assert fusion._parse_tiers(None) is None
+        assert fusion._parse_tiers("0") is None
+        assert fusion._parse_tiers("") is None
+
+    def test_parse_rejects_bad_forms(self):
+        with pytest.raises(ValueError):
+            fusion._parse_tiers("2,4,8")        # 3 factors
+        with pytest.raises(ValueError):
+            fusion._parse_tiers("dcn,4")        # mixed names/sizes
+        with pytest.raises(ValueError):
+            fusion._parse_tiers("2,0")          # non-positive
+
+    def test_ici_codec_grammar(self):
+        assert fusion._parse_ici_codec(None) is None
+        assert fusion._parse_ici_codec("bf16") == "bf16"
+        assert fusion._parse_ici_codec("0") is None
+        with pytest.raises(ValueError):
+            fusion._parse_ici_codec("int8")     # slow-tier-only codec
+
+    def test_set_mesh_tiers_round_trip_and_key(self):
+        prev = fusion.set_mesh_tiers((2, 4))
+        try:
+            assert fusion.mesh_tiers() == (2, 4)
+            hk = fusion.hier_key()
+            assert hk[1] == (2, 4) and isinstance(hk[0], bool)
+        finally:
+            fusion.set_mesh_tiers(
+                ",".join(str(s) for s in prev) if prev else None)
+
+    def test_hier_factor_matches_and_declines(self):
+        hk = (True, (2, 4), None)
+        assert fusion._hier_factor(8, hk) == (2, 4)
+        assert fusion._hier_factor(6, hk) is None       # mismatch
+        assert fusion._hier_factor(8, (True, ("dcn",), None)) is None
+        assert fusion._hier_factor(8, (True, None, None)) is None
+
+    def test_slow_axis_name(self):
+        assert fusion._slow_axis_name((True, None, None)) == "dcn"
+        assert fusion._slow_axis_name((True, ("slow", "fast"),
+                                       None)) == "slow"
+        assert fusion._slow_axis_name((True, (2, 4), None)) == "dcn"
+
+
+class TestTierClassifier:
+    def test_transposed_iota_declines_membership(self):
+        """A transposed iota replica group permutes MEMBERSHIP — the
+        classifier must not read it as contiguous ici groups (review
+        finding: the guard's slice was one char short), while the group
+        SIZE stays valid for the wire model."""
+        line = ("  %ar = f32[16]{0} all-reduce(f32[16]{0} %x), "
+                "replica_groups=[2,4]<=[8]T(1,0), to_apply=%add")
+        rec = hlo_audit.collective_bytes(
+            line, world=8, tiers=(2, 4))["per_instruction"][0]
+        assert rec["tier"] == "other"
+        assert rec["group_size"] == 4
+        assert rec["dcn_wire_bytes"] > 0  # conservative slow-tier charge
+        rec2 = hlo_audit.collective_bytes(
+            line.replace("T(1,0)", ""), world=8,
+            tiers=(2, 4))["per_instruction"][0]
+        assert rec2["tier"] == "ici"
+        assert rec2["dcn_wire_bytes"] == 0
+
+    def test_tier_of_group_forms(self):
+        assert hlo_audit._tier_of([(0, 1, 2, 3), (4, 5, 6, 7)],
+                                  2, 4, 8) == "ici"
+        assert hlo_audit._tier_of([(0, 4), (1, 5), (2, 6), (3, 7)],
+                                  2, 4, 8) == "dcn"
+        assert hlo_audit._tier_of([tuple(range(8))], 2, 4, 8) == "full"
+        assert hlo_audit._tier_of([(0,), (1,)], 2, 4, 8) == "none"
+        assert hlo_audit._tier_of([(0, 2), (1, 3)], 2, 4, 8) == "other"
+
+
+class TestHierWireModel:
+    def test_hier_beats_flat_and_dcn_leg_shrinks_pf_fold(self):
+        numels, itemsize, pf, ps = [4096, 1024], 4, 4, 2
+        exact, hier = fusion._hier_wire_bytes(numels, itemsize, None,
+                                              None, pf, ps, 128)
+        raw = sum(numels) * itemsize
+        g = pf * ps
+        assert exact == 2 * raw * (g - 1) // g
+        # the slow leg carries exactly 1/pf of the payload: flat's
+        # DCN-crossing model 2R(ps-1)/ps shrinks pf-fold
+        flat_dcn = 2 * raw * (ps - 1) // ps
+        hier_dcn = 2 * (raw // pf) * (ps - 1) // ps
+        assert flat_dcn == pf * hier_dcn
+        assert hier < exact + flat_dcn  # sanity: model totals coherent
+
+    def test_int8_dcn_leg_at_least_halves_slow_bytes(self):
+        numels, pf, ps, block = [8192], 4, 2, 128
+        _, hier_exact = fusion._hier_wire_bytes(numels, 4, None, None,
+                                                pf, ps, block)
+        _, hier_int8 = fusion._hier_wire_bytes(numels, 4, "int8", None,
+                                               pf, ps, block)
+        fast = 2 * sum(numels) * 4 * (pf - 1) // pf
+        assert (hier_exact - fast) >= 2 * (hier_int8 - fast)
+
+
+# --------------------------------------------------------------------- #
+# packed_psum over a named ("dcn", "ici") grid                           #
+# --------------------------------------------------------------------- #
+def _named_mesh(d, i):
+    return Mesh(np.array(jax.devices()).reshape(d, i), ("dcn", "ici"))
+
+
+def _psum_named(mesh, vals, hier_on, codec=None, ici=None,
+                replicated=()):
+    axes = ("dcn",) if replicated else ("dcn", "ici")
+    with fusion.hier_override(hier_on, tiers="dcn,ici", ici_codec=ici), \
+            fusion.quant_override(codec, min_numel=64):
+
+        def body(*parts):
+            return tuple(fusion.packed_psum(list(parts), axes,
+                                            replicated=replicated))
+
+        fn = jax.jit(shard_map(body, mesh=mesh,
+                               in_specs=tuple(P() for _ in vals),
+                               out_specs=tuple(P() for _ in vals),
+                               check_vma=False))
+        args = [jnp.asarray(v) for v in vals]
+        out = [np.asarray(o) for o in fn(*args)]
+        hlo = fn.lower(*args).compile().as_text()
+    return out, hlo
+
+
+class TestHierPackedPsum:
+    def test_exact_parity_and_decomposition(self):
+        d, i = _tiers_or_skip()
+        mesh = _named_mesh(d, i)
+        rng = np.random.default_rng(0)
+        vals = [rng.standard_normal(300).astype(np.float32),
+                rng.standard_normal((16, 3)).astype(np.float32)]
+        flat, hlo_flat = _psum_named(mesh, vals, False)
+        hier, hlo_hier = _psum_named(mesh, vals, True)
+        for a, b in zip(hier, flat):
+            _ulp_equal(a, b, ulps=64)  # reassociation over the tiers
+        cs = hlo_audit.collective_stats(hlo_hier)
+        assert "reduce-scatter" in cs and "all-gather" in cs
+        tiers = hlo_audit.collective_bytes(hlo_hier, world=d * i,
+                                           tiers=(d, i))
+        assert "full" not in tiers["by_tier"]
+        assert tiers["by_tier"]["ici"]["dcn_wire_bytes"] == 0
+
+    def test_int_payloads_bitwise(self):
+        d, i = _tiers_or_skip()
+        mesh = _named_mesh(d, i)
+        vals = [np.arange(200, dtype=np.int32) - 71,
+                np.arange(32, dtype=np.int64)]
+        flat, _ = _psum_named(mesh, vals, False)
+        hier, _ = _psum_named(mesh, vals, True)
+        for a, b in zip(hier, flat):
+            np.testing.assert_array_equal(a, b)
+
+    def test_int8_over_dcn_within_contract(self):
+        d, i = _tiers_or_skip()
+        mesh = _named_mesh(d, i)
+        rng = np.random.default_rng(1)
+        vals = [rng.standard_normal(4096).astype(np.float32)]
+        flat, _ = _psum_named(mesh, vals, False)
+        hier, hlo = _psum_named(mesh, vals, True, codec="int8")
+        err = np.linalg.norm(hier[0] - flat[0]) / np.linalg.norm(flat[0])
+        assert err <= 1e-2, err
+        # the int8 exchange runs on the DCN tier only: its a2a legs are
+        # classified dcn, and no full-mesh collective remains
+        tiers = hlo_audit.collective_bytes(hlo, world=d * i, tiers=(d, i))
+        assert "full" not in tiers["by_tier"]
+        assert tiers["by_tier"]["dcn"]["count"] >= 2  # a2a q + scales
+
+    def test_ici_bf16_codec_within_contract(self):
+        d, i = _tiers_or_skip()
+        mesh = _named_mesh(d, i)
+        rng = np.random.default_rng(2)
+        vals = [rng.standard_normal(2048).astype(np.float32)]
+        flat, _ = _psum_named(mesh, vals, False)
+        hier, _ = _psum_named(mesh, vals, True, ici="bf16")
+        err = np.linalg.norm(hier[0] - flat[0]) / np.linalg.norm(flat[0])
+        assert err <= 4e-3, err
+
+    def test_replicated_fast_form_bitwise_and_no_rs(self):
+        d, i = _tiers_or_skip()
+        mesh = _named_mesh(d, i)
+        rng = np.random.default_rng(3)
+        vals = [rng.standard_normal(512).astype(np.float32)]
+        # the replicated form reduces over dcn only — its flat reference
+        # is the dcn-scope psum, not the full-mesh one
+        ref, _ = _psum_named(mesh, vals, False, replicated=("ici",))
+        hier, hlo = _psum_named(mesh, vals, True, replicated=("ici",))
+        # no reassociation: every element reduces over exactly its dcn
+        # group either way — bitwise
+        np.testing.assert_array_equal(hier[0], ref[0])
+        cs = hlo_audit.collective_stats(hlo)
+        assert "reduce-scatter" not in cs       # the slice is free
+        assert "all-gather" in cs               # the ici reassembly
+        # the dcn all-reduce moves 1/i of the payload per device
+        tiers = hlo_audit.collective_bytes(hlo, world=d * i, tiers=(d, i))
+        ar = [r for r in tiers["per_instruction"]
+              if r["kind"] == "all-reduce" and r["tier"] == "dcn"]
+        assert ar and ar[0]["result_bytes"] == 512 * 4 // i
+
+    def test_ici_only_codec_never_ticks_quant_counters(self):
+        """With no DCN codec armed, the ici-bf16 fast legs belong to the
+        hier feature: quant_collectives/bytes_saved must stay put
+        (review finding: stats attribution), while the u16-bitcast
+        all-gather proves the bf16 wire is real."""
+        d, i = _tiers_or_skip()
+        mesh = _named_mesh(d, i)
+        rng = np.random.default_rng(4)
+        vals = [rng.standard_normal(2048).astype(np.float32)]
+        before = _counters("op_engine.quant_collectives",
+                           "op_engine.quant_bytes_saved")
+        qinfo = {}
+        with fusion.hier_override(True, tiers="dcn,ici",
+                                  ici_codec="bf16"), \
+                fusion.quant_override(None):
+
+            def body(a):
+                fusion.reset_qinfo(qinfo)
+                return fusion.packed_psum([a], ("dcn", "ici"),
+                                          qinfo=qinfo)[0]
+
+            fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(),),
+                                   out_specs=P(), check_vma=False))
+            np.asarray(fn(jnp.asarray(vals[0])))
+            fusion.tick_quant(qinfo)
+            hlo = fn.lower(jnp.asarray(vals[0])).compile().as_text()
+        after = _counters("op_engine.quant_collectives",
+                          "op_engine.quant_bytes_saved")
+        assert after == before
+        assert qinfo["hier_collectives"] == 1
+        assert "u16" in hlo  # the bitcast bf16 all-gather wire
+
+    def test_flush_ici_bf16_without_quant_codec(self):
+        """The flush path honors HEAT_TPU_HIER_ICI_CODEC with the quant
+        codec OFF (review finding: it used to silently run exact fast
+        legs while packed_psum applied the codec)."""
+        d, i = _tiers_or_skip()
+        fusion.reset()
+        with fusion.hier_override(True, tiers=(d, i), ici_codec="bf16"), \
+                fusion.quant_override(None, min_numel=16):
+            fusion.capture_hlo(True)
+            out = _chain_sum("float32").numpy()
+            hlo = fusion.last_hlo()
+            fusion.capture_hlo(False)
+        with fusion.hier_override(False):
+            base = _chain_sum("float32").numpy()
+        assert hlo is not None and "u16" in hlo  # bf16 wire, bitcast
+        # bf16-rounded fast legs: within the bf16 codec contract
+        err = np.linalg.norm(out - base) / np.linalg.norm(base)
+        assert err <= 4e-3, err
+
+    def test_small_scope_or_undeclared_stays_flat(self):
+        d, i = _tiers_or_skip()
+        mesh = _named_mesh(d, i)
+        vals = [np.ones(128, np.float32)]
+        before = _counters("op_engine.hier_collectives",
+                           "op_engine.hier_fallbacks",
+                           "faults.fusion.hier.exchange.fires")
+        with fusion.hier_override(True, tiers=None):
+            def body(x):
+                return fusion.packed_psum([x], ("dcn", "ici"))[0]
+
+            fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P(),),
+                                   out_specs=P(), check_vma=False))
+            hlo = fn.lower(jnp.ones(128, jnp.float32)).compile().as_text()
+        # tiers undeclared -> the "dcn"-NAMED axis still declares itself
+        # (grids that name the slow tier opted in by construction)
+        assert "reduce-scatter" in hlo
+        # a genuinely flat scope (no dcn axis, no factor): nothing fires
+        mesh1 = Mesh(np.array(jax.devices()), ("proc",))
+        with fusion.hier_override(True, tiers=None):
+            def body1(x):
+                return fusion.packed_psum([x], ("proc",))[0]
+
+            fn1 = jax.jit(shard_map(body1, mesh=mesh1, in_specs=(P(),),
+                                    out_specs=P(), check_vma=False))
+            h1 = fn1.lower(jnp.ones(128, jnp.float32)).compile().as_text()
+        assert "reduce-scatter" not in h1
+        after = _counters("op_engine.hier_collectives",
+                          "op_engine.hier_fallbacks",
+                          "faults.fusion.hier.exchange.fires")
+        assert after[1] == before[1] and after[2] == before[2]
+
+
+# --------------------------------------------------------------------- #
+# the flush path (flat mesh + declared factorization)                    #
+# --------------------------------------------------------------------- #
+def _chain_sum(dtype):
+    if dtype == "int32":
+        x = ht.arange(13 * 40, dtype=ht.int32).reshape((13, 40)).resplit(0)
+        y = x * 2 + 1
+        y = y * y - x
+        return (y + 3).sum(axis=0)
+    x = ht.arange(13 * 40, dtype=ht.float32).reshape((13, 40)).resplit(0)
+    y = ht.exp(x * 0.001) + x * 0.5 - 1.25
+    y = y * y + 0.25
+    return y.sum(axis=0)
+
+
+class TestHierFlush:
+    @pytest.mark.parametrize("dtype", ["float32", "int32"])
+    def test_flush_parity_and_decomposition(self, dtype):
+        d, i = _tiers_or_skip()
+        fusion.reset()
+        with fusion.hier_override(False):
+            flat = _chain_sum(dtype).numpy()
+        with fusion.hier_override(True, tiers=(d, i)):
+            fusion.capture_hlo(True)
+            hier = _chain_sum(dtype).numpy()
+            hlo = fusion.last_hlo()
+            fusion.capture_hlo(False)
+        if dtype == "int32":
+            np.testing.assert_array_equal(hier, flat)
+        else:
+            _ulp_equal(hier, flat, ulps=64)
+        assert hlo is not None
+        tiers = hlo_audit.collective_bytes(hlo, world=d * i, tiers=(d, i))
+        assert "full" not in tiers["by_tier"]
+        assert {"ici", "dcn"} <= set(tiers["by_tier"])
+
+    def test_hier_off_is_todays_flat_program(self):
+        d, i = _tiers_or_skip()
+        fusion.reset()
+        # tiers declared but the master gate off: bitwise today's flat
+        # emission, ONE all-reduce, no RS/AG
+        with fusion.hier_override(False, tiers=(d, i)):
+            fusion.capture_hlo(True)
+            off = _chain_sum("float32").numpy()
+            hlo = fusion.last_hlo()
+            fusion.capture_hlo(False)
+        with fusion.hier_override(False, tiers=None):
+            base = _chain_sum("float32").numpy()
+        np.testing.assert_array_equal(off, base)
+        cs = hlo_audit.collective_stats(hlo)
+        assert "reduce-scatter" not in cs and "all-gather" not in cs
+
+    def test_steady_state_zero_recompiles_including_toggling(self):
+        d, i = _tiers_or_skip()
+        fusion.reset()
+        with fusion.hier_override(True, tiers=(d, i)):
+            _chain_sum("float32").numpy()     # compile hier sibling
+        with fusion.hier_override(False):
+            _chain_sum("float32").numpy()     # compile flat sibling
+        s0 = fusion.program_cache().stats()
+        with fusion.hier_override(True, tiers=(d, i)):
+            h2 = _chain_sum("float32").numpy()
+        with fusion.hier_override(False):
+            f2 = _chain_sum("float32").numpy()
+        s1 = fusion.program_cache().stats()
+        assert s1["misses"] == s0["misses"]
+        assert s1["compiles"] == s0["compiles"]
+        assert h2 is not None and f2 is not None
+
+    def test_payload_floor_keeps_tiny_groups_flat(self):
+        """HEAT_TPU_HIER_MIN_NUMEL: a group whose total payload sits
+        below the floor keeps the flat collective (latency guard)."""
+        d, i = _tiers_or_skip()
+        fusion.reset()
+        with fusion.hier_override(True, tiers=(d, i),
+                                  min_numel=10_000_000):
+            fusion.capture_hlo(True)
+            out = _chain_sum("float32").numpy()
+            hlo = fusion.last_hlo()
+            fusion.capture_hlo(False)
+        with fusion.hier_override(False):
+            base = _chain_sum("float32").numpy()
+        np.testing.assert_array_equal(out, base)  # the flat program
+        assert "reduce-scatter" not in hlo
+
+    def test_hier_override_validation_leaks_nothing(self):
+        """A bad declaration raises with every global untouched (review
+        finding: the gate used to flip before validation ran)."""
+        before = (fusion.hier_enabled(), fusion.mesh_tiers(),
+                  fusion.hier_key())
+        with pytest.raises(ValueError):
+            with fusion.hier_override(not before[0], tiers="dcn,4"):
+                pass  # never reached: mixed names/sizes
+        assert (fusion.hier_enabled(), fusion.mesh_tiers(),
+                fusion.hier_key()) == before
+
+    def test_hier_counter_ticks_per_dispatch(self):
+        d, i = _tiers_or_skip()
+        fusion.reset()
+        with fusion.hier_override(True, tiers=(d, i)):
+            _chain_sum("float32").numpy()     # compile + dispatch
+            before = _counters("op_engine.hier_collectives")
+            _chain_sum("float32").numpy()     # cache-hit dispatch
+            after = _counters("op_engine.hier_collectives")
+        assert after[0] == before[0] + 1
+
+    def test_pmax_groups_keep_flat_collective(self):
+        d, i = _tiers_or_skip()
+        fusion.reset()
+        with fusion.hier_override(True, tiers=(d, i)):
+            fusion.capture_hlo(True)
+            x = ht.arange(13 * 8, dtype=ht.float32).reshape(
+                (13, 8)).resplit(0)
+            y = x * 0.5 + 1.0
+            y = y * y - 0.25
+            r = (y + 1.0).max(axis=0)
+            out = r.numpy()
+            hlo = fusion.last_hlo()
+            fusion.capture_hlo(False)
+        assert hlo is not None
+        # the pmax lowers as a flat all-reduce (max); no decomposition
+        assert "reduce-scatter" not in hlo
+        assert out.shape == (8,)
+
+
+# --------------------------------------------------------------------- #
+# TransformerLM acceptance: the 2-host×(n/2)-device simulated pod        #
+# --------------------------------------------------------------------- #
+# §2b executable-budget discipline: ONE model/params/toks per session,
+# module teardown drops the compiled state (test_quant_collectives.py
+# precedent)
+_ACCEPT: dict = {}
+
+
+def _accept_state():
+    d, i = _tiers_or_skip()
+    if not _ACCEPT:
+        import optax
+
+        from heat_tpu.nn.transformer import (TransformerLM,
+                                             TransformerLMConfig)
+
+        grid = ht.MeshGrid((d, i, 1, 1, 1),
+                           ("dcn", "dp", "pp", "tp", "sp"))
+        cfg = TransformerLMConfig(vocab=64, d_model=32, n_heads=4,
+                                  n_layers=2, d_ff=64)
+        model = TransformerLM(grid, cfg)
+        rng = np.random.default_rng(0)
+        toks = model.shard_batch(
+            rng.integers(0, 64, (2 * d * i, 16)).astype(np.int32))
+        _ACCEPT.update(model=model, toks=toks, params=model.init(0),
+                       tx=optax.adam(1e-2), tiers=(d, i))
+    return _ACCEPT
+
+
+def teardown_module(module):
+    _ACCEPT.clear()
+    fusion.reset()
+    gc.collect()
+
+
+class TestTransformerHierAcceptance:
+    @pytest.fixture(autouse=True)
+    def _pin(self):
+        # force the packed path on (the FUSION=0 A/B leg must still
+        # exercise it) and pin chunking off — this class asserts the
+        # EXACT hier leg structure; the quant codec is per-test
+        with fusion.override(True), fusion.step_override(True), \
+                fusion.chunk_override(1):
+            yield
+
+    def _lg(self, codec, hier_on):
+        st = _accept_state()
+        with fusion.quant_override(codec), \
+                fusion.hier_override(hier_on, tiers=None):
+            fn = st["model"].loss_and_grad_fn()
+            loss, grads = fn(st["params"], st["toks"])
+            hlo = fn.lower(st["params"],
+                           st["toks"]).compile().as_text()
+        return float(loss), grads, hlo
+
+    def test_acceptance_decomposition_and_per_tier_bytes(self):
+        st = _accept_state()
+        d, i = st["tiers"]
+        world = d * i
+        _, g_flat, hlo_flat = self._lg(None, False)
+        _, g_hier, hlo_hier = self._lg(None, True)
+        _, g_int8, hlo_int8 = self._lg("int8", True)
+
+        # 1) the decomposition: RS(ici) + AR(dcn) + AG(ici), and NO
+        #    flat full-mesh all-reduce anywhere in the step
+        comm = hlo_audit.communicating_collective_stats(hlo_hier)
+        assert "reduce-scatter" in comm and "all-gather" in comm \
+            and "all-reduce" in comm
+        t_flat = hlo_audit.collective_bytes(hlo_flat, world=world,
+                                            tiers=(d, i))
+        t_hier = hlo_audit.collective_bytes(hlo_hier, world=world,
+                                            tiers=(d, i))
+        t_int8 = hlo_audit.collective_bytes(hlo_int8, world=world,
+                                            tiers=(d, i))
+        assert "full" in t_flat["by_tier"]      # the flat plan's one AR
+        assert "full" not in t_hier["by_tier"]
+        assert "full" not in t_int8["by_tier"]
+
+        # 2) DCN-tier wire bytes: reduced >= p_ici x at the same codec,
+        #    and >= 2x further with int8-over-DCN
+        flat_dcn = t_flat["total_dcn_wire_bytes"]
+        hier_dcn = t_hier["total_dcn_wire_bytes"]
+        int8_dcn = t_int8["total_dcn_wire_bytes"]
+        assert flat_dcn >= i * hier_dcn * 0.99, (flat_dcn, hier_dcn)
+        assert hier_dcn >= 2 * int8_dcn, (hier_dcn, int8_dcn)
+
+        # 3) gradients: exact-hier is a reassociation (tight), int8 is
+        #    within the pinned 1e-2 norm-wise contract
+        fl = jax.tree_util.tree_leaves(g_flat)
+        for ref, got in zip(fl, jax.tree_util.tree_leaves(g_hier)):
+            assert np.allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-4, atol=1e-6)
+        for ref, got in zip(fl, jax.tree_util.tree_leaves(g_int8)):
+            r, g = np.asarray(ref), np.asarray(got)
+            err = np.linalg.norm(g - r) / (np.linalg.norm(r) + 1e-12)
+            assert err <= 1e-2, err
+
+    def test_toggle_back_rehits_cached_siblings(self):
+        st = _accept_state()
+        with fusion.quant_override(None), \
+                fusion.hier_override(True, tiers=None):
+            a1 = st["model"].loss_and_grad_fn()
+        with fusion.quant_override(None), fusion.hier_override(False):
+            b1 = st["model"].loss_and_grad_fn()
+        with fusion.quant_override(None), \
+                fusion.hier_override(True, tiers=None):
+            a2 = st["model"].loss_and_grad_fn()
+        with fusion.quant_override(None), fusion.hier_override(False):
+            b2 = st["model"].loss_and_grad_fn()
+        assert a1 is a2 and b1 is b2 and a1 is not b1
+
+    def test_loss_matches_flat_dp_grid(self):
+        """The 5-axis (dcn, dp) grid computes the SAME model as a flat
+        dp grid of the same world size — the tier axis is pure layout."""
+        st = _accept_state()
+        d, i = st["tiers"]
+        import optax
+
+        from heat_tpu.nn.transformer import (TransformerLM,
+                                             TransformerLMConfig)
+
+        cfg = st["model"].cfg
+        flat_model = TransformerLM(
+            ht.MeshGrid((d * i, 1, 1, 1), ("dp", "pp", "tp", "sp")), cfg)
+        rng = np.random.default_rng(0)
+        toks_np = rng.integers(0, 64, (2 * d * i, 16)).astype(np.int32)
+        with fusion.quant_override(None), fusion.hier_override(False):
+            lf = flat_model.loss_and_grad_fn()
+            loss_flat, _ = lf(flat_model.init(0),
+                              flat_model.shard_batch(toks_np))
+        with fusion.quant_override(None), \
+                fusion.hier_override(True, tiers=None):
+            lt = st["model"].loss_and_grad_fn()
+            loss_tier, _ = lt(st["params"], st["toks"])
+        assert np.isclose(float(loss_flat), float(loss_tier),
+                          rtol=1e-5), (float(loss_flat), float(loss_tier))
+
+
+# --------------------------------------------------------------------- #
+# DataParallel 2-D tier grid + DASO replicated-fast capture              #
+# --------------------------------------------------------------------- #
+class TestDataParallelTiered:
+    def _net(self):
+        flax = pytest.importorskip("flax")
+        import flax.linen as fnn
+
+        class MLP(fnn.Module):
+            @fnn.compact
+            def __call__(self, x):
+                x = fnn.Dense(16)(x)
+                x = fnn.relu(x)
+                return fnn.Dense(4)(x)
+
+        import heat_tpu.optim as optim
+
+        net = ht.nn.DataParallel(
+            MLP(), optimizer=optim.DataParallelOptimizer(
+                optim.SGD(lr=0.05)))
+        return net
+
+    def test_tiered_packed_step_parity_and_decomposition(self):
+        d, i = _tiers_or_skip()
+        n = d * i
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4 * n, 8)).astype(np.float32)
+        y = rng.integers(0, 4, (4 * n,)).astype(np.int32)
+
+        with fusion.hier_override(False):
+            net_flat = self._net()
+            losses_flat = [net_flat.step(x, y) for _ in range(3)]
+        with fusion.hier_override(True, tiers=(d, i)), \
+                fusion.quant_override(None), fusion.chunk_override(1):
+            net_hier = self._net()
+            losses_hier = [net_hier.step(x, y) for _ in range(3)]
+            # the packed step was built on the 2-D tier grid and its
+            # all-reduce decomposed
+            (step, _qinfo), = net_hier._packed_steps.values()
+            hlo = step.lower(net_hier.params,
+                             net_hier.optimizer.opt_state,
+                             jnp.asarray(x),
+                             jnp.asarray(y)).compile().as_text()
+        np.testing.assert_allclose(losses_hier, losses_flat, rtol=1e-5)
+        tiers = hlo_audit.collective_bytes(hlo, world=n, tiers=(d, i))
+        assert "full" not in tiers["by_tier"]
+        assert {"ici", "dcn"} <= set(tiers["by_tier"])
+
+    def test_hier_key_toggles_compile_siblings(self):
+        d, i = _tiers_or_skip()
+        n = d * i
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((2 * n, 8)).astype(np.float32)
+        y = rng.integers(0, 4, (2 * n,)).astype(np.int32)
+        net = self._net()
+        with fusion.hier_override(True, tiers=(d, i)):
+            net.step(x, y)
+        with fusion.hier_override(False):
+            net.step(x, y)
+        assert len(net._packed_steps) == 2
+        with fusion.hier_override(True, tiers=(d, i)):
+            net.step(x, y)  # toggle-back re-hits the cached sibling
+        assert len(net._packed_steps) == 2
+
+
+class TestDASOReplicatedCapture:
+    def test_capture_bitwise_and_dcn_payload_sharded(self):
+        d, i = _tiers_or_skip()
+        import heat_tpu.optim as optim
+
+        rng = np.random.default_rng(0)
+        params = {"w": rng.standard_normal((d, 64, 8)).astype(np.float32),
+                  "b": rng.standard_normal((d, 512)).astype(np.float32)}
+
+        def mk():
+            daso = optim.DASO(optim.SGD(lr=0.01), total_epochs=4,
+                              local_size=i)
+            return daso, daso.replicate(
+                {k: v[0] for k, v in params.items()})
+
+        with fusion.hier_override(False):
+            daso_f, p_f = mk()
+            flat = daso_f._capture(p_f)
+        with fusion.hier_override(True, tiers=None):
+            daso_h, p_h = mk()
+            hier = daso_h._capture(p_h)
+            (fn, _qinfo), = daso_h._packed_avgs.values()
+            hlo = fn.lower(p_h).compile().as_text()
+        for k in flat:
+            np.testing.assert_array_equal(np.asarray(flat[k]),
+                                          np.asarray(hier[k]))
+        # the slice-form: no reduce-scatter, the dcn all-reduce carries
+        # 1/i of the payload, one ici all-gather reassembles
+        cs = hlo_audit.collective_stats(hlo)
+        assert "reduce-scatter" not in cs
+        assert "all-gather" in cs
+        tiers = hlo_audit.collective_bytes(hlo, world=d * i, tiers=(d, i))
+        assert "full" not in tiers["by_tier"]
+        assert tiers["by_tier"]["dcn"]["dcn_wire_bytes"] > 0
+
+
+def test_hier_stats_surface_in_runtime_stats():
+    st = ht.runtime_stats()["op_engine"]["fusion"]
+    for k in ("hier_enabled", "mesh_tiers", "hier_ici_codec",
+              "hier_collectives", "hier_fallbacks"):
+        assert k in st
+    assert isinstance(st["hier_collectives"], int)
+    assert isinstance(st["hier_fallbacks"], int)

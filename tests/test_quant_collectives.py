@@ -604,15 +604,15 @@ class TestDataParallelQuant:
         net = self._net()
         with fusion.quant_override(None):
             net.step(X, Y)
-            exact_step = net._packed_steps[(fusion.quant_key(), fusion.chunk_key())][0]
+            exact_step = net._packed_steps[(fusion.quant_key(), fusion.chunk_key(), fusion.hier_key())][0]
         with fusion.quant_override("int8"):
             net.step(X, Y)
-            quant_step = net._packed_steps[(fusion.quant_key(), fusion.chunk_key())][0]
+            quant_step = net._packed_steps[(fusion.quant_key(), fusion.chunk_key(), fusion.hier_key())][0]
             assert quant_step is not exact_step  # sibling, not a reuse
         with fusion.quant_override(None):
             # toggle-back RE-HITS the cached exact program — no recompile
             net.step(X, Y)
-            assert net._packed_steps[(fusion.quant_key(), fusion.chunk_key())][0] is exact_step
+            assert net._packed_steps[(fusion.quant_key(), fusion.chunk_key(), fusion.hier_key())][0] is exact_step
         assert len(net._packed_steps) == 2
 
 
@@ -669,6 +669,88 @@ class TestDASOQuant:
 # --------------------------------------------------------------------- #
 # fault injection: encode fault falls back to the exact collective      #
 # --------------------------------------------------------------------- #
+class TestInt8OverflowRegression:
+    """The PR 10 int8-codec gotcha (ISSUE 12 satellite): huge-magnitude
+    payloads used to round-trip as inf/NaN — a finite combined value
+    just above bf16 max overflowed the return leg's bf16 downcast to
+    inf, and a non-finite block amax poisoned its bf16 scale into inf,
+    whose decode (0·inf) is NaN. The codec now SATURATES every bf16
+    downcast into finite range and pre-scales the combine by a power of
+    two (exponent-exact, bitwise-neutral in range), so 1e38-magnitude
+    payloads stay finite and inside the 1e-2 contract."""
+
+    def _roundtrip(self, payload_rows):
+        """int8 all-reduce vs exact psum, each device holding its own
+        row of ``payload_rows`` (size, n)."""
+        comm = ht.get_comm()
+        n = payload_rows.shape[1]
+        flat = jnp.asarray(payload_rows.reshape(-1))
+
+        def q_body(v):
+            return fusion._quant_int8_allreduce(
+                v, comm.axis_name, comm.size, (), 128)
+
+        def e_body(v):
+            return jax.lax.psum(v, comm.axis_name)
+
+        def run(body):
+            fn = jax.jit(shard_map(
+                body, mesh=comm.mesh, in_specs=P(comm.axis_name),
+                out_specs=P(), check_vma=False))
+            return np.asarray(fn(flat))
+
+        return run(q_body), run(e_body)
+
+    def test_1e38_magnitude_payload_round_trips_finite(self):
+        size = ht.get_comm().size
+        if size < 4:
+            pytest.skip("needs >= 4 same-sign peers for a transient "
+                        "combine overflow")
+        rng = np.random.default_rng(5)
+        # 1e38-magnitude per-device summands: size-1 positive peers and
+        # one cancelling negative one. The finite TOTAL is ~3.3e38·base,
+        # but the running combine transiently passes f32 max (the old
+        # code's per-peer sum went inf and stayed there); the
+        # power-of-two-downscaled combine keeps every partial in range
+        base = rng.uniform(0.25, 1.0, 512).astype(np.float32)
+        s = np.float32(3.3e38 / (size - 2))
+        rows = np.stack([base * s] * (size - 1)
+                        + [-base * s]).astype(np.float32)
+        q, e = self._roundtrip(rows)
+        # the TRUE total is a finite f32 — but even the exact psum's
+        # fixed combine order transiently overflows here (size-1
+        # same-sign peers), so the f64 host total is the honest
+        # reference; the downscaled int8 combine must stay finite and
+        # inside the contract where the old code (and the naive exact
+        # order) read inf
+        ref = rows.astype(np.float64).sum(axis=0)
+        assert np.isfinite(ref.astype(np.float32)).all()
+        assert np.isfinite(q).all(), "quantized leg produced inf/NaN"
+        assert _rel(q, ref) <= BOUNDS["int8"], _rel(q, ref)
+        del e
+
+    def test_sum_above_bf16_max_saturates_not_inf(self):
+        _multi_device()
+        size = ht.get_comm().size
+        # finite f32 total just above bf16 max: the old return leg
+        # downcast it to inf; now it saturates at ±bf16max (0.3% off,
+        # far inside the 1e-2 contract)
+        rows = np.full((size, 256), 3.4e38 / size, np.float32)
+        q, e = self._roundtrip(rows)
+        assert np.isfinite(e).all() and np.isfinite(q).all()
+        assert _rel(q, e) <= BOUNDS["int8"], _rel(q, e)
+
+    def test_non_finite_payload_never_nans(self):
+        _multi_device()
+        size = ht.get_comm().size
+        rows = np.ones((size, 256), np.float32)
+        rows[0, 3] = np.inf
+        q, _ = self._roundtrip(rows)
+        # non-finite payloads still do not round-trip (documented), but
+        # they SATURATE instead of poisoning blocks as NaN
+        assert not np.isnan(q).any()
+
+
 class TestQuantFault:
     def test_flush_encode_fault_falls_back_exact(self):
         from heat_tpu.utils import faults

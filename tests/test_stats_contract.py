@@ -43,6 +43,8 @@ FUSION_KEYS = {
     "quant_bytes_saved", "quant_fallbacks",
     "chunk_count", "chunk_min_numel", "chunk_collectives",
     "chunk_fallbacks",
+    "hier_enabled", "mesh_tiers", "hier_ici_codec",
+    "hier_collectives", "hier_fallbacks",
     "program_cache",
 }
 
@@ -84,10 +86,12 @@ def test_runtime_stats_value_types_pinned():
     for k in ("flushes", "fused_ops", "step_flushes", "quant_collectives",
               "quant_bytes_saved", "quant_fallbacks", "quant_min_numel",
               "chunk_count", "chunk_min_numel", "chunk_collectives",
-              "chunk_fallbacks"):
+              "chunk_fallbacks", "hier_collectives", "hier_fallbacks"):
         assert isinstance(fu[k], int), k
     assert fu["quant_codec"] in (None, "bf16", "int8")
-    for k in ("enabled", "reduce_enabled", "step_enabled"):
+    assert fu["hier_ici_codec"] in (None, "bf16")
+    assert fu["mesh_tiers"] is None or isinstance(fu["mesh_tiers"], list)
+    for k in ("enabled", "reduce_enabled", "step_enabled", "hier_enabled"):
         assert isinstance(fu[k], bool), k
     # the whole snapshot must round-trip through json (dashboards)
     json.dumps(rt)

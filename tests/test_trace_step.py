@@ -427,7 +427,12 @@ class TestDataParallelPackedStep:
         X = np.ones((comm.size * 4, 8), np.float32)
         y = np.zeros(comm.size * 4, np.int32)
         net.init(X)
-        packed, _qinfo = net._build_packed_train_step()
+        # hier pinned OFF: this test owns the FLAT packed contract (the
+        # ladder's HIER=1+tiers A/B leg would decompose the ONE asserted
+        # all-reduce into RS+AR+AG — tests/test_hier_collectives.py owns
+        # that structure)
+        with fusion.hier_override(False):
+            packed, _qinfo = net._build_packed_train_step()
         txt = packed.lower(net.params, net.optimizer.opt_state,
                            jnp.asarray(X), jnp.asarray(y)).compile().as_text()
         from heat_tpu.utils import hlo_audit

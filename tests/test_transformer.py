@@ -155,11 +155,14 @@ class TestPackedTrainStep:
         # assertions red. Chunking pinned to 1 for the same reason: the
         # CHUNKS=4 A/B leg would split the ONE asserted all-reduce into
         # chunk legs (that leg structure has its own contract in
-        # tests/test_chunk_collectives.py)
+        # tests/test_chunk_collectives.py). Hier pinned OFF likewise:
+        # the HIER=1+tiers A/B leg would decompose the ONE all-reduce
+        # into RS+AR+AG (tests/test_hier_collectives.py owns that)
         from heat_tpu.core import fusion
 
         with fusion.override(True), fusion.step_override(True), \
-                fusion.quant_override(None), fusion.chunk_override(1):
+                fusion.quant_override(None), fusion.chunk_override(1), \
+                fusion.hier_override(False):
             yield
 
     @staticmethod
@@ -274,10 +277,11 @@ class TestPackedTrainStep:
             model.loss_and_grad_fn()
         assert ("loss_and_grad", False) in model._step_cache
         model.loss_and_grad_fn()
-        # the packed key carries the quant configuration (codec toggles
-        # compile siblings instead of poisoning the exact program)
+        # the packed key carries the quant/chunk/hier configuration
+        # (toggles compile siblings instead of poisoning the exact
+        # flat program)
         assert ("loss_and_grad", True, fusion.quant_key(),
-                fusion.chunk_key()) \
+                fusion.chunk_key(), fusion.hier_key()) \
             in model._step_cache
 
 
