@@ -900,6 +900,153 @@ def _fusion_stage(timeout: float = 420.0):
         return {"fusion_error": repr(exc)}
 
 
+def _analytics_bench_main() -> None:
+    """``--analytics-bench`` child: measure the tape-compiled analytics
+    fit steps (ISSUE 13) on the 4-device CPU mesh this process was
+    launched onto.
+
+    Two figures:
+
+    * ``analytics_lloyd_*``: one KMeans Lloyd iteration timed as the
+      compiled donated packed-collective executable
+      (``kmeans._lloyd_fused_fn`` — what ``fit()`` dispatches per
+      iteration through ``fusion.fit_step_call``) vs the eager op-by-op
+      replay (``_lloyd_eager_step`` — the ``fit.step.dispatch`` degrade
+      path: per-op dispatch, separate psums). Sized dispatch-dominated
+      (n = 2^15, the fusion-stage regime) — acceptance ≥ 2×. A repeated
+      public ``fit()`` proves the steady state runs zero program-cache
+      misses.
+    * ``analytics_stream_*``: the out-of-core scenario — a 100M-element
+      (n×64 f32, 400 MB) HDF5 dataset, sized down when the box lacks the
+      disk, trained chunk-by-chunk via ``fit_stream`` with the chunk
+      accounting proving the resident set never approached
+      materialization (peak chunk ≪ file size).
+
+    Prints ONE JSON line with the analytics_* fields.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    import heat_tpu as ht
+    from heat_tpu.cluster import kmeans as km_mod
+    from heat_tpu.core import fusion
+
+    comm = ht.get_comm()
+    n, d, k = 1 << 15, D_FEATS, K_CLUSTERS
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal((n, d)).astype(np.float32)
+    x = ht.array(data, split=0)
+    xp = x.larray
+    jdt = jnp.dtype(jnp.float32)
+    cent0 = jnp.asarray(rng.standard_normal((k, d)).astype(np.float32))
+    qk, ck, hk = fusion.quant_key(), fusion.chunk_key(), fusion.hier_key()
+    fused = km_mod._lloyd_fused_fn(xp.shape, jdt, k, n, comm, qk, ck, hk)
+    eager = km_mod._lloyd_eager_step(xp.shape, jdt, k, n)
+
+    def timed_iter(step, reps, donating) -> float:
+        c = jnp.array(cent0)
+        out = step(xp, c)  # compile + warm (the donating step eats c)
+        jax.block_until_ready(out[0])
+        c = out[0]
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            c, _s, _i = step(xp, c if donating else jnp.array(c))
+        jax.block_until_ready(c)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    record = {"analytics_devices": comm.size, "analytics_n": n}
+    t_fused = min(timed_iter(fused, 20, True) for _ in range(2))
+    t_eager = min(timed_iter(eager, 6, False) for _ in range(2))
+    record["analytics_lloyd_fused_ms"] = round(t_fused, 3)
+    record["analytics_lloyd_eager_ms"] = round(t_eager, 3)
+    record["analytics_lloyd_speedup"] = round(t_eager / t_fused, 2)
+
+    # steady state on the PUBLIC path: repeated fit() is key-lookup only
+    seed = ht.array(data[:k].copy())
+    kw = dict(n_clusters=k, init=seed, max_iter=4, tol=-1.0)
+    ht.cluster.KMeans(**kw).fit(x)  # compile leg
+    st0 = fusion.program_cache().stats()
+    f0 = fusion.stats()["fit_step_flushes"]
+    for _ in range(3):
+        ht.cluster.KMeans(**kw).fit(x)
+    st1 = fusion.program_cache().stats()
+    record["analytics_fit_steady_misses"] = st1["misses"] - st0["misses"]
+    record["analytics_fit_step_flushes"] = (
+        fusion.stats()["fit_step_flushes"] - f0)
+
+    # ---- out-of-core streamed clustering, 100M-element scale -------- #
+    # Fail-soft inside the stage (like the quant/overlap stages): a
+    # missing h5py or a full disk must not take down the Lloyd figures.
+    try:
+        import h5py  # noqa: F401 — availability gate
+
+        elems = 100_000_000
+        free = shutil.disk_usage(tempfile.gettempdir()).free
+        while elems * 4 * 2 > free and elems > 1_000_000:
+            elems //= 4  # sized to the box: never fill the disk
+        ns = elems // d
+        tmp = tempfile.mkdtemp(prefix="ht_analytics_")
+        try:
+            path = os.path.join(tmp, "stream.h5")
+            with h5py.File(path, "w") as f:
+                dset = f.create_dataset("data", (ns, d), dtype="f4")
+                for lo in range(0, ns, 1 << 18):
+                    hi = min(lo + (1 << 18), ns)
+                    dset[lo:hi] = rng.standard_normal(
+                        (hi - lo, d), dtype=np.float32)
+            stream = ht.load_hdf5(path, "data", stream=True)
+            sseed = ht.array(
+                rng.standard_normal((k, d)).astype(np.float32))
+            epochs = 3
+            t0 = time.perf_counter()
+            ht.cluster.KMeans(
+                n_clusters=k, init=sseed, max_iter=epochs,
+                tol=-1.0).fit_stream(stream, rows_per_chunk=1 << 17)
+            t_fit = time.perf_counter() - t0
+            record["analytics_stream_elements"] = ns * d
+            record["analytics_stream_epochs"] = epochs
+            record["analytics_stream_file_mb"] = round(
+                os.path.getsize(path) / 1e6, 1)
+            record["analytics_stream_mrows_per_s"] = round(
+                epochs * ns / t_fit / 1e6, 2)
+            record["analytics_stream_chunks_read"] = stream.chunks_read
+            record["analytics_stream_peak_chunk_mb"] = round(
+                stream.peak_chunk_bytes / 1e6, 1)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    except Exception as exc:  # fail-soft: keep the Lloyd figures
+        record["analytics_stream_error"] = repr(exc)[:300]
+
+    print(json.dumps(record), flush=True)
+
+
+def _analytics_stage(timeout: float = 420.0):
+    """Fail-soft tape-compiled-analytics stage on a 4-device CPU mesh;
+    returns the analytics_* field dict or an ``{"analytics_error": ...}``
+    marker — the headline record survives either way (same contract as
+    the serve and fusion stages)."""
+    from __graft_entry__ import _cpu_env
+
+    me = os.path.abspath(__file__)
+    try:
+        out = subprocess.run(
+            [sys.executable, me, "--analytics-bench"], env=_cpu_env(4),
+            timeout=timeout, capture_output=True, text=True)
+        line = next((l for l in reversed(out.stdout.splitlines())
+                     if l.startswith("{")), None)
+        if out.returncode == 0 and line is not None:
+            return json.loads(line)
+        tail = (out.stderr or out.stdout or "").strip().splitlines()[-3:]
+        return {"analytics_error": f"rc={out.returncode} " + " | ".join(tail)}
+    except subprocess.TimeoutExpired:
+        return {"analytics_error": f"analytics stage exceeded {timeout:.0f}s"}
+    except Exception as exc:
+        return {"analytics_error": repr(exc)}
+
+
 def _serve_bench_main() -> None:
     """``--serve-bench`` child: measure the serving executor on the
     4-device CPU mesh this process was launched onto (the serving stage is
@@ -1167,6 +1314,9 @@ def main() -> None:
     if len(sys.argv) >= 2 and sys.argv[1] == "--fusion-bench":
         _fusion_bench_main()
         return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--analytics-bench":
+        _analytics_bench_main()
+        return
 
     me = os.path.abspath(__file__)
     from __graft_entry__ import _cpu_env
@@ -1233,6 +1383,10 @@ def main() -> None:
                 # fusion-engine speedup stage (fail-soft, live records
                 # only, same 4-device CPU mesh): eager vs fused op chains
                 rec.update(_fusion_stage())
+                # tape-compiled analytics stage (fail-soft, live records
+                # only, same mesh): fused-vs-eager Lloyd iteration + the
+                # 100M-element out-of-core streamed clustering scenario
+                rec.update(_analytics_stage())
                 line = json.dumps(rec)
             except Exception as exc:
                 sys.stderr.write(f"bench: serve/fusion stage skipped: {exc}\n")

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import factories
+from ..core import factories, fusion
 from ..core.base import BaseEstimator, ClassificationMixin
 from ..core.dndarray import DNDarray
 
@@ -94,6 +94,43 @@ def _ring_predict_fn(comm, k, n_train, c_train, jdt, ldt, shapes):
     return fn
 
 
+def _ring_predict_eager(k, n_train, jdt, ldt, block_rows=4096):
+    """The predict-assign mathematics dispatched op-by-op: the
+    ``fit.step.dispatch`` degrade path of the ring program. The training
+    set is consumed in ``block_rows`` blocks with a running top-k merge,
+    so the degrade path keeps the ring's bounded memory (never a full
+    (n_test, n_train) distance matrix — the configuration the ring
+    exists to protect must survive its own fallback). Distance TIES may
+    vote differently than the ring's streaming merge (both orders are
+    valid k-NN answers); everything else matches."""
+
+    def predict(xl, xtl, ytl):
+        xl = xl.astype(jdt)
+        xtl = xtl.astype(jdt)
+        ytl = ytl.astype(ldt)
+        x2 = jnp.sum(xl * xl, axis=1, keepdims=True)
+        best_d = jnp.full((xl.shape[0], k), jnp.inf, jdt)
+        best_l = jnp.zeros((xl.shape[0], k), ldt)
+        for lo in range(0, xtl.shape[0], block_rows):
+            blk = xtl[lo:lo + block_rows]
+            valid = lo + jnp.arange(blk.shape[0]) < n_train
+            y2 = jnp.sum(blk * blk, axis=1)[None, :]
+            tile = jnp.maximum(x2 + y2 - 2.0 * (xl @ blk.T), 0.0)
+            tile = jnp.where(valid[None, :], tile, jnp.inf)
+            lab = jnp.broadcast_to(ytl[lo:lo + block_rows][None, :],
+                                   tile.shape)
+            # running candidates first: equal-distance ties resolve to
+            # the earlier train row, like a whole-set lax.top_k would
+            cand_d = jnp.concatenate([best_d, tile], axis=1)
+            cand_l = jnp.concatenate([best_l, lab], axis=1)
+            neg_d, idx = jax.lax.top_k(-cand_d, k)
+            best_d = -neg_d
+            best_l = jnp.take_along_axis(cand_l, idx, axis=1)
+        return _vote(best_l, k)
+
+    return predict
+
+
 class KNeighborsClassifier(ClassificationMixin, BaseEstimator):
     """KNN voting classifier (reference ``kneighborsclassifier.py:18``)."""
 
@@ -140,10 +177,22 @@ class KNeighborsClassifier(ClassificationMixin, BaseEstimator):
                 jdt = jnp.dtype(jnp.float32)
             ldt = yt.larray.dtype
             c_train = xt.larray.shape[0] // comm.size
-            fn = _ring_predict_fn(
-                comm, k, xt.shape[0], c_train, jdt, ldt,
-                (x.larray.shape, xt.larray.shape))
-            winner = fn(x.larray, xt.larray, yt.larray.reshape(-1))
+            shapes = (x.larray.shape, xt.larray.shape)
+            args = (x.larray, xt.larray, yt.larray.reshape(-1))
+            if fusion.fit_enabled():
+                # predict-assign through the fit-step engine: program
+                # keyed in the fusion cache, fit.step.dispatch degrading
+                # to the eager whole-train-set tile
+                winner = fusion.fit_step_call(
+                    ("knn.ring", k, xt.shape[0], shapes, str(jdt),
+                     str(ldt), comm.cache_key),
+                    lambda qk, ck, hk: _ring_predict_fn(
+                        comm, k, xt.shape[0], c_train, jdt, ldt, shapes),
+                    args, _ring_predict_eager(k, xt.shape[0], jdt, ldt))
+            else:
+                winner = _ring_predict_fn(
+                    comm, k, xt.shape[0], c_train, jdt, ldt, shapes)(*args)
+            winner = jax.device_put(winner, comm.sharding(1, 0))
             return DNDarray(
                 winner, (x.shape[0],), _types.canonical_heat_type(winner.dtype),
                 0, x.device, comm)

@@ -21,7 +21,7 @@ import numpy as np
 from ..core._compat import shard_map
 
 from ..core.dndarray import DNDarray
-from ..core import types
+from ..core import fusion, types
 from ..core._sort import (_float_sort_key, _index_dtype, _network_sort,
                           _role_tables, batcher_rounds)
 from ._kcluster import _KCluster
@@ -31,10 +31,16 @@ __all__ = ["KMedians"]
 _STEP_CACHE: dict = {}
 
 
-def _kmedians_step_fn(phys_shape, k: int, n: int, comm):
+def _kmedians_step_fn(phys_shape, k: int, n: int, comm, fused=None):
     """Jitted ``(x_phys, centroids) -> (new_centroids, shift, labels_phys)``:
-    one full Lloyd/median iteration over the mesh."""
-    key = ("kmed", tuple(phys_shape), k, n, comm.cache_key)
+    one full Lloyd/median iteration over the mesh.
+
+    ``fused=None`` is the legacy program (today's dispatch, bitwise);
+    ``fused=(quant_key, chunk_key, hier_key)`` builds the tape-compiled
+    sibling: the two median-rank selection psums PACK into one flattened
+    all-reduce (pinned to the captured codec tuples) and the carried
+    centroids are DONATED."""
+    key = ("kmed", tuple(phys_shape), k, n, comm.cache_key, fused)
     fn = _STEP_CACHE.get(key)
     if fn is not None:
         return fn
@@ -66,12 +72,16 @@ def _kmedians_step_fn(phys_shape, k: int, n: int, comm):
         lo = jnp.maximum(counts - 1, 0) // 2  # (k,)
         hi = counts // 2
         sel = gpos[None, None, :]
-        vlo = jax.lax.psum(
-            jnp.sum(jnp.where(sel == lo[:, None, None], sv, 0), axis=-1),
-            comm.axis_name)  # (k, d)
-        vhi = jax.lax.psum(
-            jnp.sum(jnp.where(sel == hi[:, None, None], sv, 0), axis=-1),
-            comm.axis_name)
+        plo = jnp.sum(jnp.where(sel == lo[:, None, None], sv, 0), axis=-1)
+        phi = jnp.sum(jnp.where(sel == hi[:, None, None], sv, 0), axis=-1)
+        if fused is None:
+            vlo = jax.lax.psum(plo, comm.axis_name)  # (k, d)
+            vhi = jax.lax.psum(phi, comm.axis_name)
+        else:
+            qk, ck, hk = fused
+            vlo, vhi = fusion.packed_psum(
+                [plo, phi], (comm.axis_name,), quant=qk, chunks=ck,
+                hier=hk)
         med = 0.5 * (vlo + vhi)
         new_cent = jnp.where((counts > 0)[:, None], med, cent)
         shift = jnp.sum((new_cent - cent) ** 2)
@@ -83,10 +93,33 @@ def _kmedians_step_fn(phys_shape, k: int, n: int, comm):
             body, mesh=comm.mesh, in_specs=(spec_x, comm.spec(2, None)),
             out_specs=(comm.spec(2, None), comm.spec(0, None),
                        comm.spec(1, 0)),
-            check_vma=False)
-    )
+            check_vma=False),
+        donate_argnums=(1,) if fused is not None else ())
     _STEP_CACHE[key] = fn
     return fn
+
+
+def _kmedians_eager_step(k: int, n: int):
+    """The same manhattan-assignment/median mathematics dispatched
+    op-by-op (unjitted jnp, GSPMD collectives): the ``fit.step.dispatch``
+    degrade path. The median comes from ``nanmedian`` over non-members
+    masked to NaN — the average of the same two central order statistics
+    the sort-network program selects."""
+
+    def step(xp, cent):
+        gpos = jnp.arange(xp.shape[0])
+        valid = gpos < n
+        dist = jnp.sum(jnp.abs(xp[:, None, :] - cent[None, :, :]), axis=-1)
+        labels = jnp.argmin(dist, axis=1)
+        member = (labels[:, None] == jnp.arange(k)[None, :]) & valid[:, None]
+        counts = jnp.sum(member, axis=0)
+        vals = jnp.where(member[:, :, None], xp[:, None, :], jnp.nan)
+        med = jnp.nanmedian(vals, axis=0)  # (k, d)
+        new_cent = jnp.where((counts > 0)[:, None], med, cent)
+        shift = jnp.sum((new_cent - cent) ** 2)
+        return new_cent, shift, labels
+
+    return step
 
 
 class KMedians(_KCluster):
@@ -111,7 +144,37 @@ class KMedians(_KCluster):
             random_state=random_state,
         )
 
+    def _step_dispatcher(self, phys_shape, n: int, comm):
+        """The distributed per-iteration step ``(xp, centroids) ->
+        (new_centroids, shift, labels_phys)`` — the tape-compiled donated
+        program under ``fusion.fit_enabled()`` (with the eager op-by-op
+        degrade path), the legacy program otherwise."""
+        k = self.n_clusters
+        if not fusion.fit_enabled():
+            return _kmedians_step_fn(phys_shape, k, n, comm)
+        eager = _kmedians_eager_step(k, n)
+
+        def step(xp, cent):
+            return fusion.fit_step_call(
+                ("kmedians.step", tuple(phys_shape), k, n, comm.cache_key),
+                lambda qk, ck, hk: _kmedians_step_fn(
+                    phys_shape, k, n, comm, fused=(qk, ck, hk)),
+                (xp, cent), eager)
+
+        return step
+
+    def _local_step(self, logical, centroids):
+        """Replicated-data step for the shared Lloyd driver."""
+        labels = self._assign_labels(logical, centroids)
+        new_centroids = self._median_update(
+            logical, labels, centroids, self.n_clusters)
+        shift = jnp.sum((new_centroids - centroids) ** 2)
+        return new_centroids, shift, labels
+
     def fit(self, x: DNDarray) -> "KMedians":
+        """Lloyd/median iteration through the shared ``_run_lloyd``
+        driver (the historic batched/non-batched loop pair deduped into
+        ``_KCluster``)."""
         if not isinstance(x, DNDarray):
             raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
         if x.split not in (None, 0):
@@ -119,20 +182,20 @@ class KMedians(_KCluster):
         self._initialize_cluster_centers(x)
 
         k = self.n_clusters
-        xp = x.larray.astype(jnp.float32)
-        centroids = self._cluster_centers._logical().astype(jnp.float32)
         n = x.shape[0]
+        # fresh buffer: the fused step donates the carried centroids
+        centroids = jnp.array(self._cluster_centers._logical(), jnp.float32)
 
         if x.split == 0 and x.comm.size > 1 and n > 0:
-            step = _kmedians_step_fn(xp.shape, k, n, x.comm)
-            it = 0
-            labels = None
-            for it in range(1, self.max_iter + 1):
-                centroids, shift, labels = step(xp, centroids)
-                if self.tol >= 0 and float(shift) <= self.tol * self.tol:
-                    break
+            xp = x.larray.astype(jnp.float32)
+            step = self._step_dispatcher(xp.shape, n, x.comm)
+            centroids, labels, it = self._run_lloyd(step, xp, centroids)
             self._cluster_centers = DNDarray.from_logical(
                 centroids, None, x.device, x.comm)
+            # an eager-degraded final iteration may hand back labels in
+            # a different layout — pin the split-0 sharding the wrapper
+            # below claims
+            labels = jax.device_put(labels, x.comm.sharding(1, 0))
             self._labels = DNDarray(
                 labels, (n,), types.canonical_heat_type(labels.dtype), 0,
                 x.device, x.comm)
@@ -140,14 +203,8 @@ class KMedians(_KCluster):
             return self
 
         logical = x._logical().astype(jnp.float32)
-        it = 0
-        for it in range(1, self.max_iter + 1):
-            labels = self._assign_labels(logical, centroids)
-            new_centroids = self._median_update(logical, labels, centroids, k)
-            shift = float(jnp.sum((new_centroids - centroids) ** 2))
-            centroids = new_centroids
-            if self.tol >= 0 and shift <= self.tol * self.tol:
-                break
+        centroids, labels, it = self._run_lloyd(
+            self._local_step, logical, centroids)
 
         self._cluster_centers = DNDarray.from_logical(centroids, None, x.device, x.comm)
         self._labels = DNDarray.from_logical(

@@ -19,7 +19,7 @@ import numpy as np
 from ..core._compat import shard_map
 
 from ..core.dndarray import DNDarray
-from ..core import types
+from ..core import fusion, types
 from ..core._sort import _index_dtype
 from ._kcluster import _KCluster
 
@@ -28,15 +28,29 @@ __all__ = ["KMedoids"]
 _STEP_CACHE: dict = {}
 
 
-def _kmedoids_step_fn(phys_shape, k: int, n: int, comm):
-    """Jitted ``(x_phys, centroids) -> (new_centroids, shift, labels_phys)``."""
-    key = ("kmedo", tuple(phys_shape), k, n, comm.cache_key)
+def _kmedoids_step_fn(phys_shape, k: int, n: int, comm, fused=None):
+    """Jitted ``(x_phys, centroids) -> (new_centroids, shift, labels_phys)``.
+
+    ``fused=None`` is the legacy program (today's dispatch, bitwise);
+    ``fused=(quant_key, chunk_key, hier_key)`` builds the tape-compiled
+    sibling: the float psums (cluster sums, winning medoid rows) route
+    through ``fusion.packed_psum`` pinned to the captured codec tuples —
+    so they ride the quant/hier/chunk wire codecs — and the carried
+    centroids are DONATED."""
+    key = ("kmedo", tuple(phys_shape), k, n, comm.cache_key, fused)
     fn = _STEP_CACHE.get(key)
     if fn is not None:
         return fn
     p = comm.size
     c = phys_shape[0] // p
     idt = _index_dtype()
+
+    def _fsum(v):
+        if fused is None:
+            return jax.lax.psum(v, comm.axis_name)
+        qk, ck, hk = fused
+        return fusion.packed_psum([v], (comm.axis_name,), quant=qk,
+                                  chunks=ck, hier=hk)[0]
 
     def body(xb, cent):
         me = jax.lax.axis_index(comm.axis_name)
@@ -47,7 +61,7 @@ def _kmedoids_step_fn(phys_shape, k: int, n: int, comm):
         member = (labels[:, None] == jnp.arange(k)[None, :]) & valid[:, None]
         counts = jax.lax.psum(jnp.sum(member.astype(idt), axis=0),
                               comm.axis_name)
-        sums = jax.lax.psum(member.astype(xb.dtype).T @ xb, comm.axis_name)
+        sums = _fsum(member.astype(xb.dtype).T @ xb)
         means = sums / jnp.maximum(counts, 1).astype(xb.dtype)[:, None]
         # snap to the nearest member point: per-cluster (distance, row) pmin
         d_mean = jnp.sum(jnp.abs(xb[:, None, :] - means[None, :, :]), axis=-1)
@@ -61,9 +75,8 @@ def _kmedoids_step_fn(phys_shape, k: int, n: int, comm):
             jnp.where(loc_v == gmin, loc_g, jnp.asarray(big, idt)),
             comm.axis_name)  # (k,) lowest global row among ties
         winner = gpos[:, None] == g_win[None, :]  # (c, k)
-        medoids = jax.lax.psum(
-            jnp.einsum("ck,cd->kd", winner.astype(xb.dtype), xb),
-            comm.axis_name)
+        medoids = _fsum(
+            jnp.einsum("ck,cd->kd", winner.astype(xb.dtype), xb))
         new_cent = jnp.where((counts > 0)[:, None], medoids, cent)
         shift = jnp.sum((new_cent - cent) ** 2)
         return new_cent, shift, labels
@@ -74,10 +87,36 @@ def _kmedoids_step_fn(phys_shape, k: int, n: int, comm):
             in_specs=(comm.spec(2, 0), comm.spec(2, None)),
             out_specs=(comm.spec(2, None), comm.spec(0, None),
                        comm.spec(1, 0)),
-            check_vma=False)
-    )
+            check_vma=False),
+        donate_argnums=(1,) if fused is not None else ())
     _STEP_CACHE[key] = fn
     return fn
+
+
+def _kmedoids_eager_step(k: int, n: int):
+    """The same assignment/medoid-snap mathematics dispatched op-by-op
+    (unjitted jnp, GSPMD collectives): the ``fit.step.dispatch`` degrade
+    path. ``argmin`` picks the first (lowest global row) minimizer, the
+    same tie-break as the compiled value-index pmin tournament."""
+
+    def step(xp, cent):
+        gpos = jnp.arange(xp.shape[0])
+        valid = gpos < n
+        dist = jnp.sum(jnp.abs(xp[:, None, :] - cent[None, :, :]), axis=-1)
+        labels = jnp.argmin(dist, axis=1)
+        member = (labels[:, None] == jnp.arange(k)[None, :]) & valid[:, None]
+        counts = jnp.sum(member, axis=0)
+        sums = member.astype(xp.dtype).T @ xp
+        means = sums / jnp.maximum(counts, 1).astype(xp.dtype)[:, None]
+        d_mean = jnp.sum(jnp.abs(xp[:, None, :] - means[None, :, :]), axis=-1)
+        d_mean = jnp.where(member, d_mean, jnp.inf)
+        medoid_idx = jnp.argmin(d_mean, axis=0)  # (k,)
+        medoids = xp[medoid_idx]
+        new_cent = jnp.where((counts > 0)[:, None], medoids, cent)
+        shift = jnp.sum((new_cent - cent) ** 2)
+        return new_cent, shift, labels
+
+    return step
 
 
 class KMedoids(_KCluster):
@@ -101,28 +140,56 @@ class KMedoids(_KCluster):
             random_state=random_state,
         )
 
+    def _converged(self, shift_sq: float) -> bool:
+        """Medoid iteration converges at an exact fixpoint (centroids
+        snap to data points, so the shift is exactly zero there)."""
+        return shift_sq == 0.0
+
+    def _step_dispatcher(self, phys_shape, n: int, comm):
+        """Distributed per-iteration step — tape-compiled donated program
+        under ``fusion.fit_enabled()``, legacy program otherwise."""
+        k = self.n_clusters
+        if not fusion.fit_enabled():
+            return _kmedoids_step_fn(phys_shape, k, n, comm)
+        eager = _kmedoids_eager_step(k, n)
+
+        def step(xp, cent):
+            return fusion.fit_step_call(
+                ("kmedoids.step", tuple(phys_shape), k, n, comm.cache_key),
+                lambda qk, ck, hk: _kmedoids_step_fn(
+                    phys_shape, k, n, comm, fused=(qk, ck, hk)),
+                (xp, cent), eager)
+
+        return step
+
+    def _local_step(self, logical, centroids):
+        """Replicated-data step for the shared Lloyd driver: the eager
+        step with an all-true row mask (ONE copy of the medoid-update
+        mathematics to keep in sync)."""
+        return _kmedoids_eager_step(
+            self.n_clusters, logical.shape[0])(logical, centroids)
+
     def fit(self, x: DNDarray) -> "KMedoids":
+        """Medoid iteration through the shared ``_run_lloyd`` driver
+        (the historic batched/non-batched loop pair deduped into
+        ``_KCluster``)."""
         if not isinstance(x, DNDarray):
             raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
         if x.split not in (None, 0):
             x = x.resplit(0)
         self._initialize_cluster_centers(x)
 
-        k = self.n_clusters
-        xp = x.larray.astype(jnp.float32)
-        centroids = self._cluster_centers._logical().astype(jnp.float32)
         n = x.shape[0]
+        # fresh buffer: the fused step donates the carried centroids
+        centroids = jnp.array(self._cluster_centers._logical(), jnp.float32)
 
         if x.split == 0 and x.comm.size > 1 and n > 0:
-            step = _kmedoids_step_fn(xp.shape, k, n, x.comm)
-            it = 0
-            labels = None
-            for it in range(1, self.max_iter + 1):
-                centroids, shift, labels = step(xp, centroids)
-                if float(shift) == 0.0:
-                    break
+            xp = x.larray.astype(jnp.float32)
+            step = self._step_dispatcher(xp.shape, n, x.comm)
+            centroids, labels, it = self._run_lloyd(step, xp, centroids)
             self._cluster_centers = DNDarray.from_logical(
                 centroids, None, x.device, x.comm)
+            labels = jax.device_put(labels, x.comm.sharding(1, 0))
             self._labels = DNDarray(
                 labels, (n,), types.canonical_heat_type(labels.dtype), 0,
                 x.device, x.comm)
@@ -130,24 +197,8 @@ class KMedoids(_KCluster):
             return self
 
         logical = x._logical().astype(jnp.float32)
-        it = 0
-        for it in range(1, self.max_iter + 1):
-            d = jnp.sum(jnp.abs(logical[:, None, :] - centroids[None, :, :]), axis=-1)
-            labels = jnp.argmin(d, axis=1)
-            member = labels[:, None] == jnp.arange(k)[None, :]
-            counts = jnp.sum(member, axis=0)
-            sums = member.astype(logical.dtype).T @ logical
-            means = sums / jnp.maximum(counts, 1)[:, None]
-            # snap each mean to the nearest member point (the medoid step)
-            d_mean = jnp.sum(jnp.abs(logical[:, None, :] - means[None, :, :]), axis=-1)
-            d_mean = jnp.where(member, d_mean, jnp.inf)
-            medoid_idx = jnp.argmin(d_mean, axis=0)  # (k,)
-            new_centroids = logical[medoid_idx]
-            new_centroids = jnp.where((counts > 0)[:, None], new_centroids, centroids)
-            shift = float(jnp.sum((new_centroids - centroids) ** 2))
-            centroids = new_centroids
-            if shift == 0.0:
-                break
+        centroids, labels, it = self._run_lloyd(
+            self._local_step, logical, centroids)
 
         self._cluster_centers = DNDarray.from_logical(centroids, None, x.device, x.comm)
         self._labels = DNDarray.from_logical(

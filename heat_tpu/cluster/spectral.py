@@ -3,6 +3,13 @@
 Pipeline parity with the reference (``spectral.py:12,150``): rbf kernel →
 ``Laplacian.construct`` → Lanczos tridiagonalization (distributed matvecs) →
 dense eig of the small tridiagonal T → KMeans on the leading eigenvectors.
+
+Both hot loops of this pipeline ride the tape-compiled fit-step engine
+(``fusion.fit_step_call``, ``doc/analytics.md``): the Lanczos inner loop
+dispatches ONE donated executable per iteration (``linalg.solver.lanczos``)
+and the KMeans assignment runs the packed-collective Lloyd step — escape
+hatch ``HEAT_TPU_FUSION_FIT=0`` restores the legacy per-op/legacy-program
+paths end to end.
 """
 
 from __future__ import annotations
@@ -65,10 +72,16 @@ class Spectral(ClusteringMixin, BaseEstimator):
         )
         self._labels = None
         self._eigenvectors = None
+        self._n_iter = None
 
     @property
     def labels_(self):
         return self._labels
+
+    @property
+    def n_iter_(self):
+        """Lloyd iterations the embedding KMeans ran (None before fit)."""
+        return self._n_iter
 
     def _spectral_embedding(self, x: DNDarray):
         """Laplacian eigenvector embedding via Lanczos (reference ``spectral.py:120-148``)."""
@@ -102,6 +115,7 @@ class Spectral(ClusteringMixin, BaseEstimator):
             kmeans.fit(emb)
             self._labels = kmeans.labels_
             self._eigenvectors = evecs
+            self._n_iter = kmeans.n_iter_
         else:
             raise NotImplementedError(f"assign_labels={self.assign_labels!r} not supported")
         return self

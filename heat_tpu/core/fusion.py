@@ -311,6 +311,13 @@ _RESPLIT = _env_on("HEAT_TPU_FUSION_RESPLIT")
 # round-trips and all) and the model-level fused steps revert to their
 # historic GSPMD/check_vma train programs
 _STEP = _env_on("HEAT_TPU_FUSION_STEP")
+# escape hatch for the tape-compiled analytics fit steps alone: with 0,
+# the estimator family (KMeans/KMedians/KMedoids Lloyd iterations, the
+# Lanczos inner loop, Lasso coordinate sweeps, the KNN/GaussianNB
+# predict-assign programs) runs its legacy step programs — the exact
+# pre-fit-fusion dispatch, without donation, packed collectives or the
+# fusion program-cache keying
+_FIT = _env_on("HEAT_TPU_FUSION_FIT")
 
 
 def _parse_codec(val):
@@ -527,6 +534,35 @@ def step_override(flag: bool):
         yield
     finally:
         set_step_enabled(prev)
+
+
+def fit_enabled() -> bool:
+    """Whether the analytics fit-step engine is on: estimator ``fit()``
+    hot loops (and the KNN/GaussianNB predict-assign programs) dispatch
+    ONE donated, packed-collective executable per iteration through
+    :func:`fit_step_call` (``HEAT_TPU_FUSION_FIT``, default on; also
+    requires the master ``HEAT_TPU_FUSION`` switch)."""
+    return _ENABLED and _FIT
+
+
+def set_fit_enabled(flag: bool) -> bool:
+    """Toggle the analytics fit-step extension alone; returns the
+    previous setting."""
+    global _FIT
+    prev = _FIT
+    _FIT = bool(flag)
+    return prev
+
+
+@contextlib.contextmanager
+def fit_override(flag: bool):
+    """Context manager form of :func:`set_fit_enabled` (the fused-vs-
+    legacy estimator parity tests and the analytics bench A/B)."""
+    prev = set_fit_enabled(flag)
+    try:
+        yield
+    finally:
+        set_fit_enabled(prev)
 
 
 def quant_codec() -> Optional[str]:
@@ -3731,6 +3767,52 @@ def trace_step(fn, donate_argnums=(), block=True):
 
 
 # ---------------------------------------------------------------------- #
+# tape-compiled analytics fit steps                                      #
+# ---------------------------------------------------------------------- #
+def fit_step_call(key, build, args, eager):
+    """Dispatch ONE compiled analytics fit/predict step through the
+    fusion program cache — the estimator-family sibling of
+    :func:`trace_step` (KMeans/KMedians/KMedoids Lloyd iterations, the
+    Lanczos inner loop, Lasso coordinate sweeps, the KNN ring and
+    GaussianNB likelihood programs ride this).
+
+    ``key`` is the caller's structural signature (shapes, dtypes, the
+    communicator cache key); the full program key appends the captured
+    :func:`quant_key`/:func:`chunk_key`/:func:`hier_key` tuples, so a
+    wire-codec toggle compiles a SIBLING program instead of reusing one
+    traced under the other wire format (the PR 9 deferred-trace
+    discipline). ``build(qk, ck, hk)`` returns the compiled callable and
+    must PIN the captured tuples into any :func:`packed_psum` it traces.
+    ``eager`` replays the same mathematics per-op (unjitted, GSPMD
+    collectives) — the degrade path of the ``fit.step.dispatch`` fault
+    site and of real compile/dispatch failures, counted in
+    ``op_engine.fit_step_fallbacks``; a failure after a donated input
+    buffer was already invalidated re-raises (replaying from dead
+    buffers would be the PR 8 flush-fallback hazard). Successful
+    dispatches count ``op_engine.fit_step_flushes``.
+
+    With the engine off (``HEAT_TPU_FUSION_FIT=0`` or the master
+    switch), callers run their legacy step programs and never reach
+    here — see :func:`fit_enabled`.
+    """
+    qk, ck, hk = quant_key(), chunk_key(), hier_key()
+    full_key = ("fit",) + tuple(key) + (qk, ck, hk)
+    try:
+        prog = program_cache().get_custom(
+            full_key, lambda: build(qk, ck, hk))
+        _faults().check("fit.step.dispatch")
+        out = prog(*args)
+    except Exception:
+        for a in args:
+            if getattr(a, "is_deleted", lambda: False)():
+                raise  # donated buffer already invalidated — no replay
+        _metrics().inc("op_engine.fit_step_fallbacks")
+        return eager(*args)
+    _metrics().inc("op_engine.fit_step_flushes")
+    return out
+
+
+# ---------------------------------------------------------------------- #
 # observability                                                          #
 # ---------------------------------------------------------------------- #
 def stats() -> dict:
@@ -3746,6 +3828,10 @@ def stats() -> dict:
         "step_enabled": _STEP,
         "step_flushes": int(c.get("op_engine.fusion_step_flushes", 0)),
         "step_fallbacks": int(c.get("op_engine.fusion_step_fallbacks", 0)),
+        "fit_enabled": _FIT,
+        "fit_step_flushes": int(c.get("op_engine.fit_step_flushes", 0)),
+        "fit_step_fallbacks": int(
+            c.get("op_engine.fit_step_fallbacks", 0)),
         "flushes": flushes,
         "flush_fallbacks": int(
             c.get("op_engine.fusion_flush_fallbacks", 0)),

@@ -11,6 +11,7 @@ the optional ``netCDF4`` package exactly like the reference.
 
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import Iterable, List, Optional, Tuple, Union
 
@@ -23,8 +24,10 @@ from . import devices, factories, types
 from .communication import sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_axis
+from ..utils import metrics as _metrics
 
 __all__ = [
+    "DataStream",
     "load",
     "load_csv",
     "load_hdf5",
@@ -37,6 +40,82 @@ __all__ = [
     "supports_hdf5",
     "supports_netcdf",
 ]
+
+
+class DataStream:
+    """Re-iterable out-of-core chunk source — the ``stream=True`` mode of
+    :func:`load_hdf5` / :func:`load_netcdf`.
+
+    :meth:`iter_chunks` re-opens the dataset and yields consecutive
+    row-blocks as split-0 ``DNDarray`` chunks: per chunk the host reads
+    one device-block slice at a time (the :func:`_shard_and_wrap`
+    discipline), so the peak HOST footprint is one device block and the
+    peak DEVICE footprint is one chunk — the full dataset is never
+    materialized, and a new ``iter_chunks`` call streams the same data
+    again (the epoch re-read an out-of-core ``fit_stream`` needs).
+
+    Chunk accounting (the out-of-core acceptance evidence):
+    ``chunks_read`` / ``bytes_read`` accumulate over the stream's
+    lifetime and ``peak_chunk_bytes`` is the largest single chunk's
+    physical payload — asserting it under a configured in-memory cap
+    proves the resident set stayed below full materialization. The
+    process-wide counters ``io.stream_chunks`` / ``io.stream_bytes``
+    mirror the totals into ``heat_tpu.utils.metrics``.
+    """
+
+    def __init__(self, open_fn, gshape, dtype, device, comm, name=""):
+        self._open = open_fn
+        self.shape = tuple(int(s) for s in gshape)
+        self.dtype = dtype
+        self.device = device
+        self.comm = comm
+        self.name = name
+        self.chunks_read = 0
+        self.bytes_read = 0
+        self.peak_chunk_bytes = 0
+
+    def __repr__(self) -> str:
+        return (f"DataStream({self.name!r}, shape={self.shape}, "
+                f"dtype={self.dtype}, chunks_read={self.chunks_read})")
+
+    def iter_chunks(self, rows_per_chunk: int):
+        """Yield the dataset as consecutive split-0 chunks of at most
+        ``rows_per_chunk`` logical rows (the tail chunk is smaller)."""
+        rows = int(rows_per_chunk)
+        if rows <= 0:
+            raise ValueError(
+                f"rows_per_chunk must be positive, got {rows_per_chunk!r}")
+        n = self.shape[0]
+        jdt = self.dtype.jax_type()
+
+        def gen():
+            with self._open() as read:
+                for lo in range(0, n, rows):
+                    hi = min(lo + rows, n)
+                    gshape = (hi - lo,) + self.shape[1:]
+
+                    def load(slices, _lo=lo):
+                        # _shard_and_wrap clamps the split axis to
+                        # concrete logical bounds — shift them into the
+                        # file's row space
+                        shifted = (slice(slices[0].start + _lo,
+                                         slices[0].stop + _lo),) \
+                            + tuple(slices[1:])
+                        return read(shifted)
+
+                    chunk = _shard_and_wrap(
+                        load, gshape, jdt, 0, self.device, self.comm)
+                    nbytes = (int(np.prod(chunk.larray.shape))
+                              * jnp.dtype(chunk.larray.dtype).itemsize)
+                    self.chunks_read += 1
+                    self.bytes_read += nbytes
+                    self.peak_chunk_bytes = max(self.peak_chunk_bytes,
+                                                nbytes)
+                    _metrics.inc("io.stream_chunks")
+                    _metrics.inc("io.stream_bytes", nbytes)
+                    yield chunk
+
+        return gen()
 
 try:
     import h5py
@@ -131,8 +210,16 @@ def load_hdf5(
     split=None,
     device=None,
     comm=None,
-) -> DNDarray:
-    """Load an HDF5 dataset chunk-parallel (reference ``io.py:55``)."""
+    stream: bool = False,
+):
+    """Load an HDF5 dataset chunk-parallel (reference ``io.py:55``).
+
+    ``stream=True`` returns a :class:`DataStream` instead of loading:
+    the out-of-core mode — ``stream.iter_chunks(rows_per_chunk)`` feeds
+    consecutive split-0 row chunks (re-opened per pass, so each
+    ``fit_stream`` epoch re-reads from disk and datasets larger than
+    host RAM never materialize). Streaming requires ``split`` in
+    ``(None, 0)`` — chunks are always row-split."""
     if not supports_hdf5():
         raise RuntimeError("hdf5 is required for HDF5 operations, but h5py is not available")
     if not isinstance(path, str):
@@ -150,9 +237,22 @@ def load_hdf5(
             gshape = tuple(
                 int(s * load_fraction) if i == ax else s for i, s in enumerate(gshape)
             )
-        return _shard_and_wrap(
-            lambda slices: data[slices], gshape, dtype.jax_type(), split, device, comm
-        )
+        if not stream:
+            return _shard_and_wrap(
+                lambda slices: data[slices], gshape, dtype.jax_type(), split, device, comm
+            )
+    if split not in (None, 0):
+        raise ValueError(
+            f"stream=True yields row-split chunks; split={split!r} is not "
+            "supported")
+
+    @contextlib.contextmanager
+    def _open():
+        with h5py.File(path, "r") as handle:
+            yield lambda slices: handle[dataset][slices]
+
+    return DataStream(_open, gshape, dtype, device, comm,
+                      name=f"{path}:{dataset}")
 
 
 def _np_save_dtype(data: DNDarray):
@@ -226,8 +326,14 @@ def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs
                 dset[slices] = block
 
 
-def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
-    """Load a NetCDF variable (reference ``io.py:265``)."""
+def load_netcdf(path: str, variable: str, dtype=types.float32, split=None,
+                device=None, comm=None, stream: bool = False):
+    """Load a NetCDF variable (reference ``io.py:265``).
+
+    ``stream=True`` returns a :class:`DataStream` (chunked out-of-core
+    ingestion, same contract as :func:`load_hdf5`'s streaming mode —
+    masked/missing-value semantics are applied per chunk exactly as the
+    in-memory load applies them)."""
     if not supports_netcdf():
         raise RuntimeError(
             "netcdf is required for NetCDF operations — install netCDF4, "
@@ -250,19 +356,37 @@ def load_netcdf(path: str, variable: str, dtype=types.float32, split=None, devic
 
         return read
 
-    if __NETCDF == "netCDF4":
-        with nc.Dataset(path, "r") as handle:
-            data = handle.variables[variable]
+    @contextlib.contextmanager
+    def _open_var():
+        if __NETCDF == "netCDF4":
+            with nc.Dataset(path, "r") as handle:
+                yield handle.variables[variable]
+        else:
+            # maskandscale matches netCDF4's default semantics (CF
+            # scale_factor / add_offset applied, missing values masked)
+            # so both backends return the same physical values for
+            # packed variables
+            with _scipy_nc(path, "r", mmap=False,
+                           maskandscale=True) as handle:
+                yield handle.variables[variable]
+
+    if stream:
+        if split not in (None, 0):
+            raise ValueError(
+                f"stream=True yields row-split chunks; split={split!r} "
+                "is not supported")
+        with _open_var() as data:
             gshape = tuple(data.shape)
-            return _shard_and_wrap(
-                _read_chunk(data), gshape, dtype.jax_type(), split,
-                device, comm
-            )
-    # maskandscale matches netCDF4's default semantics (CF scale_factor /
-    # add_offset applied, missing values masked) so both backends return
-    # the same physical values for packed variables
-    with _scipy_nc(path, "r", mmap=False, maskandscale=True) as handle:
-        data = handle.variables[variable]
+
+        @contextlib.contextmanager
+        def _open():
+            with _open_var() as data:
+                yield _read_chunk(data)
+
+        return DataStream(_open, gshape, dtype, device, comm,
+                          name=f"{path}:{variable}")
+
+    with _open_var() as data:
         gshape = tuple(data.shape)
         return _shard_and_wrap(
             _read_chunk(data), gshape, dtype.jax_type(), split, device, comm

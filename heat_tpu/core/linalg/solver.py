@@ -1,12 +1,26 @@
-"""Iterative solvers (reference ``heat/core/linalg/solver.py``)."""
+"""Iterative solvers (reference ``heat/core/linalg/solver.py``).
+
+The Lanczos inner loop — spectral embedding's hot path — rides the
+tape-compiled analytics fit-step engine (``fusion.fit_step_call``,
+``doc/analytics.md``): for a row-split matrix each iteration is ONE
+compiled shard_map executable (matvec → all_gather → Rayleigh coefficient
+→ twice-applied classical re-orthogonalization → next norm) with the
+Krylov basis, the residual vector and the alpha/beta coefficient buffers
+all DONATED, and the iteration index a TRACED scalar so every iteration
+shares one program. The legacy per-op DNDarray loop remains the
+``HEAT_TPU_FUSION_FIT=0`` escape hatch and the replicated-matrix path.
+"""
 
 from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
-from .. import arithmetics, factories
+from .. import arithmetics, factories, fusion
+from .._compat import shard_map
 from ..dndarray import DNDarray
 from .basics import matmul, dot, transpose, _square_check
 
@@ -54,6 +68,139 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
     return x
 
 
+_LANCZOS_CACHE: dict = {}
+
+
+def _lanczos_step_fn(phys_shape, jdt, m, comm, qk, ck, hk):
+    """ONE donated executable per Lanczos iteration for a row-split
+    matrix: ``(ab, vbuf, w, abuf, bbuf, beta_in, i) -> (vbuf, w, abuf,
+    bbuf, beta_next)``.
+
+    ``ab`` is the (N_pad, n) operator — rows zero-padded to the
+    canonical layout, columns UNPADDED (no full operator copy: the
+    matvec contracts against ``vi[:n]``, identical since vectors carry
+    exact zeros beyond ``n``); all vectors live replicated in the padded
+    coordinate space, and the zero pad rows of ``ab`` preserve the
+    invariant. The body: normalize the residual into ``v_i``, row-local
+    matvec + ONE tiled all_gather (the iteration's only collective),
+    classical Gram-Schmidt against the whole masked basis applied TWICE
+    (columns ≥ i are zero, so they project to nothing — CGS2 matches
+    the legacy sequential reorthogonalization to the documented
+    tolerance), and the next residual norm. ``i`` is traced, so ONE
+    program serves every iteration; vbuf/w/abuf/bbuf are donated."""
+    key = ("lanc", phys_shape, str(jdt), m, comm.cache_key, qk, ck, hk)
+    fn = _LANCZOS_CACHE.get(key)
+    if fn is not None:
+        return fn
+    axis = comm.axis_name
+
+    def body(ab, vbuf, w, abuf, bbuf, beta_in, i):
+        vi = w / beta_in
+        vbuf = jax.lax.dynamic_update_slice(
+            vbuf, vi[:, None], (jnp.int32(0), i))
+        wl = ab @ vi[:ab.shape[1]]  # (c,) local rows; pad rows stay zero
+        w1 = jax.lax.all_gather(wl, axis, axis=0, tiled=True)
+        proj = vbuf.T @ w1  # (m,) — proj[i] is the Rayleigh alpha
+        alpha = proj[i]
+        w2 = w1 - vbuf @ proj
+        proj2 = vbuf.T @ w2  # second CGS pass ("twice is enough")
+        w2 = w2 - vbuf @ proj2
+        abuf = jax.lax.dynamic_update_slice(abuf, alpha[None], (i,))
+        bbuf = jax.lax.dynamic_update_slice(bbuf, beta_in[None], (i,))
+        beta_next = jnp.sqrt(jnp.sum(w2 * w2))
+        return vbuf, w2, abuf, bbuf, beta_next
+
+    fn = jax.jit(
+        shard_map(body, mesh=comm.mesh,
+                  in_specs=(comm.spec(2, 0), P(), P(), P(), P(), P(), P()),
+                  out_specs=(P(), P(), P(), P(), P()), check_vma=False),
+        donate_argnums=(1, 2, 3, 4))
+    _LANCZOS_CACHE[key] = fn
+    return fn
+
+
+def _lanczos_iter_eager(ab, vbuf, w, abuf, bbuf, beta, i, vi=None):
+    """One Lanczos iteration dispatched op-by-op (unjitted jnp, GSPMD
+    collectives): the ``fit.step.dispatch`` degrade path; with an
+    explicit ``vi`` it is also the tiny-beta RESTART branch (the
+    regenerated vector replaces ``w / beta``)."""
+    if vi is None:
+        vi = w / beta
+    idx0 = jnp.asarray(0, i.dtype) if hasattr(i, "dtype") else 0
+    vbuf = jax.lax.dynamic_update_slice(vbuf, vi[:, None], (idx0, i))
+    w1 = ab @ vi[:ab.shape[1]]
+    proj = vbuf.T @ w1
+    alpha = proj[i]
+    w2 = w1 - vbuf @ proj
+    proj2 = vbuf.T @ w2
+    w2 = w2 - vbuf @ proj2
+    abuf = jax.lax.dynamic_update_slice(abuf, alpha[None], (i,))
+    bbuf = jax.lax.dynamic_update_slice(
+        bbuf, jnp.asarray(beta, bbuf.dtype)[None], (i,))
+    beta_next = jnp.sqrt(jnp.sum(w2 * w2))
+    return vbuf, w2, abuf, bbuf, beta_next
+
+
+def _lanczos_fused(A: DNDarray, m: int, v0, V_out, T_out):
+    """Tape-compiled Lanczos for a row-split operator: the whole inner
+    loop is key-lookup + one donated dispatch per iteration plus a
+    single ``float(beta)`` host read (the restart guard)."""
+    from .. import random as ht_random
+
+    comm = A.comm
+    n = A.shape[0]
+    phys = A.filled(0) if A.pad else A.larray
+    if not jnp.issubdtype(phys.dtype, jnp.inexact):
+        phys = phys.astype(
+            jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    jdt = phys.dtype
+    npad = phys.shape[0]
+    # columns stay UNPADDED (the matvec slices vi[:n]) — padding them
+    # would materialize a second full operator copy on the large-n path
+    ab = phys
+    w = jnp.pad(jnp.asarray(v0.resplit(None)._logical(), jdt),
+                (0, npad - n))
+    vbuf = jnp.zeros((npad, m), jdt)
+    abuf = jnp.zeros((m,), jdt)
+    bbuf = jnp.zeros((m,), jdt)
+    beta = 1.0  # i=0 sentinel: v_0 = w / 1 = the (caller-normalized) v0
+    for i in range(m):
+        ii = jnp.asarray(i, jnp.int32)
+        bb = jnp.asarray(beta, jdt)
+        if i > 0 and beta < 1e-10:
+            # restart with a random orthogonal vector (reference branch;
+            # eager — the regenerated vi replaces the w/beta normalize)
+            vr = jnp.pad(jnp.asarray(
+                ht_random.rand(n, comm=comm).resplit(None)._logical(),
+                jdt), (0, npad - n))
+            vr = vr - vbuf @ (vbuf.T @ vr)
+            vi = vr / jnp.sqrt(jnp.sum(vr * vr))
+            vbuf, w, abuf, bbuf, bnext = _lanczos_iter_eager(
+                ab, vbuf, w, abuf, bbuf, bb, ii, vi=vi)
+        else:
+            vbuf, w, abuf, bbuf, bnext = fusion.fit_step_call(
+                ("lanczos.step", tuple(ab.shape), str(jdt), m,
+                 comm.cache_key),
+                lambda qk, ck, hk: _lanczos_step_fn(
+                    ab.shape, jdt, m, comm, qk, ck, hk),
+                (ab, vbuf, w, abuf, bbuf, bb, ii), _lanczos_iter_eager)
+        beta = float(bnext)
+
+    T_np = jnp.diag(abuf)
+    if m > 1:
+        off = bbuf[1:]
+        T_np = T_np + jnp.diag(off, k=1) + jnp.diag(off, k=-1)
+    T = DNDarray.from_logical(T_np, None, A.device, A.comm)
+    V = DNDarray.from_logical(vbuf[:n], 0, A.device, A.comm)
+    if V_out is not None:
+        V_out.larray = V.resplit(V_out.split).larray
+        if T_out is not None:
+            T_out.larray = T.larray
+            return V_out, T_out
+        return V_out, T
+    return V, T
+
+
 def lanczos(
     A: DNDarray,
     m: int,
@@ -64,7 +211,11 @@ def lanczos(
     """Lanczos tridiagonalization (reference ``solver.py:68-184``).
 
     Returns ``(V, T)`` with ``A ≈ V @ T @ V.T``; used by spectral clustering
-    exactly like the reference (``cluster/spectral.py:127``).
+    exactly like the reference (``cluster/spectral.py:127``). For a
+    row-split matrix the inner loop dispatches ONE donated compiled
+    executable per iteration (:func:`_lanczos_step_fn`); the numerics
+    contract of its twice-applied classical re-orthogonalization vs the
+    legacy sequential form is documented in ``doc/analytics.md``.
     """
     if not isinstance(A, DNDarray):
         raise TypeError(f"A needs to be of type ht.DNDarray, but was {type(A)}")
@@ -80,6 +231,10 @@ def lanczos(
         vr = ht_random.rand(n, split=A.split and 0, comm=A.comm)
         norm0 = exponential.sqrt(dot(vr, vr))
         v0 = arithmetics.div(vr, norm0)
+
+    if A.split == 0 and n > 0 and m >= 1 and fusion.fit_enabled():
+        # tape-compiled inner loop: one donated dispatch per iteration
+        return _lanczos_fused(A, m, v0, V_out, T_out)
 
     alphas = []
     betas = [0.0]

@@ -9,14 +9,33 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import factories, types
+from ..core import factories, fusion, types
 from ..core.base import BaseEstimator, ClassificationMixin
 from ..core.dndarray import DNDarray
 
 __all__ = ["GaussianNB"]
+
+
+def _jll_body(xl, means, variances, log_prior):
+    """Per-class joint log likelihood, (n, k): the predict-assign hot
+    math. Module-level so the compiled and eager paths share ONE
+    definition (unjitted it is today's inline op-by-op dispatch and the
+    ``fit.step.dispatch`` degrade path)."""
+    # (n, k): -0.5 * sum(log(2πσ²)) - 0.5 * sum((x-μ)²/σ²)
+    const = -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * variances), axis=1)  # (k,)
+    diff = xl[:, None, :] - means[None, :, :]
+    mahal = -0.5 * jnp.sum(diff * diff / variances[None, :, :], axis=2)
+    return log_prior[None, :] + const[None, :] + mahal
+
+
+# GSPMD places the (collective-free) sharded row math; jit re-specializes
+# per avals, and fit_step_call memoizes per signature — no extra cache
+# layer needed
+_JLL_JIT = jax.jit(_jll_body)
 
 
 class GaussianNB(ClassificationMixin, BaseEstimator):
@@ -143,8 +162,11 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
 
     def _joint_log_likelihood(self, x: DNDarray):
         """Per-class joint log likelihood (reference ``gaussianNB.py:391``):
-        shard-local rows against the replicated class moments. Returns
-        ``(jll_physical, x)`` with ``x`` normalized to a row split."""
+        shard-local rows against the replicated class moments, compiled
+        as ONE program per signature through the fit-step engine (the
+        predict-assign path; ``HEAT_TPU_FUSION_FIT=0`` restores the
+        historic inline op-by-op dispatch). Returns ``(jll_physical, x)``
+        with ``x`` normalized to a row split."""
         if x.split not in (None, 0):
             x = x.resplit(0)
         xl = x.larray.astype(jnp.float64)
@@ -152,11 +174,15 @@ class GaussianNB(ClassificationMixin, BaseEstimator):
         variances = jnp.asarray(self.var_.numpy())
         priors = jnp.asarray(self.class_prior_.numpy())
         log_prior = jnp.log(priors)
-        # (n, k): -0.5 * sum(log(2πσ²)) - 0.5 * sum((x-μ)²/σ²)
-        const = -0.5 * jnp.sum(jnp.log(2.0 * jnp.pi * variances), axis=1)  # (k,)
-        diff = xl[:, None, :] - means[None, :, :]
-        mahal = -0.5 * jnp.sum(diff * diff / variances[None, :, :], axis=2)
-        return log_prior[None, :] + const[None, :] + mahal, x
+        kk = means.shape[0]
+        if fusion.fit_enabled():
+            jll = fusion.fit_step_call(
+                ("gnb.jll", tuple(xl.shape), kk, str(xl.dtype), x.split),
+                lambda qk, ck, hk: _JLL_JIT,
+                (xl, means, variances, log_prior), _jll_body)
+        else:
+            jll = _jll_body(xl, means, variances, log_prior)
+        return jll, x
 
     def logsumexp(self, a, axis=None, b=None, keepdims=False, return_sign=False):
         """Stable log-sum-exp (reference ``gaussianNB.py:407``)."""

@@ -110,6 +110,12 @@ SITES: Dict[str, tuple] = {
         "and packed_psum) — degrades to the FLAT packed collective (for "
         "flushes via the cache key, hitting any cached flat program), "
         "counted in op_engine.hier_fallbacks"),
+    "fit.step.dispatch": (
+        FaultInjected,
+        "compiled analytics fit-step dispatch (fusion.fit_step_call: the "
+        "estimator Lloyd/Lanczos/coordinate-sweep and KNN/GaussianNB "
+        "predict programs) — degrades to the eager per-op iteration with "
+        "identical results, counted in op_engine.fit_step_fallbacks"),
     # reshard planner (core/resharding.py)
     "reshard.plan.build": (
         FaultInjected,

@@ -262,6 +262,32 @@ def run_hier_ab(n: int, timeout: float) -> dict:
                        extra_env={"HEAT_TPU_MESH_TIERS": f"2,{n // 2}"})
 
 
+# analytics slice for the fit-step A/B: the estimator surfaces whose
+# fit()/predict hot loops now dispatch through fusion.fit_step_call
+# (cluster family Lloyd iterations, Lasso coordinate sweeps, the Lanczos
+# inner loop behind spectral, the KNN/GaussianNB assign programs) plus
+# the fit contract module itself — the per-test HEAT_TPU_LADDER_STATS
+# log carries fit_step_flushes/fit_step_fallbacks so the A/B shows which
+# tests actually dispatched compiled iterations
+_FIT_AB_TESTS = [
+    "tests/test_analytics_fit.py", "tests/test_estimators.py",
+    "tests/test_estimators_distributed.py", "tests/test_spatial_cluster.py",
+    "tests/test_cluster_distributed.py", "tests/test_linalg.py",
+]
+
+
+def run_fit_ab(n: int, timeout: float) -> dict:
+    """``HEAT_TPU_FUSION_FIT=0`` vs ``1`` on the analytics slice: the
+    fused leg must keep every estimator test green (the tape-compiled
+    step may never change WHICH mathematics runs — only pack its psums
+    and donate its carries, within the documented numerics contract),
+    and the FIT=0 leg proves the escape hatch restores the legacy step
+    programs — exit-gating, like the fusion/quant/chunk/hier A/Bs."""
+    return _run_env_ab("HEAT_TPU_FUSION_FIT",
+                       (("legacy", "0"), ("fused", "1")),
+                       _FIT_AB_TESTS, n, timeout)
+
+
 _CHAOS_SITE_RE = re.compile(
     r"test_chaos_site\[([^\]]+)\]\s+(PASSED|FAILED|ERROR|SKIPPED)")
 
@@ -376,6 +402,14 @@ def main():
     ap.add_argument("--no-hier-ab", dest="hier_ab", action="store_false",
                     help="skip the hierarchical-collective A/B")
     ap.add_argument("--hier-ab-timeout", type=float, default=900.0)
+    ap.add_argument("--fit-ab", dest="fit_ab", action="store_true",
+                    default=True,
+                    help="run the HEAT_TPU_FUSION_FIT=0 vs 1 A/B on the "
+                         "cluster/lasso/lanczos analytics slice "
+                         "(default on)")
+    ap.add_argument("--no-fit-ab", dest="fit_ab", action="store_false",
+                    help="skip the tape-compiled fit-step A/B")
+    ap.add_argument("--fit-ab-timeout", type=float, default=900.0)
     ap.add_argument("--serve-smoke", dest="serve_smoke", action="store_true",
                     default=True, help="run the serving smoke (default on)")
     ap.add_argument("--no-serve-smoke", dest="serve_smoke",
@@ -488,6 +522,17 @@ def main():
         hier_bad = not hab.get("agree", False)
         print(json.dumps({"hier_ab_ok": not hier_bad}), flush=True)
 
+    fit_bad = False
+    if args.fit_ab and not args.examples_only:
+        # fit gate: the analytics slice must pass with the tape-compiled
+        # fit steps ON and OFF (4-device mesh) — any leg disagreement is
+        # semantic drift the compiled estimator iteration introduced
+        print("=== fit-step (analytics) A/B (4 devices) ===", flush=True)
+        fab = run_fit_ab(4, args.fit_ab_timeout)
+        artifact["fit_ab"] = fab
+        fit_bad = not fab.get("agree", False)
+        print(json.dumps({"fit_ab_ok": not fit_bad}), flush=True)
+
     chunk_bad = False
     if args.chunk_ab and not args.examples_only:
         # chunk gate: the training-heavy subset must pass unchunked AND
@@ -531,7 +576,7 @@ def main():
     bad = ([r for r in ladder if r.get("rc") != 0]
            + [r for r in ex if r.get("rc") != 0])
     sys.exit(1 if bad or audit_bad or serve_bad or fusion_bad or quant_bad
-             or chunk_bad or hier_bad or chaos_bad else 0)
+             or chunk_bad or hier_bad or fit_bad or chaos_bad else 0)
 
 
 if __name__ == "__main__":

@@ -109,6 +109,13 @@ def pytest_runtest_teardown(item, nextitem):
                 c.get("op_engine.fusion_step_flushes", 0)),
             "fusion_step_fallbacks": int(
                 c.get("op_engine.fusion_step_fallbacks", 0)),
+            # tape-compiled analytics fit steps (the FIT=0/1 ladder A/B
+            # reads these: which tests dispatched compiled estimator
+            # iterations, and whether any degraded to the eager loop)
+            "fit_step_flushes": int(
+                c.get("op_engine.fit_step_flushes", 0)),
+            "fit_step_fallbacks": int(
+                c.get("op_engine.fit_step_fallbacks", 0)),
             # quantized packed collectives: which tests actually moved
             # quantized bytes (the QUANT=0/1 ladder A/B reads these)
             "quant_collectives": int(

@@ -42,6 +42,7 @@ from heat_tpu.utils.checkpointing import CheckpointManager
 FALLBACK_COUNTERS = (
     "op_engine.fusion_flush_fallbacks",
     "op_engine.fusion_step_fallbacks",
+    "op_engine.fit_step_fallbacks",
     "op_engine.quant_fallbacks",
     "op_engine.chunk_fallbacks",
     "op_engine.hier_fallbacks",
@@ -68,6 +69,10 @@ MATRIX = {
     "fusion.step.trace": ("train", "op_engine.fusion_step_fallbacks", 2),
     "fusion.step.dispatch": ("train", None, 0),
     "fusion.quant.encode": ("quant", "op_engine.quant_fallbacks", 1),
+    # the faulted first Lloyd dispatch degrades to the eager op-by-op
+    # iteration; the remaining iterations and the assign pass run the
+    # compiled programs — same centroids/labels as the fault-free run
+    "fit.step.dispatch": ("fit", "op_engine.fit_step_fallbacks", 1),
     "fusion.chunk.dispatch": ("chunk", "op_engine.chunk_fallbacks", 1),
     "fusion.hier.exchange": ("hier", "op_engine.hier_fallbacks", 1),
     "reshard.plan.build": ("resplit", "resharding.plan_build_fallbacks", 1),
@@ -203,6 +208,25 @@ def _wl_hier(tmp_path):
         return {"r": r.numpy()}, {}
 
 
+def _wl_fit(tmp_path):
+    """A 3-iteration KMeans fit through the tape-compiled fit-step
+    engine (explicit seed centroids, tol<0 → fixed trip count, so the
+    faulted and fault-free runs execute identical iteration schedules).
+    The faulted first dispatch degrades to the eager op-by-op Lloyd
+    iteration — same mathematics, allclose within the documented ulp
+    contract."""
+    fusion.reset()
+    rng = np.random.default_rng(11)
+    data = rng.standard_normal((26, 4)).astype(np.float32)
+    seed = ht.array(data[:3].copy())
+    x = ht.array(data, split=0)
+    km = ht.cluster.KMeans(n_clusters=3, init=seed, max_iter=3, tol=-1.0)
+    km.fit(x)
+    return {"centers": np.asarray(km.cluster_centers_.numpy()),
+            "labels": np.asarray(km.labels_.numpy()),
+            "inertia": np.asarray(km.inertia_)}, {}
+
+
 def _wl_resplit(tmp_path):
     """Eager planner path (fusion off so reshard() itself is exercised,
     plan cache reset so the build site is reached)."""
@@ -279,7 +303,7 @@ def _wl_init(tmp_path):
 
 
 _WORKLOADS = {"ops": _wl_ops, "train": _wl_train, "quant": _wl_quant,
-              "chunk": _wl_chunk, "hier": _wl_hier,
+              "chunk": _wl_chunk, "hier": _wl_hier, "fit": _wl_fit,
               "resplit": _wl_resplit,
               "serve": _wl_serve, "ckpt": _wl_ckpt, "init": _wl_init}
 

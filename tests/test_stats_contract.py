@@ -35,6 +35,7 @@ OP_ENGINE_KEYS = {"align_resplits", "fusion"}
 FUSION_KEYS = {
     "enabled", "reduce_enabled", "contract_enabled", "resplit_enabled",
     "step_enabled", "step_flushes", "step_fallbacks",
+    "fit_enabled", "fit_step_flushes", "fit_step_fallbacks",
     "flushes", "flush_fallbacks", "inline_flushes",
     "reduce_flushes", "contract_flushes",
     "resplit_flushes", "resplit_nodes", "resplit_fallbacks",
@@ -83,7 +84,8 @@ def test_runtime_stats_value_types_pinned():
 
     rt = ht.runtime_stats()
     fu = rt["op_engine"]["fusion"]
-    for k in ("flushes", "fused_ops", "step_flushes", "quant_collectives",
+    for k in ("flushes", "fused_ops", "step_flushes", "fit_step_flushes",
+              "fit_step_fallbacks", "quant_collectives",
               "quant_bytes_saved", "quant_fallbacks", "quant_min_numel",
               "chunk_count", "chunk_min_numel", "chunk_collectives",
               "chunk_fallbacks", "hier_collectives", "hier_fallbacks"):
@@ -91,7 +93,8 @@ def test_runtime_stats_value_types_pinned():
     assert fu["quant_codec"] in (None, "bf16", "int8")
     assert fu["hier_ici_codec"] in (None, "bf16")
     assert fu["mesh_tiers"] is None or isinstance(fu["mesh_tiers"], list)
-    for k in ("enabled", "reduce_enabled", "step_enabled", "hier_enabled"):
+    for k in ("enabled", "reduce_enabled", "step_enabled", "fit_enabled",
+              "hier_enabled"):
         assert isinstance(fu[k], bool), k
     # the whole snapshot must round-trip through json (dashboards)
     json.dumps(rt)
