@@ -1165,6 +1165,61 @@ def _serve_stage(timeout: float = 420.0):
         return {"serve_error": repr(exc)}
 
 
+def _serve_soak_stage(timeout: float = 600.0):
+    """Fail-soft overload-robustness stage (ISSUE 14): the open-loop
+    multi-tenant soak (``scripts/soak_serve.py --quick``, 4-device CPU
+    mesh, ``serve.batch.dispatch=every:5`` armed at 2x) flattened into
+    ``serve_soak_*`` columns — p99-under-load and shed-rate at 1x/2x
+    offered load plus the per-phase serve.* counter deltas, so the
+    robustness trajectory is tracked round-over-round like the perf
+    stages. Returns ``{"serve_soak_error": ...}`` on any failure — the
+    headline record survives either way."""
+    from __graft_entry__ import _cpu_env
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    soak = os.path.join(repo, "scripts", "soak_serve.py")
+    env = _cpu_env(4)
+    env["PYTHONPATH"] = repo
+    try:
+        out = subprocess.run(
+            [sys.executable, soak, "--quick"], env=env,
+            timeout=timeout, capture_output=True, text=True, cwd=repo)
+        line = next((l for l in reversed(out.stdout.splitlines())
+                     if l.startswith("{")), None)
+        if line is None:
+            tail = (out.stderr or out.stdout or "").strip().splitlines()[-3:]
+            return {"serve_soak_error":
+                    f"rc={out.returncode} " + " | ".join(tail)}
+        rep = json.loads(line)
+        rec = {
+            "serve_soak_ok": bool(rep.get("ok")),
+            "serve_soak_verdicts": rep.get("verdicts", {}),
+            "serve_soak_capacity_rps": rep.get("capacity_rps"),
+            "serve_soak_slo_hi_ms": rep.get("slo_hi_ms"),
+            "serve_soak_breaker_fastfail_ratio":
+                rep.get("breaker", {}).get("ratio"),
+        }
+        for ph in rep.get("phases", []):
+            tag = f"{ph.get('load_x'):g}x".replace(".", "p")
+            tens = ph.get("tenants", {})
+            tot = ph.get("totals", {})
+            offered = max(int(tot.get("offered", 0)), 1)
+            rec[f"serve_soak_p99_hi_{tag}_ms"] = (
+                tens.get("hi", {}).get("latency_ms", {}).get("p99"))
+            rec[f"serve_soak_p99_lo_{tag}_ms"] = (
+                tens.get("lo", {}).get("latency_ms", {}).get("p99"))
+            rec[f"serve_soak_shed_rate_{tag}"] = round(
+                int(tot.get("shed", 0)) / offered, 4)
+            rec[f"serve_soak_counters_{tag}"] = ph.get("counters_delta", {})
+        if not rep.get("ok"):
+            rec["serve_soak_error"] = f"verdicts failed (rc={out.returncode})"
+        return rec
+    except subprocess.TimeoutExpired:
+        return {"serve_soak_error": f"serve soak exceeded {timeout:.0f}s"}
+    except Exception as exc:
+        return {"serve_soak_error": repr(exc)}
+
+
 def _probe_default_backend(timeout_s: float):
     """(platform, count) of the env-default backend; None when it cannot
     come up. Shared with the driver entry points (jax-free import)."""
@@ -1380,6 +1435,10 @@ def main() -> None:
             try:
                 rec = json.loads(line)
                 rec.update(_serve_stage())
+                # overload-robustness soak (fail-soft, live records only,
+                # same 4-device CPU mesh): p99-under-load + shed-rate
+                # columns at 1x/2x offered load with faults armed
+                rec.update(_serve_soak_stage())
                 # fusion-engine speedup stage (fail-soft, live records
                 # only, same 4-device CPU mesh): eager vs fused op chains
                 rec.update(_fusion_stage())

@@ -42,6 +42,11 @@ def main():
     p.add_argument("--threads", type=int, default=4)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-wait-ms", type=float, default=2.0)
+    p.add_argument("--tenants", type=int, default=1, choices=(1, 2),
+                   help="2 = register an 'interactive' (priority 10, "
+                        "SLO) and a 'batch' (priority 0, queue quota) "
+                        "tenant over the one executor and print "
+                        "per-tenant runtime_stats")
     args = p.parse_args()
     if os.environ.get("HEAT_TPU_EXAMPLE_SMOKE"):  # CI ladder smoke: shrink
         args.d_model, args.layers, args.seq_len = 32, 1, 16
@@ -65,6 +70,18 @@ def main():
     ex = serve_transformer(model, params, seq_len=args.seq_len)
     ex.config.max_batch = args.max_batch
     ex.config.max_wait_ms = args.max_wait_ms
+    tenant_of = None
+    if args.tenants == 2:
+        # two tenants over ONE executor: the interactive tenant outranks
+        # the batch tenant in the queue and inherits an SLO deadline; the
+        # batch tenant is quota-bounded so it can never fill the shared
+        # queue bound (doc/serving.md "Multi-tenant admission")
+        ex.register_tenant("interactive", priority=10, slo_ms=60e3)
+        ex.register_tenant("batch", priority=0,
+                           max_queue=ex.config.queue_limit * 3 // 4)
+
+        def tenant_of(i):
+            return "interactive" if i % 3 == 0 else "batch"
 
     rows_mix = (1, 2, 3, 1, 2, 1)
     t0 = time.perf_counter()
@@ -89,7 +106,10 @@ def main():
     done = []
 
     def client(t):
-        futs = [ex.submit(x) for x in reqs[t::args.threads]]
+        idx = list(range(t, len(reqs), args.threads))
+        futs = [ex.submit(reqs[i],
+                          tenant=tenant_of(i) if tenant_of else None)
+                for i in idx]
         done.extend(np.asarray(f.result(600)).shape for f in futs)
 
     t0 = time.perf_counter()
@@ -116,6 +136,15 @@ def main():
                   for k in ("requests", "batches", "shed")},
         "resharding": ht.runtime_stats()["resharding"],
     }))
+    if args.tenants == 2:
+        # the per-tenant observability surface the tentpole added:
+        # admission counters + breaker state per tenant, one JSON line
+        for name, row in sorted(snap["tenants"].items()):
+            print(f"tenant {name}: " + json.dumps(
+                {k: row[k] for k in ("priority", "admitted", "completed",
+                                     "shed", "breaker")}))
+        assert snap["tenants"]["interactive"]["completed"] > 0
+        assert snap["tenants"]["batch"]["completed"] > 0
 
 
 if __name__ == "__main__":

@@ -23,13 +23,17 @@ Model adapters (transformer forward, sklearn-layer estimators) live in
 heat_tpu`` does not pay for the model stacks.
 """
 
+from . import admission
 from . import bucketing
 from . import errors
+from . import loadgen
 from . import metrics
+from .admission import AdmissionController, Tenant
 from .bucketing import FixedBuckets, Pow2Buckets
-from .errors import (ServeClosed, ServeDeadlineExceeded, ServeError,
-                     ServeOverloaded)
+from .errors import (ServeCircuitOpen, ServeClosed, ServeDeadlineExceeded,
+                     ServeError, ServeOverloaded, ServeRateLimited)
 from .executor import ServeConfig, ServingExecutor, live_executors
+from .loadgen import TenantLoad, estimate_capacity, run_open_loop
 from .metrics import ServeMetrics, runtime_stats
 from .program_cache import ProgramCache
 
@@ -40,8 +44,15 @@ __all__ = [
     "ServeMetrics",
     "Pow2Buckets",
     "FixedBuckets",
+    "AdmissionController",
+    "Tenant",
+    "TenantLoad",
+    "run_open_loop",
+    "estimate_capacity",
     "ServeError",
     "ServeOverloaded",
+    "ServeRateLimited",
+    "ServeCircuitOpen",
     "ServeDeadlineExceeded",
     "ServeClosed",
     "runtime_stats",

@@ -12,6 +12,8 @@ from __future__ import annotations
 __all__ = [
     "ServeError",
     "ServeOverloaded",
+    "ServeRateLimited",
+    "ServeCircuitOpen",
     "ServeDeadlineExceeded",
     "ServeClosed",
 ]
@@ -25,6 +27,21 @@ class ServeOverloaded(ServeError):
     """The bounded request queue is full — the request was load-shed at
     admission (backpressure). The caller should retry with backoff or route
     to another replica; the executor did NOT enqueue anything."""
+
+
+class ServeRateLimited(ServeError):
+    """The tenant's token bucket is empty — the request was rejected at
+    admission without touching the queue. The sustained rate for this
+    tenant exceeds its registered ``rate_limit``; the caller should back
+    off (the bucket refills continuously at ``rate_limit`` tokens/s)."""
+
+
+class ServeCircuitOpen(ServeError):
+    """The tenant's circuit breaker is open — recent batch dispatches for
+    this tenant failed persistently, so its requests fast-fail at
+    admission instead of burning the worker's dispatch-retry budget (and
+    starving healthy tenants). The breaker lets a bounded number of probe
+    requests through after its cool-down; a successful probe closes it."""
 
 
 class ServeDeadlineExceeded(ServeError, TimeoutError):

@@ -14,6 +14,20 @@ Design points, in the order they matter in production:
   :class:`~heat_tpu.serve.errors.ServeOverloaded` instead of growing an
   unbounded backlog (queueing theory: past saturation, queue growth only
   adds latency, never throughput).
+* **Multi-tenant admission (opt-in).** ``register_tenant(name, priority=,
+  slo_ms=, max_queue=, rate_limit=)`` arms an
+  :class:`~heat_tpu.serve.admission.AdmissionController`: the queue
+  becomes priority-ordered (higher-priority tenants served first, FIFO
+  within a priority; a full queue evicts the youngest strictly-lower-
+  priority request rather than shedding the incoming one), per-tenant
+  quotas stop one tenant filling the shared bound, token buckets shed
+  with :class:`ServeRateLimited`, a per-tenant circuit breaker fast-fails
+  with :class:`ServeCircuitOpen` while a persistently failing dispatch
+  path cools down, and an EWMA service estimator **early-sheds** queued
+  requests that provably cannot meet their deadline before they consume a
+  batch slot. With no tenant registered, nothing here runs: the executor
+  is byte-for-byte the single-FIFO PR 2 path (same counters, same
+  semantics — pinned by ``tests/test_serve.py`` unmodified).
 * **Micro-batching.** The worker takes the oldest request, then coalesces
   up to ``max_batch`` compatible requests (same trailing shape + dtype),
   waiting at most ``max_wait_ms`` for stragglers. Rows concatenate along
@@ -36,6 +50,7 @@ Design points, in the order they matter in production:
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 import weakref
@@ -48,7 +63,8 @@ import numpy as np
 import jax
 
 from .bucketing import Pow2Buckets, bucket_nbytes
-from .errors import ServeClosed, ServeDeadlineExceeded, ServeOverloaded
+from .errors import (ServeCircuitOpen, ServeClosed, ServeDeadlineExceeded,
+                     ServeError, ServeOverloaded, ServeRateLimited)
 from .metrics import DEFAULT as _DEFAULT_METRICS, ServeMetrics
 from .program_cache import ProgramCache
 
@@ -86,11 +102,20 @@ class ServeConfig:
             self.bucket_rows = Pow2Buckets(min_rows=self.min_rows)
 
 
-class _Request:
-    __slots__ = ("x", "rows", "group", "enq_t", "deadline_t", "future",
-                 "started")
+# FIFO tiebreaker within a priority level (CPython next() is atomic)
+_SEQ = itertools.count()
 
-    def __init__(self, x: np.ndarray, deadline_t: Optional[float]):
+
+class _Request:
+    # clock discipline: enq_t and deadline_t are BOTH time.monotonic()
+    # stamps — the early-shed estimate and _expire compare against the
+    # same clock end-to-end; never mix in time.time() here (a wall-clock
+    # jump would dispatch expired requests or shed live ones)
+    __slots__ = ("x", "rows", "group", "enq_t", "deadline_t", "future",
+                 "started", "tenant", "priority", "seq")
+
+    def __init__(self, x: np.ndarray, deadline_t: Optional[float],
+                 tenant: Optional[str] = None, priority: int = 0):
         self.x = x
         self.rows = x.shape[0]
         self.group = (x.shape[1:], x.dtype.str)
@@ -98,6 +123,9 @@ class _Request:
         self.deadline_t = deadline_t
         self.future = Future()
         self.started = False  # set_running_or_notify_cancel already called
+        self.tenant = tenant
+        self.priority = priority
+        self.seq = next(_SEQ)
 
 
 class ServingExecutor:
@@ -144,6 +172,7 @@ class ServingExecutor:
                               else ProgramCache(name=name))
         self._q: list = []
         self._cv = threading.Condition()
+        self._admission = None  # AdmissionController once a tenant registers
         self._closed = False
         self._draining = False
         self._paused = False
@@ -156,7 +185,8 @@ class ServingExecutor:
     # ------------------------------------------------------------------ #
     # submission                                                         #
     # ------------------------------------------------------------------ #
-    def submit(self, x, deadline_ms: Optional[float] = None) -> Future:
+    def submit(self, x, deadline_ms: Optional[float] = None,
+               tenant: Optional[str] = None) -> Future:
         """Enqueue one request; returns a ``concurrent.futures.Future``.
 
         ``x``: ``(rows, *feat)`` host or device array — axis 0 is the
@@ -166,33 +196,181 @@ class ServingExecutor:
         then each request gets an independent copy of its rows (so no
         result pins the whole batch buffer alive) — or raises one of the
         typed serve errors.
+
+        ``tenant``: requires :meth:`register_tenant` first; the request
+        is admitted under that tenant's priority/quota/rate/breaker
+        policy, and — when ``deadline_ms`` is not given and the config
+        has no default — inherits the tenant's ``slo_ms`` as its
+        deadline. With a registry active, ``tenant=None`` rides the
+        implicit priority-0 ``"default"`` tenant.
         """
         x = np.asarray(x)
         if x.ndim < 1 or x.shape[0] < 1:
             raise ValueError(
                 f"request must have a leading row axis of >= 1, got shape "
                 f"{x.shape}")
+        adm = self._admission
+        if adm is not None:
+            tname = adm.resolve(tenant)  # unknown tenant -> ValueError
+        elif tenant is not None:
+            raise ValueError(
+                f"submit(tenant={tenant!r}) needs register_tenant() first")
+        else:
+            tname = None
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
+            if deadline_ms is None and adm is not None:
+                deadline_ms = adm.slo_ms(tname)
         deadline_t = (None if deadline_ms is None
                       else time.monotonic() + deadline_ms / 1e3)
-        req = _Request(x, deadline_t)
+        req = _Request(x, deadline_t, tenant=tname)
+        evicted = None
         with self._cv:
             if self._closed:
                 raise ServeClosed(f"executor {self.name!r} is closed")
-            if len(self._q) >= self.config.queue_limit:
+            if adm is None:
+                if len(self._q) >= self.config.queue_limit:
+                    self.metrics.record_shed()
+                    raise ServeOverloaded(
+                        f"executor {self.name!r} queue is full "
+                        f"({self.config.queue_limit} pending)")
+                self._q.append(req)
+            else:
+                evicted = self._admit(req)
+            self._cv.notify_all()
+        if evicted is not None:
+            # fail the preempted future OUTSIDE the lock (done-callbacks
+            # run synchronously — the close() lesson)
+            if evicted.future.set_running_or_notify_cancel():
+                evicted.future.set_exception(ServeOverloaded(
+                    f"executor {self.name!r} queue is full "
+                    f"({self.config.queue_limit} pending); preempted by a "
+                    f"higher-priority tenant"))
+        return req.future
+
+    def register_tenant(self, name: str, *, priority: int = 0,
+                        slo_ms: Optional[float] = None,
+                        max_queue: Optional[int] = None,
+                        rate_limit: Optional[float] = None, **policy):
+        """Register a tenant (idempotent; re-registering updates policy)
+        and switch admission onto the multi-tenant path. Extra ``policy``
+        kwargs: ``burst``, ``breaker_failures``, ``breaker_cooldown_s``,
+        ``half_open_max`` (see :class:`~heat_tpu.serve.admission.Tenant`).
+        Returns the :class:`Tenant` record."""
+        from .admission import AdmissionController
+
+        with self._cv:
+            if self._admission is None:
+                self._admission = AdmissionController()
+            adm = self._admission
+        return adm.register(name, priority=priority, slo_ms=slo_ms,
+                            max_queue=max_queue, rate_limit=rate_limit,
+                            **policy)
+
+    @property
+    def admission(self):
+        """The executor's ``AdmissionController`` (None until a tenant
+        registers — the backward-compatible single-FIFO path)."""
+        return self._admission
+
+    def tenant_stats(self) -> dict:
+        """Per-tenant counters/breaker snapshot ({} with no registry)."""
+        adm = self._admission
+        return adm.tenant_stats() if adm is not None else {}
+
+    def _admit(self, req: _Request):
+        """Multi-tenant admission (lock held). Returns a preempted queued
+        request to fail outside the lock, or None. Raises the typed
+        rejection errors; any *machinery* failure degrades this request
+        to the legacy bounded-FIFO admission (fail-open: a bug in the new
+        admission path must never be an outage the old path lacked)."""
+        from ..utils import faults as _faults
+        from ..utils import metrics as _pm
+
+        adm = self._admission
+        cfg = self.config
+        try:
+            _faults.check("serve.admission.decide")
+            try:
+                adm.check_tenant(req.tenant, consume_token=False)
+            except ServeCircuitOpen:
+                self.metrics.record_breaker_rejected()
+                raise
+            tenant = adm.get(req.tenant)
+            req.priority = int(tenant.priority)  # before the victim scan
+            if tenant.max_queue is not None:
+                queued = sum(1 for r in self._q if r.tenant == req.tenant)
+                if queued >= tenant.max_queue:
+                    adm.count(req.tenant, "shed")
+                    self.metrics.record_shed()
+                    raise ServeOverloaded(
+                        f"tenant {req.tenant!r} queue quota is full "
+                        f"({tenant.max_queue} pending)")
+            # the token is taken LAST among the tenant-local checks so a
+            # quota-shed request never drains the bucket (a drained
+            # bucket would misattribute later sheds to the rate limit)
+            try:
+                adm.take_token(req.tenant)
+            except ServeRateLimited:
+                self.metrics.record_rate_limited()
+                raise
+            evicted = None
+            if len(self._q) >= cfg.queue_limit:
+                # preempt the youngest strictly-lower-priority request
+                # (scan from the back: the first hit of the minimal
+                # priority is the youngest of that priority)
+                vi = None
+                for i in range(len(self._q) - 1, -1, -1):
+                    r = self._q[i]
+                    if r.priority < req.priority and (
+                            vi is None
+                            or r.priority < self._q[vi].priority):
+                        vi = i
+                if vi is None:
+                    adm.refund_token(req.tenant)  # shed: no service taken
+                    adm.count(req.tenant, "shed")
+                    self.metrics.record_shed()
+                    raise ServeOverloaded(
+                        f"executor {self.name!r} queue is full "
+                        f"({cfg.queue_limit} pending)")
+                evicted = self._q.pop(vi)
+                adm.count(evicted.tenant, "shed")
+                self.metrics.record_shed()
+            self._insert(req)
+            adm.count(req.tenant, "admitted")
+            _pm.inc("serve.admit")
+            return evicted
+        except ServeError:
+            raise    # typed rejections ARE the admission decision
+        except Exception:
+            # chaos site / machinery failure: legacy bounded-FIFO
+            # admission for this request (doc/robustness.md)
+            _pm.inc("serve.admission_fallbacks")
+            if len(self._q) >= cfg.queue_limit:
                 self.metrics.record_shed()
                 raise ServeOverloaded(
                     f"executor {self.name!r} queue is full "
-                    f"({self.config.queue_limit} pending)")
+                    f"({cfg.queue_limit} pending)")
             self._q.append(req)
-            self._cv.notify_all()
-        return req.future
+            return None
+
+    def _insert(self, req: _Request) -> None:
+        """Priority-ordered insert (lock held): descending priority,
+        FIFO (seq) within a priority — uniform-priority traffic appends
+        in O(1), exactly the legacy order."""
+        q = self._q
+        key = (-req.priority, req.seq)
+        i = len(q)
+        while i > 0 and (-q[i - 1].priority, q[i - 1].seq) > key:
+            i -= 1
+        q.insert(i, req)
 
     def predict(self, x, deadline_ms: Optional[float] = None,
-                timeout: Optional[float] = None):
+                timeout: Optional[float] = None,
+                tenant: Optional[str] = None):
         """Synchronous convenience: ``submit(...).result(timeout)``."""
-        return self.submit(x, deadline_ms=deadline_ms).result(timeout)
+        return self.submit(x, deadline_ms=deadline_ms,
+                           tenant=tenant).result(timeout)
 
     def warmup(self, feat_shape: Sequence[int], dtype=np.float32,
                rows: Optional[Sequence[int]] = None) -> dict:
@@ -296,11 +474,19 @@ class ServingExecutor:
     def closed(self) -> bool:
         return self._closed
 
+    @property
+    def worker_alive(self) -> bool:
+        """True while the dispatch worker thread lives (the soak harness's
+        first verdict: nothing may kill it)."""
+        return self._worker.is_alive()
+
     def stats(self) -> dict:
-        """This executor's metrics snapshot + queue depth + cache stats."""
+        """This executor's metrics snapshot + queue depth + cache stats
+        (+ per-tenant admission counters once a registry exists)."""
         return self.metrics.snapshot(
             queue_depth=self.queue_depth,
-            program_cache=self.program_cache.stats())
+            program_cache=self.program_cache.stats(),
+            tenants=self.tenant_stats())
 
     def __enter__(self) -> "ServingExecutor":
         return self
@@ -405,8 +591,15 @@ class ServingExecutor:
         the live remainder, every future moved to RUNNING — from here on a
         client ``Future.cancel()`` returns False instead of racing the
         worker's ``set_result`` (which would raise ``InvalidStateError``
-        and poison the batch-mates via the backstop)."""
+        and poison the batch-mates via the backstop).
+
+        With admission control armed, requests whose deadline cannot
+        survive even one more estimated batch service time are **early
+        shed** here, typed, before they consume the batch slot — the
+        deadline arithmetic is one ``time.monotonic()`` clock end-to-end
+        (enqueue stamp → EWMA estimate → this check)."""
         now = time.monotonic()
+        adm = self._admission
         live = []
         for req in batch:
             if not req.started:
@@ -415,11 +608,24 @@ class ServingExecutor:
                 req.started = True
             if req.deadline_t is not None and now > req.deadline_t:
                 self.metrics.record_deadline_expired()
+                if adm is not None:
+                    adm.count(req.tenant, "deadline_expired")
                 req.future.set_exception(ServeDeadlineExceeded(
                     f"request expired after "
                     f"{(now - req.enq_t) * 1e3:.1f} ms in queue"))
-            else:
-                live.append(req)
+                continue
+            if req.deadline_t is not None and adm is not None:
+                est = adm.estimate_service_s(req.group)
+                if est is not None and now + est > req.deadline_t:
+                    self.metrics.record_early_shed()
+                    adm.count(req.tenant, "early_shed")
+                    req.future.set_exception(ServeDeadlineExceeded(
+                        f"early shed: estimated service "
+                        f"{est * 1e3:.1f} ms cannot meet the deadline "
+                        f"({(req.deadline_t - now) * 1e3:.1f} ms away "
+                        f"after {(now - req.enq_t) * 1e3:.1f} ms queued)"))
+                    continue
+            live.append(req)
         return live
 
     def _process(self, batch: list) -> None:
@@ -481,7 +687,13 @@ class ServingExecutor:
                           int(getattr(policy, "min_rows", cfg.min_rows)), 1)
             bucket = -(-rows // quantum) * quantum
             self.metrics.record_fallback_single()
+        adm = self._admission
+        tenants = ({r.tenant for r in batch if r.tenant is not None}
+                   if adm is not None else ())
+        svc_dt = [None]  # successful-dispatch duration for the estimator
+
         def run_once():
+            t_disp = time.monotonic()
             _faults.check("serve.batch.dispatch")
             payload = np.empty((bucket,) + feat, dtype)
             off = 0
@@ -497,7 +709,9 @@ class ServingExecutor:
             # sliced on host. Slicing the sharded device output per
             # request instead would dispatch a device program per slice —
             # more dispatches than the unbatched path it replaces.
-            return jax.tree.map(np.asarray, jax.block_until_ready(out))
+            res = jax.tree.map(np.asarray, jax.block_until_ready(out))
+            svc_dt[0] = time.monotonic() - t_disp
+            return res
 
         try:
             out = run_once()
@@ -514,10 +728,17 @@ class ServingExecutor:
             try:
                 out = run_once()
             except Exception as exc:
+                # post-retry failure: the breaker's unit of evidence
+                if adm is not None:
+                    adm.on_batch_outcome(tenants, ok=False)
                 self.metrics.record_error()
                 for req in batch:
                     req.future.set_exception(exc)
                 return
+        if adm is not None:
+            if svc_dt[0] is not None:
+                adm.observe_service(batch[0].group, bucket, svc_dt[0])
+            adm.on_batch_outcome(tenants, ok=True)
         self.metrics.record_batch(len(batch), rows, bucket)
         done_t = time.monotonic()
         off = 0
@@ -531,4 +752,6 @@ class ServingExecutor:
                 lambda a, s=sl: a[s] if whole else a[s].copy(), out)
             off += req.rows
             self.metrics.record_request(done_t - req.enq_t)
+            if adm is not None:
+                adm.count(req.tenant, "completed")
             req.future.set_result(res)

@@ -39,7 +39,8 @@ class ServeMetrics:
     """Thread-safe serving metrics registry."""
 
     COUNTERS = ("requests", "batches", "rows", "padded_rows", "shed",
-                "deadline_expired", "fallback_single", "errors")
+                "deadline_expired", "early_shed", "rate_limited",
+                "breaker_rejections", "fallback_single", "errors")
 
     def __init__(self, name: str = "serve", window: int = _WINDOW):
         self.name = name
@@ -84,6 +85,17 @@ class ServeMetrics:
 
     def record_deadline_expired(self) -> None:
         self._inc("deadline_expired")
+
+    def record_early_shed(self) -> None:
+        """A queued request the EWMA estimator proved cannot meet its
+        deadline, shed typed before dispatch (admission control only)."""
+        self._inc("early_shed")
+
+    def record_rate_limited(self) -> None:
+        self._inc("rate_limited")
+
+    def record_breaker_rejected(self) -> None:
+        self._inc("breaker_rejections")
 
     def record_fallback_single(self) -> None:
         self._inc("fallback_single")
@@ -137,7 +149,11 @@ def runtime_stats() -> dict:
     ``ht.runtime_stats()["resharding"]`` is exactly
     :func:`heat_tpu.core.resharding.plan_cache_stats` (aliased through, not
     copied-and-drifted); ``"serve"`` aggregates every live executor's queue
-    depth and program cache on top of the shared metrics registry;
+    depth and program cache on top of the shared metrics registry — its
+    ``"tenants"`` map folds each live executor's per-tenant admission
+    counters (admitted/shed/rate_limited/early_shed/breaker_*, plus the
+    breaker state gauge, worst across executors; empty with no
+    multi-tenant registry);
     ``"op_engine"`` carries the alignment counter plus the fusion engine's
     figures (``"fusion"`` is exactly :func:`heat_tpu.core.fusion.stats`:
     enabled flag, flush count, fused-op count, their ops-per-flush ratio,
@@ -165,17 +181,38 @@ def runtime_stats() -> dict:
     cache_stats = {k: 0 for k in ProgramCache.STATS_KEYS}
     n_exec = 0
     caches = {}  # dedupe by identity: executors may SHARE a ProgramCache
+    tenants: dict = {}
+    _BREAKER_RANK = {"closed": 0, "half_open": 1, "open": 2}
     for ex in _executor.live_executors():
         n_exec += 1
         depth += ex.queue_depth
         caches[id(ex.program_cache)] = ex.program_cache
+        # per-tenant admission counters across executors: the DECLARED
+        # counter keys sum, the breaker gauge reports the worst state,
+        # policy fields (priority/slo_ms/max_queue/rate_limit) keep the
+        # first registration seen — summing a quota across executors
+        # would report a bound nobody enforces
+        from .admission import TENANT_COUNTERS
+
+        for name, st in ex.tenant_stats().items():
+            agg = tenants.setdefault(name, {})
+            for k, v in st.items():
+                if k in TENANT_COUNTERS:
+                    agg[k] = agg.get(k, 0) + int(v)
+                elif k == "breaker":
+                    if k not in agg or _BREAKER_RANK.get(v, 0) > \
+                            _BREAKER_RANK.get(agg[k], 0):
+                        agg[k] = v
+                else:
+                    agg.setdefault(k, v)
     for cache in caches.values():
         for k, v in cache.stats().items():
             cache_stats[k] += v
     counters = _pm.counters()
     return {
         "serve": DEFAULT.snapshot(
-            queue_depth=depth, executors=n_exec, program_cache=cache_stats),
+            queue_depth=depth, executors=n_exec, program_cache=cache_stats,
+            tenants=tenants),
         "resharding": resharding.plan_cache_stats(),
         "op_engine": {
             "align_resplits": int(counters.get("op_engine.align_resplits", 0)),

@@ -134,6 +134,18 @@ SITES: Dict[str, tuple] = {
     "serve.bucket.policy": (
         FaultInjected,
         "bucket policy evaluation on the coalesced row total"),
+    "serve.admission.decide": (
+        FaultInjected,
+        "multi-tenant admission decision (serve/executor.py::_admit) — "
+        "degrades that request to the legacy bounded-FIFO admission "
+        "(quota/rate/breaker skipped, request still served), counted in "
+        "serve.admission_fallbacks"),
+    "serve.breaker.probe": (
+        FaultInjected,
+        "circuit-breaker consult / half-open probe admission "
+        "(serve/admission.py::check_tenant) — fails OPEN (the request is "
+        "admitted; the dispatch path stays the health authority), "
+        "counted in serve.breaker_fallbacks"),
     # shared program cache (utils/program_cache.py)
     "program_cache.compile": (
         FaultInjected,

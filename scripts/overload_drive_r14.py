@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""User-style drive for the ISSUE 14 overload-serving surface (r14).
+
+Run on the 8-device virtual CPU mesh:
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python scripts/overload_drive_r14.py
+
+18 checks, each printed PASS/FAIL; exit 1 on any FAIL. Exercises the
+package boundary exactly as a serving user would: a real sharded model
+behind `ServingExecutor`, tenants registered over it, open-loop soak,
+breaker cycle, the untouched legacy path, and `ht.runtime_stats()`.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.serve import (Pow2Buckets, ServeCircuitOpen, ServeConfig,
+                            ServeDeadlineExceeded, ServeMetrics,
+                            ServeOverloaded, ServeRateLimited,
+                            ServingExecutor, TenantLoad, estimate_capacity,
+                            run_open_loop, serve_estimator)
+from heat_tpu.utils import faults
+from heat_tpu.utils import metrics as _pm
+
+CHECKS = []
+
+
+def check(name, ok, detail=""):
+    CHECKS.append(bool(ok))
+    print(f"[{'PASS' if ok else 'FAIL'}] {name}" +
+          (f"  ({detail})" if detail else ""), flush=True)
+
+
+def main():
+    comm = ht.get_comm()
+    print(f"mesh: {comm.size} devices", flush=True)
+    rng = np.random.default_rng(0)
+
+    # ---- a REAL model behind the executor: fitted KMeans via the
+    # production adapter (the serving path a data-analytics user gets) --
+    d = 16
+    xtr = rng.standard_normal((256, d)).astype(np.float32)
+    km = ht.cluster.KMeans(n_clusters=8, max_iter=10, random_state=0)
+    km.fit(ht.array(xtr, split=0))
+    ex = serve_estimator(km, comm=comm, metrics=ServeMetrics())
+    ex.warmup((d,), np.float32, rows=(1, 2, 5, 9, 17, 33))
+
+    # 1. legacy path first: untouched single-FIFO semantics
+    q = rng.standard_normal((5, d)).astype(np.float32)
+    want = km.predict(ht.array(q, split=0)).numpy()
+    got = np.asarray(ex.predict(q, timeout=60))
+    check("legacy predict == estimator.predict",
+          np.array_equal(got.astype(np.int64), np.asarray(want, np.int64)))
+    check("legacy path has no tenant rows",
+          ex.tenant_stats() == {} and ex.admission is None)
+
+    # 2. tenants over the SAME executor
+    ex.register_tenant("interactive", priority=10, slo_ms=30e3)
+    ex.register_tenant("batch", priority=0, max_queue=8, rate_limit=1e4)
+    got2 = np.asarray(ex.predict(q, tenant="interactive", timeout=60))
+    check("tenant-tagged predict bitwise-equal",
+          np.array_equal(got2, got))
+    st = ex.stats()["tenants"]
+    check("per-tenant counters in stats()",
+          st["interactive"]["admitted"] >= 1
+          and st["interactive"]["completed"] >= 1
+          and st["interactive"]["breaker"] == "closed")
+
+    # 3. priority: paused queue, batch flood + one interactive -> the
+    # interactive request completes first (served from the queue head)
+    order = []
+    ex.pause()
+    futs = []
+    for i in range(4):
+        f = ex.submit(q, tenant="batch")
+        f.add_done_callback(lambda _f, t="batch": order.append(t))
+        futs.append(f)
+    fi = ex.submit(q, tenant="interactive")
+    fi.add_done_callback(lambda _f: order.append("interactive"))
+    ex.resume()
+    for f in futs + [fi]:
+        f.result(60)
+    check("priority head-of-queue", order[0] == "interactive", str(order))
+
+    # 4. quota: batch capped at 8 queued
+    ex.pause()
+    futs = [ex.submit(q, tenant="batch") for _ in range(8)]
+    try:
+        ex.submit(q, tenant="batch")
+        check("quota sheds typed", False)
+    except ServeOverloaded as e:
+        check("quota sheds typed", "quota" in str(e))
+    ex.resume()
+    for f in futs:
+        f.result(60)
+
+    # 5. rate limit (fresh tenant with a 1-token bucket)
+    ex.register_tenant("freebie", rate_limit=1e-3, burst=1.0)
+    ex.predict(q, tenant="freebie", timeout=60)
+    try:
+        ex.submit(q, tenant="freebie")
+        check("rate limit sheds typed", False)
+    except ServeRateLimited:
+        check("rate limit sheds typed", True)
+
+    # 6. SLO as deadline + never dispatched when expired
+    m2 = ServeMetrics()
+    ex2 = ServingExecutor(
+        lambda x: x * np.float32(2.0),
+        ServeConfig(bucket_rows=Pow2Buckets(min_rows=comm.size,
+                                            multiple_of=comm.size)),
+        metrics=m2, cache_token=comm.cache_key)
+    ex2.register_tenant("t", slo_ms=1.0)
+    ex2.pause()
+    f = ex2.submit(np.ones((comm.size, 4), np.float32), tenant="t")
+    time.sleep(0.05)
+    ex2.resume()
+    try:
+        f.result(30)
+        check("SLO deadline expiry typed", False)
+    except ServeDeadlineExceeded:
+        snap = m2.snapshot()
+        check("SLO deadline expiry typed",
+              snap["deadline_expired"] == 1 and snap["batches"] == 0,
+              f"batches={snap['batches']}")
+
+    # 7. early shed: primed 10s estimate, 500ms deadline -> shed unrun
+    ex2.admission.observe_service(((4,), np.dtype(np.float32).str),
+                                  comm.size, 10.0)
+    ex2.pause()
+    f = ex2.submit(np.ones((comm.size, 4), np.float32), deadline_ms=500.0,
+                   tenant="t")
+    ex2.resume()
+    try:
+        f.result(30)
+        check("early shed before dispatch", False)
+    except ServeDeadlineExceeded as e:
+        snap = m2.snapshot()
+        check("early shed before dispatch",
+              "early shed" in str(e) and snap["batches"] == 0
+              and snap["early_shed"] == 1)
+    ex2.close()
+
+    # 8. breaker: K=2 failures -> open -> fast fail -> healthy tenant
+    # unaffected -> half-open probe closes; fast-fail < 1/10 retry path
+    m3 = ServeMetrics()
+    ex3 = ServingExecutor(
+        lambda x: x + np.float32(1.0),
+        ServeConfig(max_batch=2, bucket_rows=Pow2Buckets(
+            min_rows=comm.size, multiple_of=comm.size)),
+        metrics=m3, cache_token=comm.cache_key)
+    ex3.register_tenant("hi", priority=10)
+    ex3.register_tenant("bk", priority=0, breaker_failures=2,
+                        breaker_cooldown_s=0.25)
+    xb = np.ones((comm.size, 4), np.float32)
+    ex3.predict(xb, tenant="hi", timeout=60)  # warm the program
+    retry_lat = []
+    with faults.inject("serve.batch.dispatch=every:1"):
+        for _ in range(2):
+            t0 = time.monotonic()
+            try:
+                ex3.submit(xb, tenant="bk").result(60)
+            except faults.FaultInjected:
+                pass
+            retry_lat.append(time.monotonic() - t0)
+    check("breaker opens after K post-retry failures",
+          ex3.admission.breaker_state("bk") == "open")
+    fast = []
+    for _ in range(10):
+        t0 = time.monotonic()
+        try:
+            ex3.submit(xb, tenant="bk")
+        except ServeCircuitOpen:
+            pass
+        fast.append(time.monotonic() - t0)
+    ratio = sorted(fast)[5] / (sum(retry_lat) / len(retry_lat))
+    check("breaker fast-fail < 1/10 retry path", ratio < 0.1,
+          f"ratio={ratio:.4f}")
+    out = np.asarray(ex3.predict(xb, tenant="hi", timeout=60))
+    check("healthy tenant unaffected while breaker open",
+          np.array_equal(out, xb + 1.0) and m3.snapshot()["errors"] == 2)
+    time.sleep(0.3)
+    ex3.submit(xb, tenant="bk").result(60)
+    check("half-open probe closes breaker",
+          ex3.admission.breaker_state("bk") == "closed")
+    check("worker alive through the whole breaker cycle", ex3.worker_alive)
+    ex3.close()
+
+    # 9. the open-loop soak short form (2-tenant, stall + every:5 fault)
+    m4 = ServeMetrics()
+    ex4 = ServingExecutor(
+        lambda x: x * np.float32(3.0),
+        ServeConfig(max_batch=8, max_wait_ms=2.0, queue_limit=32,
+                    bucket_rows=Pow2Buckets(min_rows=comm.size,
+                                            multiple_of=comm.size)),
+        metrics=m4, cache_token=comm.cache_key)
+    ex4.register_tenant("hi", priority=10, slo_ms=1500.0)
+    ex4.register_tenant("lo", priority=0, max_queue=24, slo_ms=6000.0)
+    ex4.warmup((4,), np.float32, rows=(1, 2, 3, 5, 9, 17))
+    cap = estimate_capacity(ex4, (4,), n=24)
+    total = min(2.0 * cap, 500.0)
+    retries0 = int(_pm.counters().get("serve.batch_retries", 0))
+    with faults.inject("serve.batch.dispatch=every:5"):
+        rep = run_open_loop(
+            ex4, [TenantLoad("hi", min(0.2 * total, 50.0), rows_mix=(1, 2)),
+                  TenantLoad("lo", max(total * 0.8, 100.0), rows_mix=(1, 2))],
+            1.2, (4,), seed=3, stall=(0.3, 0.4))
+    hi, lo = rep["tenants"]["hi"], rep["tenants"]["lo"]
+    shed = hi["shed"] + lo["shed"]
+    check("soak: worker alive", ex4.worker_alive)
+    check("soak: zero untyped client errors",
+          rep["totals"]["untyped"] == 0)
+    check("soak: overload materialized and >=90% shed on lo",
+          shed > 0 and lo["shed"] / max(shed, 1) >= 0.9,
+          f"hi={hi['shed']} lo={lo['shed']}")
+    check("soak: hi p99 within SLO",
+          hi["outcomes"]["ok"] > 0 and hi["latency_ms"]["p99"] <= 1500.0,
+          f"p99={hi['latency_ms'].get('p99')}ms")
+    check("soak: bounded dispatch retry exercised",
+          int(_pm.counters().get("serve.batch_retries", 0)) > retries0)
+    ex4.close()
+
+    # 10. one observability surface: runtime_stats carries the tenant map
+    rt = ht.runtime_stats()
+    row = rt["serve"]["tenants"].get("interactive", {})
+    check("runtime_stats tenants folded + json-serializable",
+          row.get("admitted", 0) >= 1
+          and json.dumps(rt) is not None)
+    ex.close()
+
+    n_fail = CHECKS.count(False)
+    print(f"\n{len(CHECKS) - n_fail}/{len(CHECKS)} checks passed", flush=True)
+    return 1 if n_fail else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

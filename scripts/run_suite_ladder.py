@@ -415,6 +415,14 @@ def main():
     ap.add_argument("--no-serve-smoke", dest="serve_smoke",
                     action="store_false",
                     help="skip the serving executor smoke step")
+    ap.add_argument("--serve-soak", dest="serve_soak", action="store_true",
+                    default=True,
+                    help="run the open-loop overload soak with "
+                         "p99-under-load verdicts (default on)")
+    ap.add_argument("--no-serve-soak", dest="serve_soak",
+                    action="store_false",
+                    help="skip the serve soak stage")
+    ap.add_argument("--serve-soak-timeout", type=float, default=600.0)
     ap.add_argument("--chaos", dest="chaos", action="store_true",
                     default=True,
                     help="run the fault-injection chaos matrix + "
@@ -476,6 +484,39 @@ def main():
             artifact["serve_smoke"] = {"error": "serve smoke exceeded 600s"}
             serve_bad = True
         print(json.dumps({"serve_smoke_ok": not serve_bad}), flush=True)
+
+    soak_bad = False
+    if args.serve_soak and not args.examples_only:
+        # overload-robustness gate (ISSUE 14): short deterministic
+        # open-loop soak at 1x/2x estimated capacity with
+        # serve.batch.dispatch=every:5 armed mid-soak — per-tenant
+        # p50/p95/p99 + shed/breaker verdicts land in the artifact next
+        # to chaos; any failed verdict fails the round
+        print("=== serve soak (4 devices) ===", flush=True)
+        env = _env(4)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["PYTHONPATH"] = _REPO
+        try:
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(_REPO, "scripts", "soak_serve.py"),
+                 "--quick"],
+                env=env, capture_output=True, text=True,
+                timeout=args.serve_soak_timeout, cwd=_REPO)
+            line = next((l for l in reversed(out.stdout.splitlines())
+                         if l.startswith("{")), None)
+            artifact["serve_soak"] = (
+                json.loads(line) if line
+                else {"error": (out.stderr or "no output").strip()[-300:]})
+            soak_bad = out.returncode != 0
+        except subprocess.TimeoutExpired:
+            artifact["serve_soak"] = {
+                "error": f"serve soak exceeded {args.serve_soak_timeout:.0f}s"}
+            soak_bad = True
+        print(json.dumps({
+            "serve_soak_ok": not soak_bad,
+            "verdicts": artifact["serve_soak"].get("verdicts", {})}),
+            flush=True)
 
     chaos_bad = False
     if args.chaos and not args.examples_only:
@@ -575,8 +616,9 @@ def main():
     print(f"wrote {args.out}")
     bad = ([r for r in ladder if r.get("rc") != 0]
            + [r for r in ex if r.get("rc") != 0])
-    sys.exit(1 if bad or audit_bad or serve_bad or fusion_bad or quant_bad
-             or chunk_bad or hier_bad or fit_bad or chaos_bad else 0)
+    sys.exit(1 if bad or audit_bad or serve_bad or soak_bad or fusion_bad
+             or quant_bad or chunk_bad or hier_bad or fit_bad or chaos_bad
+             else 0)
 
 
 if __name__ == "__main__":

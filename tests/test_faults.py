@@ -51,6 +51,8 @@ FALLBACK_COUNTERS = (
     "serve.batch_retries",
     "serve.worker_backstops",
     "serve.bucket_splits",
+    "serve.admission_fallbacks",
+    "serve.breaker_fallbacks",
     "checkpoint.write_retries",
     "checkpoint.read_retries",
     "checkpoint.corrupt_skipped",
@@ -80,6 +82,12 @@ MATRIX = {
     "serve.worker.batch": ("serve", "serve.worker_backstops", 1),
     "serve.batch.dispatch": ("serve", "serve.batch_retries", 1),
     "serve.bucket.policy": ("serve", "serve.bucket_splits", 1),
+    # the faulted admission decision degrades that ONE request to the
+    # legacy bounded-FIFO admission (still served); the faulted breaker
+    # consult fails OPEN (request admitted, dispatch stays the health
+    # authority) — the healthy-tenant requests around them are untouched
+    "serve.admission.decide": ("mtserve", "serve.admission_fallbacks", 1),
+    "serve.breaker.probe": ("mtserve", "serve.breaker_fallbacks", 1),
     "program_cache.compile": ("serve", "serve.batch_retries", 1),
     "checkpoint.manifest.write": ("ckpt", "checkpoint.write_retries", 1),
     "checkpoint.leaf.write": ("ckpt", "checkpoint.write_retries", 1),
@@ -271,6 +279,30 @@ def _wl_serve(tmp_path):
             {"absorbed": absorbed})
 
 
+def _wl_mtserve(tmp_path):
+    """Multi-tenant burst: two registered tenants (priority 10 vs 0),
+    12 interleaved requests through the admission controller. Every
+    request is served whichever new-machinery site fires — admission
+    faults degrade that request to legacy FIFO admission, breaker-consult
+    faults fail open — so the payload is fault-free-equal and the healthy
+    tenant sees zero errors (every future resolves)."""
+    comm = ht.get_comm()
+    cfg = ServeConfig(
+        max_batch=4, max_wait_ms=20.0,
+        bucket_rows=Pow2Buckets(min_rows=comm.size, multiple_of=comm.size))
+    with ServingExecutor(_model, cfg, metrics=ServeMetrics(),
+                         cache_token=comm.cache_key) as ex:
+        ex.register_tenant("hi", priority=10, slo_ms=60e3)
+        ex.register_tenant("lo", priority=0, max_queue=64)
+        ex.pause()
+        futs = {i: ex.submit(np.full((comm.size, D), i, np.float32),
+                             tenant=("hi" if i % 2 else "lo"))
+                for i in range(12)}
+        ex.resume()
+        results = {i: np.asarray(f.result(60)) for i, f in futs.items()}
+    return {"res": np.stack([results[i] for i in range(12)])}, {}
+
+
 def _wl_ckpt(tmp_path):
     """Save two steps, restore the newest — the full manifest+leaf
     write/read cycle."""
@@ -305,7 +337,8 @@ def _wl_init(tmp_path):
 _WORKLOADS = {"ops": _wl_ops, "train": _wl_train, "quant": _wl_quant,
               "chunk": _wl_chunk, "hier": _wl_hier, "fit": _wl_fit,
               "resplit": _wl_resplit,
-              "serve": _wl_serve, "ckpt": _wl_ckpt, "init": _wl_init}
+              "serve": _wl_serve, "mtserve": _wl_mtserve,
+              "ckpt": _wl_ckpt, "init": _wl_init}
 
 _BASELINES: dict = {}  # workload name -> fault-free payload (per session)
 

@@ -24,9 +24,18 @@ from heat_tpu.utils.program_cache import ProgramCache
 TOP_KEYS = {"serve", "resharding", "op_engine", "faults", "counters"}
 
 SERVE_KEYS = {"requests", "batches", "rows", "padded_rows", "shed",
-              "deadline_expired", "fallback_single", "errors",
+              "deadline_expired", "early_shed", "rate_limited",
+              "breaker_rejections", "fallback_single", "errors",
               "latency_ms", "batch_occupancy", "queue_depth", "executors",
-              "program_cache"}
+              "program_cache", "tenants"}
+
+# per-tenant entry shape inside serve.tenants (admission.TENANT_COUNTERS
+# + the policy/gauge fields) — pinned so dashboards reading the tenant
+# map fail HERE when a counter is added without a schema update
+TENANT_KEYS = {"priority", "slo_ms", "max_queue", "rate_limit", "breaker",
+               "admitted", "completed", "shed", "rate_limited",
+               "deadline_expired", "early_shed", "breaker_rejections",
+               "breaker_opens", "dispatch_failures"}
 
 RESHARDING_KEYS = {"hits", "misses", "entries"}
 
@@ -117,3 +126,32 @@ def test_runtime_stats_survives_live_executor():
         rt = ht.runtime_stats()
         assert rt["serve"]["executors"] >= 1
         assert set(rt["serve"]["program_cache"]) == PROGRAM_CACHE_KEYS
+        # no registry on this executor -> it contributes no tenant rows
+        assert ex.tenant_stats() == {}
+
+
+def test_runtime_stats_tenant_shape_pinned():
+    """A multi-tenant executor folds per-tenant admission counters into
+    ``runtime_stats()["serve"]["tenants"]`` with the exact pinned entry
+    shape, json-serializable."""
+    import json
+
+    comm = ht.get_comm()
+
+    def model(x):
+        return x + np.float32(1.0)
+
+    cfg = ServeConfig(
+        max_batch=4,
+        bucket_rows=Pow2Buckets(min_rows=comm.size, multiple_of=comm.size))
+    with ServingExecutor(model, cfg, metrics=ServeMetrics(),
+                         cache_token=comm.cache_key) as ex:
+        ex.register_tenant("contract-hi", priority=5, slo_ms=60e3)
+        ex.predict(np.ones((comm.size, 3), np.float32), timeout=60,
+                   tenant="contract-hi")
+        rt = ht.runtime_stats()
+        row = rt["serve"]["tenants"]["contract-hi"]
+        assert set(row) == TENANT_KEYS
+        assert row["admitted"] >= 1 and row["completed"] >= 1
+        assert row["breaker"] == "closed" and row["priority"] == 5
+        json.dumps(rt)
