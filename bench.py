@@ -900,6 +900,146 @@ def _fusion_stage(timeout: float = 420.0):
         return {"fusion_error": repr(exc)}
 
 
+def _decode_bench_main() -> None:
+    """``--decode-bench`` child: continuous-batching decode throughput vs
+    the monolithic ``generate()`` convoy on the 4-device CPU mesh this
+    process was launched onto (ISSUE 15 acceptance: >= 1.5x tokens/s on
+    a seeded mixed-length workload).
+
+    Workload: R requests with prompt lengths in [5, 13) and
+    ``max_new_tokens`` drawn from {8, 12, 16, 24, 192} skewed short with
+    a heavy 192-token tail (the LLM-serving shape: many short answers,
+    occasional long generations — the tail is what convoys the
+    monolithic batch), staggered arrivals.
+    Baseline: the same requests grouped into slot-sized batches in
+    arrival order, each batch running ``generate()`` to the LONGEST
+    member (the convoy) — tokens/s counts only REQUESTED tokens on both
+    paths. Both paths are warmed first so neither pays a compile in the
+    timed pass. Prints ONE JSON line with tokens/s both ways, the
+    speedup, mean slot occupancy and the per-phase serve.decode_*
+    counter deltas.
+    """
+    import time as _time
+
+    import jax
+
+    import heat_tpu as ht
+    from heat_tpu.nn.transformer import TransformerLM, TransformerLMConfig
+    from heat_tpu.serve import DecodeConfig, DecodeEngine
+    from heat_tpu.utils import metrics as _pm
+
+    n = ht.get_comm().size
+    grid = ht.MeshGrid((n, 1, 1, 1), ("dp", "pp", "tp", "sp"))
+    # sized so per-step compute dominates the engine's per-dispatch host
+    # overhead on this CPU mesh (the convoy win is a compute ratio; on
+    # real TPUs dispatch cost shrinks and the ratio is the whole story)
+    cfg = TransformerLMConfig(vocab=256, d_model=192, n_heads=8,
+                              n_layers=2, d_ff=768)
+    model = TransformerLM(grid, cfg)
+    params = model.init(0)
+    rng = np.random.default_rng(7)
+
+    slots = 4 * model.dp_world
+    R = 10 * slots
+    lens = rng.integers(5, 13, R)
+    # the chat traffic shape: mostly short answers, a heavy long tail —
+    # exactly what convoys a monolithic batch (every batch runs to its
+    # longest member while the engine's finished lanes take new work)
+    news = rng.choice([8, 12, 16, 24, 192], size=R,
+                      p=[.30, .30, .15, .10, .15])
+    reqs = [(rng.integers(0, cfg.vocab, (int(s),)).astype(np.int32),
+             int(m)) for s, m in zip(lens, news)]
+    useful = int(sum(m for _p, m in reqs))
+    gaps = rng.uniform(0.0, 2e-3, R)  # staggered (open-loop-ish) arrivals
+
+    # ---- monolithic convoy baseline: slot-sized batches, arrival order
+    batches = [reqs[i:i + slots] for i in range(0, R, slots)]
+
+    def run_mono():
+        for chunk in batches:
+            s_max = max(p.size for p, _m in chunk)
+            m_max = max(m for _p, m in chunk)
+            toks = np.zeros((len(chunk), s_max), np.int32)
+            for j, (p, _m) in enumerate(chunk):
+                toks[j, :p.size] = p
+            jax.block_until_ready(model.generate(params, toks, m_max))
+
+    run_mono()  # warm every (batch, bucket, max_new) program
+    t0 = _time.perf_counter()
+    run_mono()
+    t_mono = _time.perf_counter() - t0
+
+    # ---- continuous batching through the slot engine
+    eng = DecodeEngine(model, params,
+                       DecodeConfig(slots=slots, max_seq_len=256,
+                                    queue_limit=4 * R),
+                       name="decode-bench")
+    eng.warmup()
+    misses0 = eng.program_cache.stats()["misses"]
+
+    def run_cont():
+        futs = []
+        for (p, m), gap in zip(reqs, gaps):
+            futs.append(eng.submit(p, m))
+            if gap > 1e-3:
+                _time.sleep(gap)
+        for f in futs:
+            f.result(600)
+
+    run_cont()  # warm pass (programs are already compiled; steadies JIT)
+    c0 = {k: int(_pm.counters().get(f"serve.decode_{k}", 0))
+          for k in ("prefills", "steps", "tokens_out", "fallbacks")}
+    t0 = _time.perf_counter()
+    run_cont()
+    t_cont = _time.perf_counter() - t0
+    c1 = {k: int(_pm.counters().get(f"serve.decode_{k}", 0)) - c0[k]
+          for k in c0}
+    st = eng.stats()
+    steady_misses = eng.program_cache.stats()["misses"] - misses0
+    eng.close()
+
+    mono_tps = useful / t_mono
+    cont_tps = useful / t_cont
+    record = {
+        "decode_requests": R,
+        "decode_slots": slots,
+        "decode_useful_tokens": useful,
+        "decode_cont_tokens_per_s": round(cont_tps, 1),
+        "decode_mono_tokens_per_s": round(mono_tps, 1),
+        "decode_speedup": round(cont_tps / mono_tps, 2),
+        "decode_speedup_target": 1.5,
+        "decode_mean_occupancy": round(st["occupancy"], 3),
+        "decode_steady_misses": steady_misses,
+        "decode_counters": c1,
+        "decode_devices": n,
+    }
+    print(json.dumps(record), flush=True)
+
+
+def _decode_stage(timeout: float = 600.0):
+    """Fail-soft continuous-batching decode stage on a 4-device CPU mesh;
+    returns the decode_* field dict or a ``{"decode_error": ...}`` marker
+    — the headline record survives either way (same contract as the
+    serve and fusion stages)."""
+    from __graft_entry__ import _cpu_env
+
+    me = os.path.abspath(__file__)
+    try:
+        out = subprocess.run(
+            [sys.executable, me, "--decode-bench"], env=_cpu_env(4),
+            timeout=timeout, capture_output=True, text=True)
+        line = next((l for l in reversed(out.stdout.splitlines())
+                     if l.startswith("{")), None)
+        if out.returncode == 0 and line is not None:
+            return json.loads(line)
+        tail = (out.stderr or out.stdout or "").strip().splitlines()[-3:]
+        return {"decode_error": f"rc={out.returncode} " + " | ".join(tail)}
+    except subprocess.TimeoutExpired:
+        return {"decode_error": f"decode stage exceeded {timeout:.0f}s"}
+    except Exception as exc:
+        return {"decode_error": repr(exc)}
+
+
 def _analytics_bench_main() -> None:
     """``--analytics-bench`` child: measure the tape-compiled analytics
     fit steps (ISSUE 13) on the 4-device CPU mesh this process was
@@ -1372,6 +1512,9 @@ def main() -> None:
     if len(sys.argv) >= 2 and sys.argv[1] == "--analytics-bench":
         _analytics_bench_main()
         return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--decode-bench":
+        _decode_bench_main()
+        return
 
     me = os.path.abspath(__file__)
     from __graft_entry__ import _cpu_env
@@ -1446,6 +1589,11 @@ def main() -> None:
                 # only, same mesh): fused-vs-eager Lloyd iteration + the
                 # 100M-element out-of-core streamed clustering scenario
                 rec.update(_analytics_stage())
+                # continuous-batching decode stage (fail-soft, live
+                # records only, same mesh): slot-engine tokens/s vs the
+                # monolithic generate() convoy on a seeded mixed-length
+                # workload (ISSUE 15 acceptance >= 1.5x)
+                rec.update(_decode_stage())
                 line = json.dumps(rec)
             except Exception as exc:
                 sys.stderr.write(f"bench: serve/fusion stage skipped: {exc}\n")

@@ -19,6 +19,9 @@ Usage (8 virtual devices):
       # (2, n/2) ("dcn", "ici") tier grid: the packed train step's
       # gradient all-reduce decomposes as reduce-scatter(ici) ->
       # all-reduce(dcn) -> all-gather(ici), HEAT_TPU_HIER
+  python gpt_parallel.py --serve --steps 5   # continuous-batching decode:
+      # 2 tenants' mixed-length generation through the slot-based
+      # DecodeEngine (heat_tpu.serve.decode), per-tenant tokens/s printed
 """
 
 import argparse
@@ -59,6 +62,13 @@ def main():
              "simulated 2-host (2, n/2) ('dcn','ici') split on CPU — "
              "so the packed step's gradient all-reduce decomposes "
              "hierarchically (RS over ici, AR over dcn, AG over ici)")
+    p.add_argument("--serve", action="store_true",
+                   help="after training, serve generation through the "
+                        "continuous-batching DecodeEngine: 2 tenants "
+                        "(interactive prio 10 / batch prio 0), mixed "
+                        "prompt/output lengths, per-tenant tokens/s + "
+                        "slot occupancy printed")
+    p.add_argument("--serve-requests", type=int, default=24)
     args = p.parse_args()
 
     import optax
@@ -149,7 +159,8 @@ def main():
 
     # KV-cached greedy decode needs a token-recurrent grid (pp=sp=1, dense
     # MLP); skip the demo on pipelined / sequence-sharded / MoE configs
-    if model.pp == 1 and model.sp == 1 and not cfg.moe_experts:
+    decode_ok = model.pp == 1 and model.sp == 1 and not cfg.moe_experts
+    if decode_ok and not args.serve:
         # exactly dp prompt rows (tile if the training batch is smaller)
         reps = -(-model.dp_world // tokens.shape[0])
         prompt = np.tile(tokens, (reps, 1))[:model.dp_world,
@@ -157,6 +168,59 @@ def main():
         out = np.asarray(model.generate(params, prompt, max_new_tokens=12))
         print("prompt:   ", prompt[0].tolist())
         print("generated:", out[0, 8:].tolist())
+    if decode_ok and args.serve:
+        run_serve(model, params, args, rng)
+    elif args.serve:
+        print("--serve skipped: decode needs a pp=1, sp=1 dense grid")
+
+
+def run_serve(model, params, args, rng):
+    """--serve: two tenants' mixed-length generation through the
+    continuous-batching DecodeEngine (heat_tpu.serve.decode) — finished
+    sequences free their slot mid-flight, queued requests join between
+    steps, and the ONE decode executable serves every occupancy."""
+    import time
+
+    from heat_tpu.serve import serve_transformer
+
+    vocab = model.cfg.vocab
+    eng = serve_transformer(model, params, seq_len=64, decode=True,
+                            slots=2 * model.dp_world)
+    eng.register_tenant("interactive", priority=10, slo_ms=120e3)
+    eng.register_tenant("batch", priority=0)
+    eng.warmup()
+
+    n_req = max(4, args.serve_requests)
+    reqs = []
+    for i in range(n_req):
+        s0 = int(rng.integers(4, 13))
+        max_new = int(rng.integers(4, 17))
+        tenant = "interactive" if i % 3 else "batch"
+        reqs.append((rng.integers(0, vocab, (s0,)).astype(np.int32),
+                     max_new, tenant))
+    t0 = time.perf_counter()
+    futs = [(t, p.size, eng.submit(p, m, tenant=t)) for p, m, t in reqs]
+    per_tenant = {"interactive": 0, "batch": 0}
+    sample = None
+    for tenant, s0, f in futs:
+        out = f.result(600)
+        per_tenant[tenant] += int(out.size) - int(s0)  # generated only
+        if sample is None:
+            sample = out
+    wall = time.perf_counter() - t0
+    st = eng.stats()
+    print(f"serve: {n_req} requests in {wall:.2f}s over {st['slots']} "
+          f"slots  mean occupancy {st['occupancy']:.2f}")
+    for tenant, toks in per_tenant.items():
+        row = st["tenants"].get(tenant, {})
+        print(f"  tenant {tenant:12s} {toks / wall:8.1f} tok/s  "
+              f"completed {row.get('completed', 0)}")
+    print(f"  prefills {st['prefills']}  decode steps "
+          f"{st['decode_steps']}  tokens out {st['tokens_out']}  "
+          f"steady compiles after warmup: "
+          f"{st['program_cache']['misses']} misses total")
+    print("  sample:", sample.tolist())
+    eng.close()
 
 
 if __name__ == "__main__":

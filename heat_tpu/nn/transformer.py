@@ -99,15 +99,19 @@ def _rmsnorm(x, scale):
 
 def rope_apply(x, pos, theta: float = 10000.0):
     """Rotary position embedding (half-split convention) on ``(mb, S, H,
-    Dh)`` with GLOBAL token positions ``pos`` of shape ``(S,)``. Positions
+    Dh)`` with GLOBAL token positions ``pos`` of shape ``(S,)`` — or
+    ``(mb, S)`` when every batch row sits at its own position (the
+    serving decode engine: one slot per row, each mid-stream). Positions
     are supplied explicitly because under sequence parallelism the local
     block's positions depend on the layout: contiguous split gives
     ``r*S_local + arange``, the zigzag layout two chunk-offset ranges."""
     half = x.shape[-1] // 2
     freq = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    ang = pos.astype(jnp.float32)[:, None] * freq[None, :]       # (S, half)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = pos.astype(jnp.float32)[..., None] * freq  # (S, half) | (B, S, half)
+    if ang.ndim == 2:
+        ang = ang[None]                              # shared across the batch
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
     x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.astype(x.dtype)
@@ -322,28 +326,43 @@ class TransformerLM:
             k = rope_apply(k, pos, c.rope_theta)
         return q, k, v
 
-    def _psum_tp(self, x):
+    def _psum_tp(self, x, wire=None):
         """The Megatron-block tp reduction — skipped on tp=1 grids when
         the jax has no vma tracking: a size-1-axis psum is a value
         identity but still lowers to a (singleton-group) all-reduce pair
         through forward+backward. Under vma tracking the identity psum
         stays — ``check_vma=True`` needs it to clear the tp-varying type
         (the SAME capability gate as ``pipeline_apply``'s pp==1 branch:
-        :func:`heat_tpu.nn.parallel.vma_capable`)."""
+        :func:`heat_tpu.nn.parallel.vma_capable`).
+
+        ``wire``: a ``(quant_key, chunk_key, hier_key)`` triple pinned by
+        a builder that cache-keyed on it (the serving decode engine) —
+        the psum then rides :func:`heat_tpu.core.fusion.packed_psum` so
+        the opt-in wire codecs apply; the exact-codec emission is
+        bitwise the plain ``lax.psum`` (PR 4 probe). Wire bodies are
+        always ``check_vma=False``, so tp=1 emits nothing."""
+        if wire is not None:
+            if self.tp <= 1:
+                return x
+            from ..core import fusion
+
+            qk, ck, hk = wire
+            return fusion.packed_psum([x], ("tp",), quant=qk, chunks=ck,
+                                      hier=hk)[0]
         from .parallel import vma_capable
 
         if self.tp > 1 or vma_capable():
             return lax.psum(x, "tp")
         return x
 
-    def _attn_residual(self, p, x, attn):
+    def _attn_residual(self, p, x, attn, wire=None):
         """Row-parallel output projection (one tp psum) + residual."""
         return x + self._psum_tp(
-            jnp.einsum("bshk,hkd->bsd", attn, p["wproj"]))
+            jnp.einsum("bshk,hkd->bsd", attn, p["wproj"]), wire=wire)
 
-    def _dense_mlp_residual(self, p, x, m_in):
+    def _dense_mlp_residual(self, p, x, m_in, wire=None):
         h = jax.nn.gelu(m_in @ p["w_up"])
-        return x + self._psum_tp(h @ p["w_down"])
+        return x + self._psum_tp(h @ p["w_down"], wire=wire)
 
     def _head(self, params, h):
         """Final norm + unembed; logits upcast to f32 only after the GEMM —
@@ -687,6 +706,98 @@ class TransformerLM:
     # ------------------------------------------------------------- #
     # generation (KV-cached autoregressive decode)                  #
     # ------------------------------------------------------------- #
+    # the cache-attention bodies below are shared by generate()'s
+    # compiled batch program AND the serving continuous-batching engine
+    # (heat_tpu.serve.decode.DecodeEngine) — an architecture change
+    # lands in both decoders at once, like _block/_forward_device for
+    # training and serving forwards
+
+    PROMPT_BUCKET_MIN = 8
+
+    @classmethod
+    def prompt_bucket(cls, s0: int) -> int:
+        """The prompt-length bucket: smallest power of two >= ``s0``
+        (floored at :data:`PROMPT_BUCKET_MIN`) — the Pow2Buckets ladder
+        applied to sequence length. Prompts pad onto the bucket so one
+        compiled program serves every prompt length in it; the padded
+        rows' K/V stay masked (``col < n_valid``) until overwritten."""
+        s0 = int(s0)
+        if s0 < 1:
+            raise ValueError(f"prompt length must be >= 1, got {s0}")
+        return max(cls.PROMPT_BUCKET_MIN, 1 << (s0 - 1).bit_length())
+
+    def check_decode_grid(self) -> None:
+        """Decode is token-recurrent: a pipelined or sequence-sharded
+        layout would idle on the single live token, and MoE routing at
+        S=1 degenerates. Shared guard for generate() and DecodeEngine."""
+        if self.pp != 1 or self.sp != 1:
+            raise ValueError(
+                "generate requires a pp=1, sp=1 grid (token-recurrent "
+                "decode); use dp x tp for inference")
+        if self.cfg.moe_experts:
+            raise NotImplementedError("generate supports the dense MLP only")
+
+    def _attn_from_cache(self, q, ck, cv, upto):
+        """q (Bl, 1, Hs, Dh) against cached rows < ``upto`` (a scalar, or
+        a (Bl,) vector when every row is at its own decode depth — the
+        serving engine's per-slot live positions)."""
+        s = jnp.einsum("bqhd,bshd->bhqs", q.astype(jnp.float32),
+                       ck.astype(jnp.float32)) / math.sqrt(self.cfg.head_dim)
+        col = jnp.arange(ck.shape[1])[None, None, None, :]
+        lim = upto if jnp.ndim(upto) == 0 else upto[:, None, None, None]
+        s = jnp.where(col < lim, s, -jnp.inf)
+        w = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqs,bshd->bqhd", w, cv.astype(jnp.float32))
+        return out.astype(q.dtype)
+
+    def _cache_layer_step(self, p_l, x, ck, cv, pos, wire=None):
+        """One block on a single-token batch (Bl, 1, D): write this
+        token's K/V at per-row cache position ``pos`` ((Bl,) int32) and
+        attend rows < pos+1. ``generate`` passes a uniform ``pos`` (the
+        whole batch at step t); the DecodeEngine passes each slot's own
+        position. Rows whose position the caller does not advance (dead
+        slots) just overwrite the same masked row — harmless by the
+        col < upto discipline."""
+        Bl = x.shape[0]
+        q, k, v = self._qkv(p_l, x, pos[:, None])
+        ck = ck.at[jnp.arange(Bl), pos].set(k[:, 0].astype(ck.dtype))
+        cv = cv.at[jnp.arange(Bl), pos].set(v[:, 0].astype(cv.dtype))
+        x = self._attn_residual(
+            p_l, x, self._attn_from_cache(q, ck, cv, pos + 1), wire=wire)
+        x = self._dense_mlp_residual(
+            p_l, x, _rmsnorm(x, p_l["ln2"]), wire=wire)
+        return x, ck, cv
+
+    def _prompt_kv_logits(self, params, toks, n_valid, wire=None):
+        """Padded-prompt prefill forward: ``toks`` (Bl, Sp) int32 with
+        rows >= ``n_valid`` (a traced scalar) being pad. Returns per-layer
+        K/V lists (each (Bl, Sp, Hs, Dh), post-RoPE — each row rotated by
+        its absolute position exactly as in training) and the f32 logits
+        at position ``n_valid - 1``. Causal attention never reads a later
+        column, so valid rows are exactly the unpadded forward's; padded
+        rows carry garbage the caller must keep masked (col < upto) until
+        its own decode writes overwrite them."""
+        c = self.cfg
+        dtype = c.compute_dtype
+        stage_params = jax.tree.map(lambda a: a[0], params["stages"])
+        Sp = toks.shape[1]
+        x = params["embed"][toks].astype(dtype)
+        pos0 = jnp.arange(Sp)
+        ks, vs = [], []
+        for l in range(c.n_layers):
+            p_l = self._cast_params(
+                jax.tree.map(lambda a: a[l], stage_params))
+            q, k, v = self._qkv(p_l, x, pos0)
+            ks.append(k.astype(dtype))
+            vs.append(v.astype(dtype))
+            attn = jnp.moveaxis(local_attention(
+                jnp.moveaxis(q, 2, 1), jnp.moveaxis(k, 2, 1),
+                jnp.moveaxis(v, 2, 1), causal=True), 1, 2)
+            x = self._attn_residual(p_l, x, attn, wire=wire)
+            x = self._dense_mlp_residual(
+                p_l, x, _rmsnorm(x, p_l["ln2"]), wire=wire)
+        h_last = lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
+        return ks, vs, self._head(params, h_last)[:, 0]
 
     def generate(self, params, prompts, max_new_tokens: int,
                  temperature: float = 0.0, seed: int = 0):
@@ -701,16 +812,19 @@ class TransformerLM:
         token-recurrent: a pipelined or sequence-sharded layout would idle
         on the single live token) and a dense MLP (no MoE routing at S=1).
 
+        The prompt length is BUCKETED (:meth:`prompt_bucket`): prompts
+        pad to the power-of-two ladder and the true length rides as a
+        traced scalar, so repeated calls with varying ``S0`` share one
+        compiled program per ``(B, bucket, max_new_tokens, temperature)``
+        instead of recompiling per exact prompt length (program-key
+        hygiene; steady-state compiles 0, pinned in
+        ``tests/test_serve_decode.py``).
+
         K/V are cached post-RoPE, so each cache row is rotated by its own
         absolute position exactly as in the training forward.
         """
         c = self.cfg
-        if self.pp != 1 or self.sp != 1:
-            raise ValueError(
-                "generate requires a pp=1, sp=1 grid (token-recurrent "
-                "decode); use dp x tp for inference")
-        if c.moe_experts:
-            raise NotImplementedError("generate supports the dense MLP only")
+        self.check_decode_grid()
         prompts = jnp.asarray(prompts, jnp.int32)
         B, S0 = prompts.shape
         if B % self.dp_world:
@@ -720,29 +834,10 @@ class TransformerLM:
         max_new_tokens = int(max_new_tokens)
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-        S_max = S0 + max_new_tokens
-        Hs = c.n_heads // self.tp
+        Sb = self.prompt_bucket(S0)
+        S_max = Sb + max_new_tokens
 
-        def attn_from_cache(q, ck, cv, upto):
-            """q (Bl, 1, Hs, Dh) against cached rows < ``upto``."""
-            s = jnp.einsum("bqhd,bshd->bhqs", q.astype(jnp.float32),
-                           ck.astype(jnp.float32)) / math.sqrt(c.head_dim)
-            col = jnp.arange(ck.shape[1])[None, None, None, :]
-            s = jnp.where(col < upto, s, -jnp.inf)
-            w = jax.nn.softmax(s, axis=-1)
-            out = jnp.einsum("bhqs,bshd->bqhd", w, cv.astype(jnp.float32))
-            return out.astype(q.dtype)
-
-        def layer_step(p_l, x, ck, cv, pos, upto):
-            """One block on (Bl, 1, D) with cache write at ``pos``."""
-            q, k, v = self._qkv(p_l, x, pos)
-            ck = lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), upto - 1, axis=1)
-            cv = lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), upto - 1, axis=1)
-            x = self._attn_residual(p_l, x, attn_from_cache(q, ck, cv, upto))
-            x = self._dense_mlp_residual(p_l, x, _rmsnorm(x, p_l["ln2"]))
-            return x, ck, cv
-
-        def body(params, toks, key):
+        def body(params, toks, n_valid, key):
             Bl = toks.shape[0]
             # independent sampling noise per data-parallel shard — a
             # replicated key would draw IDENTICAL continuations for equal
@@ -753,23 +848,16 @@ class TransformerLM:
             key = jax.random.fold_in(key, dp_idx)
             stage_params = jax.tree.map(lambda a: a[0], params["stages"])
             dtype = c.compute_dtype
-            caches_k = jnp.zeros((c.n_layers, Bl, S_max, Hs, c.head_dim), dtype)
+            Hs = c.n_heads // self.tp
+            caches_k = jnp.zeros((c.n_layers, Bl, S_max, Hs, c.head_dim),
+                                 dtype)
             caches_v = jnp.zeros_like(caches_k)
 
-            # ---- prefill: full causal pass over the prompt, cache K/V ---- #
-            x = params["embed"][toks].astype(dtype)
-            pos0 = jnp.arange(S0)
+            # ---- prefill: causal pass over the padded prompt ---- #
+            ks, vs, logits0 = self._prompt_kv_logits(params, toks, n_valid)
             for l in range(c.n_layers):
-                p_l = self._cast_params(jax.tree.map(lambda a: a[l], stage_params))
-                q, k, v = self._qkv(p_l, x, pos0)
-                caches_k = caches_k.at[l, :, :S0].set(k.astype(dtype))
-                caches_v = caches_v.at[l, :, :S0].set(v.astype(dtype))
-                attn = jnp.moveaxis(local_attention(
-                    jnp.moveaxis(q, 2, 1), jnp.moveaxis(k, 2, 1),
-                    jnp.moveaxis(v, 2, 1), causal=True), 1, 2)
-                x = self._attn_residual(p_l, x, attn)
-                x = self._dense_mlp_residual(p_l, x, _rmsnorm(x, p_l["ln2"]))
-            logits0 = self._head(params, x[:, -1:, :])[:, 0]  # (Bl, V)
+                caches_k = caches_k.at[l, :, :Sb].set(ks[l])
+                caches_v = caches_v.at[l, :, :Sb].set(vs[l])
 
             def sample(logits, key):
                 if temperature == 0.0:
@@ -784,13 +872,13 @@ class TransformerLM:
             def step(carry, key_t):
                 caches_k, caches_v, tok, t = carry
                 x = params["embed"][tok].astype(dtype)[:, None, :]
-                pos = t[None]
+                pos = jnp.full((Bl,), t, jnp.int32)
                 new_k, new_v = caches_k, caches_v
                 for l in range(c.n_layers):
                     p_l = self._cast_params(
                         jax.tree.map(lambda a: a[l], stage_params))
-                    xl, ckl, cvl = layer_step(
-                        p_l, x, new_k[l], new_v[l], pos, t + 1)
+                    xl, ckl, cvl = self._cache_layer_step(
+                        p_l, x, new_k[l], new_v[l], pos)
                     x = xl
                     new_k = new_k.at[l].set(ckl)
                     new_v = new_v.at[l].set(cvl)
@@ -802,23 +890,25 @@ class TransformerLM:
             # (each step consumes the previous token and emits the next)
             keys = jax.random.split(key, max_new_tokens)[1:]
             (_, _, last, _), toks_out = lax.scan(
-                step, (caches_k, caches_v, first, jnp.int32(S0)), keys)
+                step, (caches_k, caches_v, first, n_valid), keys)
             # toks_out: (N-1, Bl) tokens FED at each step; append the final
-            gen = jnp.concatenate(
+            return jnp.concatenate(
                 [jnp.swapaxes(toks_out, 0, 1), last[:, None]], axis=1)
-            return jnp.concatenate([toks, gen], axis=1)
 
         data_spec = P(("dcn", "dp"), None) if self._has_dcn \
             else P("dp", None)
-        cache_key = ("generate", B, S0, max_new_tokens, float(temperature))
+        cache_key = ("generate", B, Sb, max_new_tokens, float(temperature))
         fn = self._step_cache.get(cache_key)
         if fn is None:
             fn = jax.jit(shard_map(
                 body, mesh=self.grid.mesh,
-                in_specs=(self.param_specs(), data_spec, P()),
+                in_specs=(self.param_specs(), data_spec, P(), P()),
                 out_specs=data_spec, check_vma=False))
             self._step_cache[cache_key] = fn
+        padded = jnp.pad(prompts, ((0, 0), (0, Sb - S0)))
         toks_sharded = jax.device_put(
-            prompts, NamedSharding(self.grid.mesh, data_spec))
+            padded, NamedSharding(self.grid.mesh, data_spec))
         key = jax.random.key(seed)
-        return fn(params, toks_sharded, key)
+        gen = fn(params, toks_sharded, jnp.int32(S0), key)
+        return jnp.concatenate([jnp.asarray(prompts), jnp.asarray(gen)],
+                               axis=1)

@@ -7,7 +7,11 @@ runs one compiled sharded program per batch from a counter-instrumented
 :class:`ProgramCache`, and scatters results to per-request futures — with
 bounded admission (:class:`ServeOverloaded`), per-request deadlines
 (:class:`ServeDeadlineExceeded`), a drain/close lifecycle and a degraded
-single-request fallback. ``heat_tpu.serve.metrics.runtime_stats`` (exported
+single-request fallback. For autoregressive LLM traffic, the
+:class:`DecodeEngine` (:mod:`heat_tpu.serve.decode`) replaces batch
+coalescing with continuous batching: a slot-based device-resident KV
+cache with in-flight request join/leave and ONE cached decode-step
+executable. ``heat_tpu.serve.metrics.runtime_stats`` (exported
 as ``ht.runtime_stats()``) is the process's one observability surface.
 
 >>> import heat_tpu as ht
@@ -32,6 +36,7 @@ from .admission import AdmissionController, Tenant
 from .bucketing import FixedBuckets, Pow2Buckets
 from .errors import (ServeCircuitOpen, ServeClosed, ServeDeadlineExceeded,
                      ServeError, ServeOverloaded, ServeRateLimited)
+from .decode import DecodeConfig, DecodeEngine, live_decode_engines
 from .executor import ServeConfig, ServingExecutor, live_executors
 from .loadgen import TenantLoad, estimate_capacity, run_open_loop
 from .metrics import ServeMetrics, runtime_stats
@@ -40,6 +45,9 @@ from .program_cache import ProgramCache
 __all__ = [
     "ServingExecutor",
     "ServeConfig",
+    "DecodeEngine",
+    "DecodeConfig",
+    "live_decode_engines",
     "ProgramCache",
     "ServeMetrics",
     "Pow2Buckets",

@@ -63,7 +63,7 @@ def transformer_logits_fn(model, params) -> Callable:
 
 def serve_transformer(model, params, seq_len: int,
                       config: Optional[ServeConfig] = None,
-                      **kwargs) -> ServingExecutor:
+                      decode: bool = False, **kwargs):
     """A configured executor serving ``model``'s forward at ``seq_len``.
 
     Requests are ``(rows, seq_len)`` int32 token arrays. The default
@@ -71,8 +71,25 @@ def serve_transformer(model, params, seq_len: int,
     padded batch divides over the data-parallel axis); pp must be 1 for
     the non-pipelined forward latency path to make sense, but any
     dp x tp grid serves.
+
+    ``decode=True`` returns the continuous-batching
+    :class:`~heat_tpu.serve.decode.DecodeEngine` instead — per-request
+    autoregressive generation over a slot-based device-resident KV cache
+    (``seq_len`` becomes the engine's ``max_seq_len`` capacity bucket;
+    extra ``kwargs``: ``slots``, plus anything
+    :class:`~heat_tpu.serve.decode.DecodeConfig` takes). ``config`` must
+    be None on this path (the engine has its own config type).
     """
     c = model.cfg
+    if decode:
+        from .decode import DecodeConfig, DecodeEngine
+
+        if config is not None:
+            raise ValueError(
+                "decode=True takes DecodeConfig kwargs, not a ServeConfig")
+        return DecodeEngine(model, params,
+                            DecodeConfig(max_seq_len=seq_len, **kwargs),
+                            name="transformer-decode")
     if seq_len % max(1, model.sp):
         raise ValueError(
             f"seq_len ({seq_len}) must divide over sp ({model.sp})")

@@ -153,7 +153,10 @@ def runtime_stats() -> dict:
     ``"tenants"`` map folds each live executor's per-tenant admission
     counters (admitted/shed/rate_limited/early_shed/breaker_*, plus the
     breaker state gauge, worst across executors; empty with no
-    multi-tenant registry);
+    multi-tenant registry), and its ``"decode"`` map pins the
+    continuous-batching engine figures (slots, occupancy, prefills,
+    decode_steps, tokens_out, decode_fallbacks —
+    ``serve.decode.DECODE_STATS_KEYS``);
     ``"op_engine"`` carries the alignment counter plus the fusion engine's
     figures (``"fusion"`` is exactly :func:`heat_tpu.core.fusion.stats`:
     enabled flag, flush count, fused-op count, their ops-per-flush ratio,
@@ -209,10 +212,29 @@ def runtime_stats() -> dict:
         for k, v in cache.stats().items():
             cache_stats[k] += v
     counters = _pm.counters()
+    # continuous-batching decode engines (serve/decode.py): the pinned
+    # six-figure snapshot — slot inventory + mean occupancy over the live
+    # engines, lifetime counters from the process-wide registry
+    from .decode import live_decode_engines
+
+    slots = 0
+    occ_num = 0.0
+    for eng in live_decode_engines():
+        st = eng.stats()
+        slots += st["slots"]
+        occ_num += st["occupancy"] * st["slots"]
+    decode = {
+        "slots": slots,
+        "occupancy": (occ_num / slots) if slots else 0.0,
+        "prefills": int(counters.get("serve.decode_prefills", 0)),
+        "decode_steps": int(counters.get("serve.decode_steps", 0)),
+        "tokens_out": int(counters.get("serve.decode_tokens_out", 0)),
+        "decode_fallbacks": int(counters.get("serve.decode_fallbacks", 0)),
+    }
     return {
         "serve": DEFAULT.snapshot(
             queue_depth=depth, executors=n_exec, program_cache=cache_stats,
-            tenants=tenants),
+            tenants=tenants, decode=decode),
         "resharding": resharding.plan_cache_stats(),
         "op_engine": {
             "align_resplits": int(counters.get("op_engine.align_resplits", 0)),

@@ -146,6 +146,12 @@ SITES: Dict[str, tuple] = {
         "(serve/admission.py::check_tenant) — fails OPEN (the request is "
         "admitted; the dispatch path stays the health authority), "
         "counted in serve.breaker_fallbacks"),
+    "serve.decode.step": (
+        FaultInjected,
+        "continuous-batching decode-step dispatch "
+        "(serve/decode.py::DecodeEngine._dispatch_step) — that step "
+        "degrades to the eager per-slot path with every future intact, "
+        "counted in serve.decode_fallbacks"),
     # shared program cache (utils/program_cache.py)
     "program_cache.compile": (
         FaultInjected,

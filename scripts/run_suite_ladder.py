@@ -415,6 +415,13 @@ def main():
     ap.add_argument("--no-serve-smoke", dest="serve_smoke",
                     action="store_false",
                     help="skip the serving executor smoke step")
+    ap.add_argument("--decode-smoke", dest="decode_smoke",
+                    action="store_true", default=True,
+                    help="run the continuous-batching decode smoke "
+                         "(default on)")
+    ap.add_argument("--no-decode-smoke", dest="decode_smoke",
+                    action="store_false",
+                    help="skip the decode engine smoke step")
     ap.add_argument("--serve-soak", dest="serve_soak", action="store_true",
                     default=True,
                     help="run the open-loop overload soak with "
@@ -484,6 +491,32 @@ def main():
             artifact["serve_smoke"] = {"error": "serve smoke exceeded 600s"}
             serve_bad = True
         print(json.dumps({"serve_smoke_ok": not serve_bad}), flush=True)
+
+    decode_bad = False
+    if args.decode_smoke and not args.examples_only:
+        # continuous-batching gate (ISSUE 15): mixed-length two-tenant
+        # decode through the slot engine — parity vs generate(), zero
+        # steady-state misses, pinned stats shape (scripts/decode_smoke.py)
+        print("=== decode smoke (4 devices) ===", flush=True)
+        env = _env(4)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["PYTHONPATH"] = _REPO
+        try:
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(_REPO, "scripts", "decode_smoke.py")],
+                env=env, capture_output=True, text=True, timeout=600.0,
+                cwd=_REPO)
+            line = next((l for l in reversed(out.stdout.splitlines())
+                         if l.startswith("{")), None)
+            artifact["decode_smoke"] = (
+                json.loads(line) if line
+                else {"error": (out.stderr or "no output").strip()[-300:]})
+            decode_bad = out.returncode != 0
+        except subprocess.TimeoutExpired:
+            artifact["decode_smoke"] = {"error": "decode smoke exceeded 600s"}
+            decode_bad = True
+        print(json.dumps({"decode_smoke_ok": not decode_bad}), flush=True)
 
     soak_bad = False
     if args.serve_soak and not args.examples_only:
@@ -616,9 +649,9 @@ def main():
     print(f"wrote {args.out}")
     bad = ([r for r in ladder if r.get("rc") != 0]
            + [r for r in ex if r.get("rc") != 0])
-    sys.exit(1 if bad or audit_bad or serve_bad or soak_bad or fusion_bad
-             or quant_bad or chunk_bad or hier_bad or fit_bad or chaos_bad
-             else 0)
+    sys.exit(1 if bad or audit_bad or serve_bad or decode_bad or soak_bad
+             or fusion_bad or quant_bad or chunk_bad or hier_bad or fit_bad
+             or chaos_bad else 0)
 
 
 if __name__ == "__main__":

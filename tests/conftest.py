@@ -136,6 +136,12 @@ def pytest_runtest_teardown(item, nextitem):
                 c.get("op_engine.hier_collectives", 0)),
             "hier_fallbacks": int(
                 c.get("op_engine.hier_fallbacks", 0)),
+            # continuous-batching decode engine (the --decode-smoke
+            # ladder stage reads these: which tests dispatched slot
+            # steps, and whether any degraded to the eager per-slot path)
+            "serve_decode_steps": int(c.get("serve.decode_steps", 0)),
+            "serve_decode_fallbacks": int(
+                c.get("serve.decode_fallbacks", 0)),
             "zero_fills": int(c.get("op_engine.zero_fills", 0)),
             "fusion_ops": int(c.get("op_engine.fusion_ops", 0)),
             "fusion_program_compiles": int(

@@ -53,6 +53,7 @@ FALLBACK_COUNTERS = (
     "serve.bucket_splits",
     "serve.admission_fallbacks",
     "serve.breaker_fallbacks",
+    "serve.decode_fallbacks",
     "checkpoint.write_retries",
     "checkpoint.read_retries",
     "checkpoint.corrupt_skipped",
@@ -88,6 +89,10 @@ MATRIX = {
     # authority) — the healthy-tenant requests around them are untouched
     "serve.admission.decide": ("mtserve", "serve.admission_fallbacks", 1),
     "serve.breaker.probe": ("mtserve", "serve.breaker_fallbacks", 1),
+    # the faulted decode-step dispatch degrades THAT step to the eager
+    # per-slot path — same masked-attention mathematics, futures intact,
+    # worker alive; tokens equal the fault-free continuous-batching run
+    "serve.decode.step": ("decode", "serve.decode_fallbacks", 1),
     "program_cache.compile": ("serve", "serve.batch_retries", 1),
     "checkpoint.manifest.write": ("ckpt", "checkpoint.write_retries", 1),
     "checkpoint.leaf.write": ("ckpt", "checkpoint.write_retries", 1),
@@ -303,6 +308,59 @@ def _wl_mtserve(tmp_path):
     return {"res": np.stack([results[i] for i in range(12)])}, {}
 
 
+# shared model/params/program-cache for the decode workload (the §2b
+# executable-budget discipline: the prefill/step programs compile ONCE
+# for baseline + faulted + silence legs; module teardown drops them)
+_DECODE: dict = {}
+
+
+def _decode_fixture():
+    if not _DECODE:
+        from heat_tpu.nn.transformer import (TransformerLM,
+                                             TransformerLMConfig)
+        from heat_tpu.serve.program_cache import ProgramCache
+
+        n = ht.get_comm().size
+        grid = ht.MeshGrid((n, 1, 1, 1), ("dp", "pp", "tp", "sp"))
+        cfg = TransformerLMConfig(vocab=23, d_model=16, n_heads=2,
+                                  n_layers=1, d_ff=32)
+        model = TransformerLM(grid, cfg)
+        _DECODE.update(model=model, params=model.init(5),
+                       cache=ProgramCache(name="chaos-decode"))
+    return _DECODE
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _drop_decode_state():
+    yield
+    _DECODE.clear()
+    import gc
+
+    gc.collect()
+
+
+def _wl_decode(tmp_path):
+    """Continuous-batching decode burst: 3 mixed-length greedy requests
+    through the slot engine. Per-request tokens are schedule-independent
+    (slots are isolated lanes), so the faulted run — whose first decode
+    step degrades to the eager per-slot path — must produce the exact
+    fault-free tokens with every future resolved and the worker alive."""
+    from heat_tpu.serve.decode import DecodeConfig, DecodeEngine
+
+    fx = _decode_fixture()
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 23, (s,)).astype(np.int32)
+               for s in (4, 9, 6)]
+    with DecodeEngine(fx["model"], fx["params"],
+                      DecodeConfig(slots=2 * fx["model"].dp_world,
+                                   max_seq_len=32),
+                      program_cache=fx["cache"]) as eng:
+        futs = [eng.submit(p, m) for p, m in zip(prompts, (6, 3, 5))]
+        outs = [f.result(120) for f in futs]
+        assert eng.worker_alive
+    return {"toks": np.concatenate(outs)}, {}
+
+
 def _wl_ckpt(tmp_path):
     """Save two steps, restore the newest — the full manifest+leaf
     write/read cycle."""
@@ -338,6 +396,7 @@ _WORKLOADS = {"ops": _wl_ops, "train": _wl_train, "quant": _wl_quant,
               "chunk": _wl_chunk, "hier": _wl_hier, "fit": _wl_fit,
               "resplit": _wl_resplit,
               "serve": _wl_serve, "mtserve": _wl_mtserve,
+              "decode": _wl_decode,
               "ckpt": _wl_ckpt, "init": _wl_init}
 
 _BASELINES: dict = {}  # workload name -> fault-free payload (per session)

@@ -1,0 +1,408 @@
+"""The continuous-batching decode engine contract (ISSUE 15).
+
+What is pinned here, in the order the ISSUE lists it:
+
+* greedy continuous-batching tokens are BITWISE-equal to
+  ``TransformerLM.generate()`` per request, across mixed prompt/output
+  lengths and join orders (slots are isolated lanes — results never
+  depend on co-residents);
+* a finished sequence (EOS or max_new_tokens) frees its slot for the
+  next queued request (slot reuse);
+* steady-state decoding dispatches cached executables only — 0
+  program-cache misses after warmup, INCLUDING across
+  quant/chunk/hier codec toggles (siblings compile once, toggle-back
+  re-hits);
+* the decode-step carry is donated (old cache buffers invalidate);
+* slot grants follow tenant priority (FIFO within one);
+* the per-step host fetch is ONLY the sampled-token vector — audited
+  with ``jax.transfer_guard_device_to_host("disallow")`` around live
+  decoding (the engine's one ``allow`` doorway);
+* ``generate()`` program-key hygiene: prompt lengths bucket onto the
+  power-of-two ladder, so varying S0 shares one compiled program.
+
+§2b executable-budget discipline: ONE model/params/program-cache memo
+for the whole module (every engine instance shares the compiled
+prefill/step programs), and the module teardown drops the compiled
+state so the suite's end-state executable count is unchanged.
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+import jax
+
+import heat_tpu as ht
+from heat_tpu.core import fusion
+from heat_tpu.nn.transformer import TransformerLM, TransformerLMConfig
+from heat_tpu.serve import (DecodeConfig, DecodeEngine, ServeClosed,
+                            ServeOverloaded)
+from heat_tpu.serve.program_cache import ProgramCache
+from heat_tpu.utils import metrics as _pm
+
+_MEMO: dict = {}
+
+
+def _fx():
+    """Module-shared model/params/program-cache (§2b: one compile set)."""
+    if not _MEMO:
+        n = ht.get_comm().size
+        tp = 2 if n % 2 == 0 else 1
+        dp = n // tp
+        grid = ht.MeshGrid((dp, 1, tp, 1), ("dp", "pp", "tp", "sp"))
+        cfg = TransformerLMConfig(vocab=29, d_model=32, n_heads=4,
+                                  n_layers=2, d_ff=64)
+        model = TransformerLM(grid, cfg)
+        _MEMO.update(model=model, params=model.init(11),
+                     cache=ProgramCache(name="decode-test"),
+                     refs={})
+    return _MEMO
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _drop_compiled_state():
+    yield
+    _MEMO.clear()
+    fusion.reset()
+    gc.collect()
+
+
+def _engine(**over):
+    fx = _fx()
+    kw = dict(slots=2 * fx["model"].dp_world, max_seq_len=64)
+    kw.update(over)
+    return DecodeEngine(fx["model"], fx["params"], DecodeConfig(**kw),
+                        program_cache=fx["cache"])
+
+
+def _prompt(seed, s0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, _fx()["model"].cfg.vocab, (s0,)).astype(np.int32)
+
+
+def _ref(prompt, max_new):
+    """generate()'s tokens for one request (memoized — the reference
+    programs are the module's biggest compiles)."""
+    fx = _fx()
+    key = (prompt.tobytes(), int(max_new))
+    if key not in fx["refs"]:
+        B = fx["model"].dp_world
+        out = np.asarray(fx["model"].generate(
+            fx["params"], np.tile(prompt, (B, 1)), max_new))
+        fx["refs"][key] = out[0]
+    return fx["refs"][key]
+
+
+# --------------------------------------------------------------------- #
+# parity                                                                #
+# --------------------------------------------------------------------- #
+MIX = ((3, 6), (9, 3), (5, 10), (12, 4), (7, 8), (4, 2))
+
+
+def test_greedy_matches_generate_mixed_lengths():
+    """THE acceptance parity: continuous batching with mixed prompt and
+    output lengths produces, per request, exactly generate()'s greedy
+    tokens (prompt + continuation)."""
+    with _engine() as eng:
+        eng.warmup()
+        futs = [eng.submit(_prompt(40 + i, s0), mn)
+                for i, (s0, mn) in enumerate(MIX)]
+        outs = [f.result(120) for f in futs]
+    for i, ((s0, mn), out) in enumerate(zip(MIX, outs)):
+        want = _ref(_prompt(40 + i, s0), mn)
+        np.testing.assert_array_equal(out, want)
+        assert out.shape == (s0 + mn,)
+
+
+def test_join_order_independent():
+    """Slots are isolated lanes: submitting the same mix in a different
+    join order (and joining mid-flight of other sequences) changes no
+    request's tokens."""
+    order = [3, 0, 5, 2, 4, 1]
+    with _engine() as eng:
+        # joins staggered: first two start decoding before the rest join
+        futs = {}
+        for j in order[:2]:
+            futs[j] = eng.submit(_prompt(40 + j, MIX[j][0]), MIX[j][1])
+        for j in order[2:]:
+            futs[j] = eng.submit(_prompt(40 + j, MIX[j][0]), MIX[j][1])
+        outs = {j: f.result(120) for j, f in futs.items()}
+    for j, out in outs.items():
+        np.testing.assert_array_equal(
+            out, _ref(_prompt(40 + j, MIX[j][0]), MIX[j][1]))
+
+
+def test_eos_stops_early_with_exact_prefix():
+    """eos_id: generation stops on sampling it; the result is exactly
+    generate()'s token stream truncated at (and including) the first
+    EOS hit."""
+    prompt, mn = _prompt(43, MIX[3][0]), MIX[3][1]
+    full = _ref(prompt, mn)
+    gen = full[prompt.size:]
+    eos = int(gen[1])  # force a stop after the 2nd generated token
+    with _engine() as eng:
+        out = eng.generate(prompt, mn, eos_id=eos, timeout=120)
+    cut = int(np.nonzero(gen == eos)[0][0]) + 1
+    np.testing.assert_array_equal(out, full[:prompt.size + cut])
+
+
+# --------------------------------------------------------------------- #
+# slot lifecycle                                                        #
+# --------------------------------------------------------------------- #
+def test_slot_reuse_after_finish():
+    """More requests than slots: every finished sequence frees its lane
+    for a queued one — all requests complete with one engine-sized slot
+    pool, and the engine ends empty."""
+    with _engine() as eng:
+        n_req = 3 * eng.slots
+        futs = [eng.submit(_prompt(100 + i, 3 + (i % 5)), 2 + (i % 3))
+                for i in range(n_req)]
+        outs = [f.result(180) for f in futs]
+        st = eng.stats()
+        assert st["prefills"] == n_req
+        assert st["live"] == 0 and st["queue_depth"] == 0
+    for i, out in enumerate(outs):
+        np.testing.assert_array_equal(
+            out, _ref(_prompt(100 + i, 3 + (i % 5)), 2 + (i % 3)))
+
+
+def test_donation_invalidates_old_cache():
+    """The decode-step carry is donated: after a request runs, the cache
+    buffers the engine started with are deleted (device memory stays
+    ONE cache, not one per step)."""
+    with _engine() as eng:
+        ck0, cv0 = eng._ck, eng._cv
+        eng.generate(_prompt(40, 3), 4, timeout=120)
+        assert ck0.is_deleted() and cv0.is_deleted()
+
+
+# --------------------------------------------------------------------- #
+# steady state + codec keying                                           #
+# --------------------------------------------------------------------- #
+def test_steady_state_zero_misses_with_codec_toggles():
+    """After warmup, traffic over the same prompt ladder compiles
+    NOTHING — and toggling the quant/chunk/hier configuration compiles
+    SIBLING programs exactly once each (the keys carry
+    quant_key()/chunk_key()/hier_key()), with toggle-back re-hitting
+    the original executables."""
+    fx = _fx()
+    with _engine() as eng:
+        eng.warmup()
+        m0 = fx["cache"].stats()["misses"]
+        futs = [eng.submit(_prompt(40 + i, s0), mn)
+                for i, (s0, mn) in enumerate(MIX)]
+        for f in futs:
+            f.result(120)
+        assert fx["cache"].stats()["misses"] - m0 == 0
+
+        # codec toggles compile siblings (new keys) ...
+        with fusion.quant_override("int8"):
+            eng.generate(_prompt(40, 3), 2, timeout=120)
+        with fusion.chunk_override(4):
+            eng.generate(_prompt(40, 3), 2, timeout=120)
+        with fusion.hier_override(True, tiers=(2, 2)):
+            eng.generate(_prompt(40, 3), 2, timeout=120)
+        toggled = fx["cache"].stats()["misses"] - m0
+        assert toggled > 0
+
+        # ... toggle-back re-hits: the exact programs are still cached
+        m1 = fx["cache"].stats()["misses"]
+        eng.generate(_prompt(40, 3), 2, timeout=120)
+        assert fx["cache"].stats()["misses"] == m1
+
+        # and re-toggling re-hits the sibling programs too
+        with fusion.quant_override("int8"):
+            eng.generate(_prompt(40, 3), 2, timeout=120)
+        assert fx["cache"].stats()["misses"] == m1
+
+
+def test_quant_toggle_keeps_greedy_tokens():
+    """On tp-sharded grids the decode psums ride packed_psum, so the
+    int8 wire codec applies — greedy argmax must survive it for this
+    model (and on tp=1 grids there is no collective at all, bitwise by
+    construction)."""
+    prompt, mn = _prompt(41, 9), 3
+    with _engine() as eng:
+        with fusion.quant_override("int8"):
+            out = eng.generate(prompt, mn, timeout=120)
+    np.testing.assert_array_equal(out, _ref(prompt, mn))
+
+
+# --------------------------------------------------------------------- #
+# tenancy                                                               #
+# --------------------------------------------------------------------- #
+def test_tenant_priority_orders_slot_grants():
+    """Queued requests wait in tenant-priority order (FIFO within a
+    priority) — the order slot grants pop — and per-tenant
+    admitted/completed counters fold into the engine stats."""
+    with _engine() as eng:
+        eng.register_tenant("hi", priority=10)
+        eng.register_tenant("lo", priority=0)
+        eng.pause()
+        lo = [eng.submit(_prompt(100 + i, 3), 2, tenant="lo")
+              for i in range(3)]
+        hi = [eng.submit(_prompt(200 + i, 3), 2, tenant="hi")
+              for i in range(2)]
+        # the queue IS the grant order: both hi requests outrank every lo
+        assert [r.tenant for r in eng._q] == ["hi", "hi", "lo", "lo", "lo"]
+        eng.resume()
+        for f in hi + lo:
+            f.result(120)
+        st = eng.stats()["tenants"]
+        assert st["hi"]["admitted"] == 2 and st["hi"]["completed"] == 2
+        assert st["lo"]["admitted"] == 3 and st["lo"]["completed"] == 3
+
+
+def test_unknown_tenant_rejected():
+    with _engine() as eng:
+        with pytest.raises(ValueError, match="register_tenant"):
+            eng.submit(_prompt(40, 3), 2, tenant="ghost")
+
+
+# --------------------------------------------------------------------- #
+# device-residency audit                                                #
+# --------------------------------------------------------------------- #
+def test_per_step_host_fetch_is_only_the_token_vector():
+    """THE device-residency audit: with device→host transfers
+    DISALLOWED process-wide, live decoding still runs — the engine's one
+    ``allow`` doorway (``DecodeEngine._fetch``) moves only the sampled
+    token vector / first-token scalar, and nothing else (cache,
+    positions, logits) ever crosses."""
+    with _engine() as eng:
+        eng.warmup()
+        eng.pause()
+        futs = [eng.submit(_prompt(40 + i, s0), mn)
+                for i, (s0, mn) in enumerate(MIX[:3])]
+        with jax.transfer_guard_device_to_host("disallow"):
+            eng.resume()
+            outs = [f.result(120) for f in futs]
+        st = eng.stats()
+        assert st["decode_steps"] > 0
+    for i, out in enumerate(outs):
+        np.testing.assert_array_equal(
+            out, _ref(_prompt(40 + i, MIX[i][0]), MIX[i][1]))
+
+
+# --------------------------------------------------------------------- #
+# admission / lifecycle edges                                           #
+# --------------------------------------------------------------------- #
+def test_validation_and_shed():
+    with _engine(queue_limit=2) as eng:
+        with pytest.raises(ValueError, match="at least one token"):
+            eng.submit(np.zeros(0, np.int32), 2)
+        with pytest.raises(ValueError, match="vocab"):
+            eng.submit(np.full(3, 10_000, np.int32), 2)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit(_prompt(40, 3), 0)
+        with pytest.raises(ValueError, match="sequence bucket"):
+            eng.submit(_prompt(40, 3), 10_000)
+        eng.pause()
+        eng.submit(_prompt(40, 3), 2)
+        eng.submit(_prompt(41, 3), 2)
+        shed0 = int(_pm.counters().get("serve.decode_shed", 0))
+        with pytest.raises(ServeOverloaded):
+            eng.submit(_prompt(42, 3), 2)
+        assert int(_pm.counters().get("serve.decode_shed", 0)) == shed0 + 1
+        eng.resume()
+        eng.flush(120)
+
+
+def test_close_no_drain_with_inflight_request():
+    """Regression (review round): a slot-granted request's future is
+    already RUNNING — close(drain=False) must fail it with ServeClosed,
+    not raise RuntimeError from set_running_or_notify_cancel (which
+    would also skip the worker join and, from __exit__, mask the user's
+    exception)."""
+    import time
+
+    eng = _engine()
+    # long enough that it is still mid-decode when close lands
+    f = eng.submit(_prompt(40, 3), 40)
+    deadline = time.monotonic() + 60
+    while eng.live_slots == 0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert eng.live_slots > 0
+    eng.close(drain=False)  # must not raise
+    with pytest.raises(ServeClosed):
+        f.result(10)
+    assert not eng.worker_alive
+
+
+def test_close_paths():
+    eng = _engine()
+    eng.pause()
+    f = eng.submit(_prompt(40, 3), 2)
+    eng.close(drain=False)
+    with pytest.raises(ServeClosed):
+        f.result(10)
+    with pytest.raises(ServeClosed):
+        eng.submit(_prompt(40, 3), 2)
+    assert not eng.worker_alive
+    # drain close answers what is queued
+    eng2 = _engine()
+    f2 = eng2.submit(_prompt(40, 3), 2)
+    eng2.close(drain=True)
+    assert f2.result(10).shape == (5,)
+
+
+def test_runtime_stats_decode_fold():
+    steps0 = ht.runtime_stats()["serve"]["decode"]["decode_steps"]
+    with _engine() as eng:
+        eng.generate(_prompt(40, 3), 4, timeout=120)
+        rt = ht.runtime_stats()["serve"]["decode"]
+        assert rt["slots"] >= eng.slots
+        assert rt["decode_steps"] > steps0
+        assert rt["tokens_out"] > 0
+
+
+# --------------------------------------------------------------------- #
+# generate() program-key hygiene (ISSUE 15 satellite)                   #
+# --------------------------------------------------------------------- #
+def test_generate_prompt_bucket_shares_programs():
+    """Varying prompt lengths within one power-of-two bucket share ONE
+    compiled generate() program (pad + traced n_valid); crossing the
+    bucket boundary compiles exactly one more."""
+    fx = _fx()
+    model, params = fx["model"], fx["params"]
+    B = model.dp_world
+    rng = np.random.default_rng(0)
+
+    def gen(s0):
+        # max_new=13 is unique to this test: no other module test may
+        # have pre-populated a ("generate", B, bucket, 13, ...) program
+        prompts = rng.integers(0, model.cfg.vocab, (B, s0)).astype(np.int32)
+        return np.asarray(model.generate(params, prompts, 13))
+
+    gen(5)
+    n0 = len(model._step_cache)
+    gen(6)
+    gen(7)
+    gen(8)  # bucket(5..8) == 8: all share the first program
+    assert len(model._step_cache) == n0
+    gen(9)  # bucket 16: exactly one sibling
+    assert len(model._step_cache) == n0 + 1
+    gen(12)
+    assert len(model._step_cache) == n0 + 1
+
+
+def test_generate_bucketed_results_unpadded_exact():
+    """Bucketing pads the prompt and threads the true length as a traced
+    scalar — results must be invariant to how much padding the bucket
+    added (S0=8 runs unpadded in its bucket; S0=5 pads by 3)."""
+    fx = _fx()
+    model, params = fx["model"], fx["params"]
+    B = model.dp_world
+    rng = np.random.default_rng(5)
+    p8 = rng.integers(0, model.cfg.vocab, (B, 8)).astype(np.int32)
+    out8 = np.asarray(model.generate(params, p8, 3))
+    # the padded-bucket program and an exact-length run agree: re-run the
+    # 8-token prompt THROUGH the 16-bucket program by extending length
+    p5 = p8[:, :5]
+    out5 = np.asarray(model.generate(params, p5, 3))
+    assert out5.shape == (B, 8) and out8.shape == (B, 11)
+    # prefix property: the 5-token prompt's continuation is computed on
+    # exactly the 5 valid rows (padding masked), so feeding generate the
+    # same 5 tokens twice is deterministic
+    np.testing.assert_array_equal(
+        out5, np.asarray(model.generate(params, p5, 3)))

@@ -27,7 +27,12 @@ SERVE_KEYS = {"requests", "batches", "rows", "padded_rows", "shed",
               "deadline_expired", "early_shed", "rate_limited",
               "breaker_rejections", "fallback_single", "errors",
               "latency_ms", "batch_occupancy", "queue_depth", "executors",
-              "program_cache", "tenants"}
+              "program_cache", "tenants", "decode"}
+
+# the continuous-batching decode engine's pinned figure set
+# (serve/decode.py DECODE_STATS_KEYS — the ISSUE 15 shape contract)
+DECODE_KEYS = {"slots", "occupancy", "prefills", "decode_steps",
+               "tokens_out", "decode_fallbacks"}
 
 # per-tenant entry shape inside serve.tenants (admission.TENANT_COUNTERS
 # + the policy/gauge fields) — pinned so dashboards reading the tenant
@@ -76,6 +81,14 @@ def test_runtime_stats_schema_pinned():
     rt = ht.runtime_stats()
     assert set(rt) == TOP_KEYS
     assert set(rt["serve"]) == SERVE_KEYS
+    assert set(rt["serve"]["decode"]) == DECODE_KEYS
+    from heat_tpu.serve.decode import DECODE_STATS_KEYS
+
+    assert set(DECODE_STATS_KEYS) == DECODE_KEYS
+    for k in ("slots", "prefills", "decode_steps", "tokens_out",
+              "decode_fallbacks"):
+        assert isinstance(rt["serve"]["decode"][k], int), k
+    assert isinstance(rt["serve"]["decode"]["occupancy"], float)
     assert set(rt["serve"]["program_cache"]) == PROGRAM_CACHE_KEYS
     assert set(rt["resharding"]) == RESHARDING_KEYS
     assert set(rt["op_engine"]) == OP_ENGINE_KEYS
