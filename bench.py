@@ -1187,6 +1187,131 @@ def _analytics_stage(timeout: float = 420.0):
         return {"analytics_error": repr(exc)}
 
 
+def _data_bench_main() -> None:
+    """``--data-bench`` child: measure the tape-compiled data engine
+    (ISSUE 17) on the 4-device CPU mesh this process was launched onto.
+
+    Three figures:
+
+    * ``data_groupby_*``: groupby-sum over 10M rows (int64 keys, f32
+      values) through the ONE-packed-all-reduce program — rows/s plus a
+      repeated-call probe proving zero steady-state program-cache
+      misses;
+    * ``data_topk_*``: top-64 of the same 10M values through the
+      k-sized-exchange program (zero all-gather) — rows/s;
+    * ``data_quantile_*``: the out-of-core scenario — EXACT streaming
+      median + p99 over a ~100M-element f32 HDF5 dataset (sized down
+      when the box lacks the disk) via the multi-pass bisection folds,
+      with the stream accounting proving the resident set never
+      approached materialization (peak chunk ≪ file size).
+
+    Prints ONE JSON line with the data_* fields.
+    """
+    import shutil
+    import tempfile
+
+    import heat_tpu as ht
+    from heat_tpu import data as htdata
+
+    comm = ht.get_comm()
+    n_rows, G, K = 10_000_000, 64, 64
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, G, n_rows).astype(np.int64)
+    vals = rng.standard_normal(n_rows).astype(np.float32)
+    k = ht.array(keys, split=0)
+    v = ht.array(vals, split=0)
+
+    def timed(fn, reps) -> float:
+        fn()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (time.perf_counter() - t0) / reps
+
+    record = {"data_devices": comm.size, "data_rows": n_rows}
+    t_gb = timed(lambda: htdata.groupby(k, G).sum(v).numpy(), 5)
+    record["data_groupby_groups"] = G
+    record["data_groupby_ms"] = round(t_gb * 1e3, 2)
+    record["data_groupby_mrows_per_s"] = round(n_rows / t_gb / 1e6, 1)
+    t_tk = timed(lambda: htdata.topk(v, K)[0].numpy(), 5)
+    record["data_topk_k"] = K
+    record["data_topk_ms"] = round(t_tk * 1e3, 2)
+    record["data_topk_mrows_per_s"] = round(n_rows / t_tk / 1e6, 1)
+    misses0 = htdata.engine.program_cache().stats()["misses"]
+    htdata.groupby(k, G).sum(v).numpy()
+    htdata.topk(v, K)
+    record["data_steady_misses"] = (
+        htdata.engine.program_cache().stats()["misses"] - misses0)
+
+    # ---- out-of-core streaming quantile, 100M-element scale --------- #
+    # Fail-soft inside the stage (like the analytics stream leg): a
+    # missing h5py or a full disk must not take down the in-memory
+    # figures.
+    try:
+        import h5py  # noqa: F401 — availability gate
+
+        elems = 100_000_000
+        free = shutil.disk_usage(tempfile.gettempdir()).free
+        while elems * 4 * 2 > free and elems > 1_000_000:
+            elems //= 4  # sized to the box: never fill the disk
+        tmp = tempfile.mkdtemp(prefix="ht_data_")
+        try:
+            path = os.path.join(tmp, "stream.h5")
+            with h5py.File(path, "w") as f:
+                dset = f.create_dataset("data", (elems,), dtype="f4")
+                for lo in range(0, elems, 1 << 22):
+                    hi = min(lo + (1 << 22), elems)
+                    dset[lo:hi] = rng.standard_normal(
+                        hi - lo, dtype=np.float32)
+            stream = ht.load_hdf5(path, "data", stream=True)
+            t0 = time.perf_counter()
+            q = htdata.stream_quantile(stream, [0.5, 0.99],
+                                       rows_per_chunk=1 << 20)
+            t_q = time.perf_counter() - t0
+            passes = max(1, stream.chunks_read
+                         // -(-elems // (1 << 20)))
+            record["data_quantile_elements"] = elems
+            record["data_quantile_passes"] = passes
+            record["data_quantile_file_mb"] = round(
+                os.path.getsize(path) / 1e6, 1)
+            record["data_quantile_s"] = round(t_q, 2)
+            record["data_quantile_mrows_per_s"] = round(
+                passes * elems / t_q / 1e6, 2)
+            record["data_quantile_peak_chunk_mb"] = round(
+                stream.peak_chunk_bytes / 1e6, 1)
+            record["data_quantile_p50"] = round(float(q[0]), 6)
+            record["data_quantile_p99"] = round(float(q[1]), 6)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    except Exception as exc:  # fail-soft: keep the in-memory figures
+        record["data_quantile_error"] = repr(exc)[:300]
+
+    print(json.dumps(record), flush=True)
+
+
+def _data_stage(timeout: float = 600.0):
+    """Fail-soft data-engine stage on a 4-device CPU mesh; returns the
+    data_* field dict or a ``{"data_error": ...}`` marker — the headline
+    record survives either way (same contract as the analytics stage)."""
+    from __graft_entry__ import _cpu_env
+
+    me = os.path.abspath(__file__)
+    try:
+        out = subprocess.run(
+            [sys.executable, me, "--data-bench"], env=_cpu_env(4),
+            timeout=timeout, capture_output=True, text=True)
+        line = next((l for l in reversed(out.stdout.splitlines())
+                     if l.startswith("{")), None)
+        if out.returncode == 0 and line is not None:
+            return json.loads(line)
+        tail = (out.stderr or out.stdout or "").strip().splitlines()[-3:]
+        return {"data_error": f"rc={out.returncode} " + " | ".join(tail)}
+    except subprocess.TimeoutExpired:
+        return {"data_error": f"data stage exceeded {timeout:.0f}s"}
+    except Exception as exc:
+        return {"data_error": repr(exc)}
+
+
 def _serve_bench_main() -> None:
     """``--serve-bench`` child: measure the serving executor on the
     4-device CPU mesh this process was launched onto (the serving stage is
@@ -1515,6 +1640,9 @@ def main() -> None:
     if len(sys.argv) >= 2 and sys.argv[1] == "--decode-bench":
         _decode_bench_main()
         return
+    if len(sys.argv) >= 2 and sys.argv[1] == "--data-bench":
+        _data_bench_main()
+        return
 
     me = os.path.abspath(__file__)
     from __graft_entry__ import _cpu_env
@@ -1594,6 +1722,11 @@ def main() -> None:
                 # monolithic generate() convoy on a seeded mixed-length
                 # workload (ISSUE 15 acceptance >= 1.5x)
                 rec.update(_decode_stage())
+                # data-engine stage (fail-soft, live records only, same
+                # mesh): groupby/top-k rows/s at 10M rows + the exact
+                # streaming quantile over a ~100M-element HDF5 stream
+                # with its peak-resident accounting (ISSUE 17)
+                rec.update(_data_stage())
                 line = json.dumps(rec)
             except Exception as exc:
                 sys.stderr.write(f"bench: serve/fusion stage skipped: {exc}\n")

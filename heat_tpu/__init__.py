@@ -53,6 +53,7 @@ from . import nn
 from . import optim
 from . import utils
 from . import serve
+from . import data
 
 __version__ = core.__version__
 

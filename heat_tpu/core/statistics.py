@@ -1025,16 +1025,30 @@ def _percentile_distributed(x: DNDarray, q, axis_s, out, interpolation,
     floating = jnp.issubdtype(jdt, jnp.floating)
     if axis_s is None:
         n = int(np.prod(x.shape, dtype=np.int64))
-        # floats: NaN-fill the padding — NaNs (data and padding alike) sort
-        # last, so the first n sorted positions are exactly the data
-        # multiset even when it contains NaN or +inf
-        sent = jnp.asarray(jnp.nan, jdt) if floating else _min_neutral(x)
-        fn = distributed_flat_sort_fn(
-            x.larray.shape, jdt, x.split, comm)
-        sorted_phys = fn(x.filled(sent))
+        # data-engine route: ONE bisection-count program returns exactly
+        # the order statistics the picks below need (zero all-gather) —
+        # same elements the sort path would select, bit-exact; None under
+        # the HEAT_TPU_DATA_ENGINE=0 escape hatch or a non-translatable
+        # dtype/layout, which keeps the merge-split sort path
+        take = None
+        if n > 0:
+            from ..data import ops as _data_ops
 
-        def take(i):
-            return sorted_phys[i]
+            take = _data_ops.order_stat_take(
+                x, n, np.asarray(q, dtype=np.float64).reshape(-1),
+                interpolation, floating)
+        if take is None:
+            # floats: NaN-fill the padding — NaNs (data and padding
+            # alike) sort last, so the first n sorted positions are
+            # exactly the data multiset even when it contains NaN or +inf
+            sent = jnp.asarray(jnp.nan, jdt) if floating else \
+                _min_neutral(x)
+            fn = distributed_flat_sort_fn(
+                x.larray.shape, jdt, x.split, comm)
+            sorted_phys = fn(x.filled(sent))
+
+            def take(i):
+                return sorted_phys[i]
     else:
         n = x.shape[axis_s]
         fn = distributed_sort_fn(

@@ -1,0 +1,25 @@
+"""``heat_tpu.data`` — the tape-compiled distributed data engine.
+
+Relational/ordering primitives (groupby-aggregate, top-k, exact order
+statistics, inner hash join) and their out-of-core streaming variants,
+compiled as cached ``shard_map`` programs with statically planned
+exchanges — see :mod:`heat_tpu.data.ops` for the op → collective-plan
+table and ``doc/data_engine.md`` for the full contract.
+
+``ht.percentile`` / ``ht.median`` / ``ht.quantile`` route their
+distributed flat reductions through :func:`order_stats` bisection
+(zero all-gather) and fall back to the merge-split sort path under
+``HEAT_TPU_DATA_ENGINE=0`` or on non-translatable layouts.
+"""
+
+from . import engine, ops, streaming
+from .engine import enabled, override, program_cache, reset, stats
+from .ops import (GroupBy, groupby, groupby_agg, join, order_stats, topk)
+from .streaming import stream_groupby, stream_quantile, stream_topk
+
+__all__ = [
+    "engine", "ops", "streaming",
+    "enabled", "override", "program_cache", "reset", "stats",
+    "GroupBy", "groupby", "groupby_agg", "join", "order_stats", "topk",
+    "stream_groupby", "stream_quantile", "stream_topk",
+]

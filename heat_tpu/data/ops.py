@@ -1,0 +1,662 @@
+"""Distributed data-engine primitives as cached ``shard_map`` programs.
+
+Every op is shard-local compute plus ONE statically planned exchange —
+no gather of the data axis, ever:
+
+=================  ====================================================
+op                 collective plan (per compiled program)
+=================  ====================================================
+groupby-aggregate  shard-local bucketed partial aggregation (segment
+                   scatter) + ONE packed all-reduce of the per-group
+                   partials (``fusion.packed_psum``; min/max ride one
+                   ``lax.pmin``/``pmax``) — exactly 1 communicating
+                   collective, HLO-audited
+top-k              shard-local ``lax.top_k`` + a k-sized psum exchange
+                   of the (p, k) candidate table — ZERO all-gathers
+order statistics   shard-local sort of the monotone unsigned key
+(percentile/       encoding + ``bits`` bisection-count rounds, each ONE
+median/quantile)   packed psum of the per-rank counts — ZERO
+                   all-gathers; converges to the exact order-statistic
+                   key (the count step function jumps only at attained
+                   keys), then decodes bit-exactly
+hash join          hash partition (``key % p``) into static (p, cap)
+                   send tables + the planner's static-shape tiled
+                   ``all_to_all``, validity flags riding the merge-split
+                   discipline for the data-dependent bucket sizes; a
+                   second capacity-exact all_to_all compacts matches to
+                   the canonical split-0 layout (ONE host sync for the
+                   result length, like ``_setops``)
+=================  ====================================================
+
+Total order: all ordering ops use the ``_sort.py`` monotone key
+encoding, mapped onto the UNSIGNED integer line (sign bit flip) so
+bisection arithmetic never overflows — ``-inf < … < -0.0 < +0.0 < … <
++inf < NaN``, NaNs canonicalized. The eager reference paths reuse the
+same device-side encode/decode helpers, so fused and eager agree
+bitwise on the selected elements.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core._compat import shard_map
+from ..core._sort import _float_sort_key, _index_dtype
+from ..core.dndarray import DNDarray
+from ..utils import faults as _faults
+from ..utils import metrics
+from . import engine
+
+__all__ = ["groupby", "GroupBy", "groupby_agg", "topk", "join",
+           "order_stat_take", "order_stats"]
+
+AGGS = ("sum", "mean", "count", "min", "max")
+
+
+# ---------------------------------------------------------------------- #
+# total-order key encoding (unsigned line)                               #
+# ---------------------------------------------------------------------- #
+def _unsigned_dtype(bits: int):
+    return jnp.dtype(f"uint{bits}")
+
+
+def unsigned_key(x):
+    """Monotone map of ``x`` onto the unsigned integer line (total order
+    with NaN last; see ``_sort._float_sort_key``). Unsigned ints pass
+    through; signed ints and float keys get the sign bit flipped."""
+    jdt = jnp.dtype(x.dtype)
+    if jdt == jnp.bool_:
+        return x.astype(jnp.uint8)
+    if jnp.issubdtype(jdt, jnp.unsignedinteger):
+        return x
+    k = _float_sort_key(x) if jnp.issubdtype(jdt, jnp.floating) else x
+    kdt = jnp.dtype(k.dtype)
+    bits = kdt.itemsize * 8
+    ukdt = _unsigned_dtype(bits)
+    return jax.lax.bitcast_convert_type(k, ukdt) ^ ukdt.type(1 << (bits - 1))
+
+
+def decode_key(uk, jdt):
+    """Inverse of :func:`unsigned_key` — bit-exact back to ``jdt``."""
+    jdt = jnp.dtype(jdt)
+    if jdt == jnp.bool_:
+        return uk.astype(jnp.bool_)
+    if jnp.issubdtype(jdt, jnp.unsignedinteger):
+        return uk.astype(jdt)
+    ukdt = jnp.dtype(uk.dtype)
+    bits = ukdt.itemsize * 8
+    sdt = jnp.dtype(f"int{bits}")
+    s = jax.lax.bitcast_convert_type(uk ^ ukdt.type(1 << (bits - 1)), sdt)
+    if not jnp.issubdtype(jdt, jnp.floating):
+        return s.astype(jdt)
+    fdt = jnp.dtype(jnp.float64 if bits == 64 else jnp.float32)
+    imax = jnp.asarray(jnp.iinfo(sdt).max, sdt)
+    b = jnp.where(s >= 0, s, imax - s)  # self-inverse under wraparound
+    return jax.lax.bitcast_convert_type(b, fdt).astype(jdt)
+
+
+def _key_bits(jdt) -> int:
+    jdt = jnp.dtype(jdt)
+    if jnp.issubdtype(jdt, jnp.floating):
+        return 64 if jdt.itemsize == 8 else 32
+    return max(jdt.itemsize * 8, 8)
+
+
+def _orderable(jdt) -> bool:
+    jdt = jnp.dtype(jdt)
+    return (jnp.issubdtype(jdt, jnp.floating)
+            or (jnp.issubdtype(jdt, jnp.integer) and jdt != jnp.bool_))
+
+
+def _ftype():
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+# ---------------------------------------------------------------------- #
+# groupby-aggregate                                                      #
+# ---------------------------------------------------------------------- #
+def _build_groupby(kphys, kjdt, vphys, vjdt, n, G, op, comm, qk, ck, hk):
+    """ONE executable: segment scatter + exactly 1 communicating
+    collective (a packed psum for sum/mean/count, one pmin/pmax)."""
+    from ..core import fusion
+
+    ax = comm.axis_name
+    p = comm.size
+    c = kphys[0] // p
+    idt = _index_dtype()
+    ft = _ftype()
+    tail = vphys[1:] if vphys is not None else ()
+    vnd = 1 + len(tail)
+
+    def body(kb, *vbs):
+        me = jax.lax.axis_index(ax)
+        gpos = me.astype(idt) * c + jnp.arange(c, dtype=idt)
+        valid = (gpos < n) & (kb >= 0) & (kb < G)
+        idx = jnp.where(valid, kb, 0).astype(idt)
+        if op == "count":
+            part = jnp.zeros((G,), idt).at[idx].add(valid.astype(idt))
+            (tot,) = fusion.packed_psum((part,), (ax,), quant=qk,
+                                        chunks=ck, hier=hk)
+            return tot
+        vb = vbs[0]
+        vmask = valid.reshape(valid.shape + (1,) * (vb.ndim - 1))
+        gshape = (G,) + vb.shape[1:]
+        if op == "sum":
+            contrib = jnp.where(vmask, vb, jnp.zeros((), vb.dtype))
+            part = jnp.zeros(gshape, vb.dtype).at[idx].add(contrib)
+            (tot,) = fusion.packed_psum((part,), (ax,), quant=qk,
+                                        chunks=ck, hier=hk)
+            return tot
+        if op == "mean":
+            # sums AND counts accumulate in ftype: one dtype group ->
+            # the packed psum stays ONE all-reduce (counts are exact
+            # integers in f64 under the repo's x64 default)
+            vs = jnp.where(vmask, vb, jnp.zeros((), vb.dtype)).astype(ft)
+            part = jnp.zeros(gshape, ft).at[idx].add(vs)
+            cnt = jnp.zeros((G,), ft).at[idx].add(valid.astype(ft))
+            tot, cn = fusion.packed_psum((part, cnt), (ax,), quant=qk,
+                                         chunks=ck, hier=hk)
+            cn = cn.reshape((G,) + (1,) * len(tail))
+            return tot / cn  # empty group -> NaN (0/0), documented
+        # min / max: neutral-filled scatter + ONE pmin/pmax all-reduce
+        if jnp.issubdtype(jnp.dtype(vjdt), jnp.floating):
+            neutral = jnp.asarray(jnp.inf if op == "min" else -jnp.inf,
+                                  vjdt)
+        else:
+            info = jnp.iinfo(jnp.dtype(vjdt))
+            neutral = jnp.asarray(info.max if op == "min" else info.min,
+                                  vjdt)
+        contrib = jnp.where(vmask, vb, neutral)
+        buf = jnp.full(gshape, neutral, vjdt)
+        part = (buf.at[idx].min(contrib) if op == "min"
+                else buf.at[idx].max(contrib))
+        return (jax.lax.pmin(part, ax) if op == "min"
+                else jax.lax.pmax(part, ax))
+
+    in_specs = (comm.spec(1, 0),)
+    out_nd = 1 if op == "count" else vnd
+    if op != "count":
+        in_specs = in_specs + (comm.spec(vnd, 0),)
+    return jax.jit(shard_map(
+        body, mesh=comm.mesh, in_specs=in_specs,
+        out_specs=comm.spec(out_nd, None), check_vma=False))
+
+
+def _eager_groupby(kphys, vphys, n, G, op):
+    """Same mathematics, eagerly on the logical arrays (GSPMD eager
+    ops) — the degrade path and the property-test reference."""
+    kg = kphys[:n]
+    idt = _index_dtype()
+    ft = _ftype()
+    valid = (kg >= 0) & (kg < G)
+    idx = jnp.where(valid, kg, 0).astype(idt)
+    if op == "count":
+        return jnp.zeros((G,), idt).at[idx].add(valid.astype(idt))
+    vg = vphys[:n]
+    vmask = valid.reshape(valid.shape + (1,) * (vg.ndim - 1))
+    gshape = (G,) + vg.shape[1:]
+    if op == "sum":
+        contrib = jnp.where(vmask, vg, jnp.zeros((), vg.dtype))
+        return jnp.zeros(gshape, vg.dtype).at[idx].add(contrib)
+    if op == "mean":
+        vs = jnp.where(vmask, vg, jnp.zeros((), vg.dtype)).astype(ft)
+        tot = jnp.zeros(gshape, ft).at[idx].add(vs)
+        cn = jnp.zeros((G,), ft).at[idx].add(valid.astype(ft))
+        return tot / cn.reshape((G,) + (1,) * (vg.ndim - 1))
+    vjdt = jnp.dtype(vg.dtype)
+    if jnp.issubdtype(vjdt, jnp.floating):
+        neutral = jnp.asarray(jnp.inf if op == "min" else -jnp.inf, vjdt)
+    else:
+        info = jnp.iinfo(vjdt)
+        neutral = jnp.asarray(info.max if op == "min" else info.min, vjdt)
+    contrib = jnp.where(vmask, vg, neutral)
+    buf = jnp.full(gshape, neutral, vjdt)
+    return (buf.at[idx].min(contrib) if op == "min"
+            else buf.at[idx].max(contrib))
+
+
+def groupby_agg(keys: DNDarray, num_groups: int, op: str,
+                values: DNDarray = None) -> DNDarray:
+    """Distributed groupby-aggregate: ``keys`` (1-D integer, values in
+    ``[0, num_groups)``; out-of-range rows are dropped) bucket ``values``
+    (1-D or 2-D, row-aligned) into a REPLICATED ``(num_groups, ...)``
+    result. Empty groups: sum/count 0, mean NaN, min/max the identity
+    (±inf / integer extreme). ``mean`` returns the accumulation float
+    dtype (f64 under x64)."""
+    if op not in AGGS:
+        raise ValueError(f"unknown groupby aggregation {op!r}")
+    if keys.ndim != 1:
+        raise ValueError("groupby keys must be 1-D")
+    if not jnp.issubdtype(jnp.dtype(keys.larray.dtype), jnp.integer):
+        raise TypeError("groupby keys must be integers")
+    G = int(num_groups)
+    if G <= 0:
+        raise ValueError("num_groups must be positive")
+    n = int(keys.shape[0])
+    if op != "count":
+        if values is None:
+            raise ValueError(f"groupby agg {op!r} needs values")
+        if values.ndim not in (1, 2) or int(values.shape[0]) != n:
+            raise ValueError("groupby values must be (n,) or (n, d) "
+                             "row-aligned with keys")
+        if values.split != keys.split:
+            values = values.resplit(keys.split)
+    metrics.inc("data_engine.groupby_calls")
+    comm = keys.comm
+    kjdt = jnp.dtype(keys.larray.dtype)
+    vjdt = jnp.dtype(values.larray.dtype) if values is not None else None
+    vphys = tuple(values.larray.shape) if values is not None else None
+    args = (keys.larray,) + ((values.larray,) if values is not None else ())
+
+    def eager(kp, *vp):
+        return _eager_groupby(kp, vp[0] if vp else None, n, G, op)
+
+    if engine.enabled() and keys.split == 0:
+        key = ("data.groupby", tuple(keys.larray.shape), str(kjdt),
+               vphys, str(vjdt), n, G, op, comm.cache_key)
+        res = engine.engine_call(
+            key,
+            lambda qk, ck, hk: _build_groupby(
+                tuple(keys.larray.shape), kjdt, vphys, vjdt, n, G, op,
+                comm, qk, ck, hk),
+            args, eager)
+    else:
+        res = eager(*args)
+    return DNDarray.from_logical(res, None, keys.device, comm)
+
+
+class GroupBy:
+    """``groupby(keys, num_groups)`` handle — ``.agg(op, values)`` plus
+    the named shorthands."""
+
+    def __init__(self, keys: DNDarray, num_groups: int):
+        self.keys = keys
+        self.num_groups = int(num_groups)
+
+    def agg(self, op: str, values: DNDarray = None) -> DNDarray:
+        return groupby_agg(self.keys, self.num_groups, op, values)
+
+    def sum(self, values):
+        return self.agg("sum", values)
+
+    def mean(self, values):
+        return self.agg("mean", values)
+
+    def count(self):
+        return self.agg("count")
+
+    def min(self, values):
+        return self.agg("min", values)
+
+    def max(self, values):
+        return self.agg("max", values)
+
+
+def groupby(keys: DNDarray, num_groups: int) -> GroupBy:
+    return GroupBy(keys, num_groups)
+
+
+# ---------------------------------------------------------------------- #
+# top-k                                                                  #
+# ---------------------------------------------------------------------- #
+def _build_topk(phys, jdt, n, k, largest, comm):
+    """Shard-local ``lax.top_k`` + the k-sized psum exchange of the
+    (p, k) candidate table — zero all-gathers of the data axis."""
+    from ..core import fusion
+
+    ax = comm.axis_name
+    p = comm.size
+    c = phys[0] // p
+    idt = _index_dtype()
+
+    def body(xb):
+        me = jax.lax.axis_index(ax)
+        gpos = me.astype(idt) * c + jnp.arange(c, dtype=idt)
+        valid = gpos < n
+        uk = unsigned_key(xb)
+        sel = jnp.where(valid, uk if largest else ~uk,
+                        jnp.zeros((), uk.dtype))
+        sv, si = jax.lax.top_k(sel, k)
+        # padding sits at the tail of the shard, so stable top_k never
+        # displaces a valid zero-key element; invalid picks get pos=n
+        # and sort after every valid candidate in the merge
+        cpos = jnp.where(valid[si], gpos[si], jnp.asarray(n, idt))
+        bs = jnp.zeros((p, k), sel.dtype).at[me].set(sv)
+        bp = jnp.zeros((p, k), idt).at[me].set(cpos)
+        bs, bp = fusion.packed_psum((bs, bp), (ax,))
+        fs, fp = bs.reshape(p * k), bp.reshape(p * k)
+        order = jnp.lexsort((fp, ~fs))[:k]  # sel desc, position asc
+        osel, opos = fs[order], fp[order]
+        ouk = osel if largest else ~osel
+        return decode_key(ouk, jdt), opos
+
+    return jax.jit(shard_map(
+        body, mesh=comm.mesh, in_specs=(comm.spec(1, 0),),
+        out_specs=(comm.spec(1, None), comm.spec(1, None)),
+        check_vma=False))
+
+
+def _eager_topk(xp, n, k, largest):
+    full = xp[:n]
+    idt = _index_dtype()
+    uk = unsigned_key(full)
+    sel = uk if largest else ~uk
+    order = jnp.lexsort((jnp.arange(n, dtype=idt), ~sel))[:k]
+    return full[order], order.astype(idt)
+
+
+def topk(x: DNDarray, k: int, largest: bool = True):
+    """Top-k of a 1-D array under the engine's total order (NaN sorts
+    greatest, after +inf). Returns REPLICATED ``(values, indices)``,
+    ordered by (value, then position): the exact rows ``lax.top_k`` on
+    the gathered array would pick — without gathering it."""
+    if x.ndim != 1:
+        raise ValueError("topk expects a 1-D array")
+    n = int(x.shape[0])
+    k = int(k)
+    if not 1 <= k <= n:
+        raise ValueError(f"k={k} out of range for {n} elements")
+    if not _orderable(x.larray.dtype):
+        raise TypeError(f"topk: unordered dtype {x.dtype}")
+    metrics.inc("data_engine.topk_calls")
+    comm = x.comm
+    jdt = jnp.dtype(x.larray.dtype)
+    c = x.larray.shape[0] // comm.size if x.split == 0 else 0
+
+    def eager(xp):
+        return _eager_topk(xp, n, k, largest)
+
+    if engine.enabled() and x.split == 0 and k <= c:
+        key = ("data.topk", tuple(x.larray.shape), str(jdt), n, k,
+               bool(largest), comm.cache_key)
+        vals, pos = engine.engine_call(
+            key,
+            lambda qk, ck, hk: _build_topk(
+                tuple(x.larray.shape), jdt, n, k, largest, comm),
+            (x.larray,), eager)
+    else:
+        vals, pos = eager(x.larray)
+    return (DNDarray.from_logical(vals, None, x.device, comm),
+            DNDarray.from_logical(pos, None, x.device, comm))
+
+
+# ---------------------------------------------------------------------- #
+# order statistics (percentile / median / quantile)                      #
+# ---------------------------------------------------------------------- #
+def _build_order_stats(phys, jdt, split, gshape, m, comm):
+    """Bisection on the unsigned key line: shard-local sort once, then
+    ``bits`` rounds of (searchsorted count -> ONE packed psum) converge
+    every requested rank to its exact order-statistic key — zero
+    all-gathers, all-reduce payload is the (m,) count vector."""
+    ax = comm.axis_name
+    p = comm.size
+    c = phys[split] // p
+    idt = _index_dtype()
+    bits = _key_bits(jdt)
+    ukdt = _unsigned_dtype(bits)
+    umax = np.asarray(np.iinfo(np.dtype(f"uint{bits}")).max, ukdt)
+
+    def body(xb, rk):
+        me = jax.lax.axis_index(ax)
+        pos_s = me.astype(idt) * c + jnp.arange(c, dtype=idt)
+        valid_s = pos_s < gshape[split]
+        shape = [1] * xb.ndim
+        shape[split] = c
+        mask = jnp.broadcast_to(valid_s.reshape(shape), xb.shape).ravel()
+        uk = unsigned_key(xb).ravel()
+        # padding keys to umax: umax is unattained for floats (the
+        # canonical-NaN key sits strictly below it); for ints an
+        # attained umax still converges correctly — the minimal key v
+        # with count(<=v) >= r+1 is unaffected below umax, and at umax
+        # the (inflated) count only confirms an answer that is umax
+        su = jnp.sort(jnp.where(mask, uk, umax))
+        lo = jnp.zeros((m,), ukdt)
+        hi = jnp.full((m,), umax, ukdt)
+
+        def rnd(_, carry):
+            lo, hi = carry
+            done = lo >= hi
+            mid = lo + (hi - lo) // jnp.asarray(2, ukdt)
+            cnt = jnp.searchsorted(su, mid, side="right").astype(idt)
+            cnt = jax.lax.psum(cnt, ax)
+            ge = cnt >= rk + 1
+            nlo = jnp.where(ge, lo, mid + jnp.asarray(1, ukdt))
+            nhi = jnp.where(ge, mid, hi)
+            return (jnp.where(done, lo, nlo), jnp.where(done, hi, nhi))
+
+        lo, hi = jax.lax.fori_loop(0, bits, rnd, (lo, hi))
+        return decode_key(lo, jdt)
+
+    return jax.jit(shard_map(
+        body, mesh=comm.mesh,
+        in_specs=(comm.spec(len(phys), split), comm.spec(1, None)),
+        out_specs=comm.spec(1, None), check_vma=False))
+
+
+def order_stats(x: DNDarray, ranks) -> jnp.ndarray:
+    """Exact order statistics of the flattened distributed bag at the
+    given (sorted, 0-based) ranks, under the engine's total order —
+    REPLICATED (m,) values in ``x``'s dtype, no gather of the data."""
+    ranks_t = tuple(int(r) for r in ranks)
+    metrics.inc("data_engine.quantile_calls")
+    comm = x.comm
+    jdt = jnp.dtype(x.larray.dtype)
+    m = len(ranks_t)
+    idt = _index_dtype()
+    args = (x.larray, jnp.asarray(ranks_t, dtype=idt))
+
+    def eager(xp, rk):
+        uk = unsigned_key(x._logical().ravel())
+        return decode_key(jnp.sort(uk)[rk], jdt)
+
+    key = ("data.ostats", tuple(x.larray.shape), str(jdt), x.split,
+           tuple(int(s) for s in x.shape), m, comm.cache_key)
+    return engine.engine_call(
+        key,
+        lambda qk, ck, hk: _build_order_stats(
+            tuple(x.larray.shape), jdt, x.split,
+            tuple(int(s) for s in x.shape), m, comm),
+        args, eager)
+
+
+def order_stat_take(x: DNDarray, n: int, q_arr, interpolation: str,
+                    floating: bool):
+    """Engine route for ``statistics._percentile_distributed``'s flat
+    branch: precompute the needed ranks, run ONE bisection program, and
+    return a ``take(i)`` closure — or None when the engine is off or the
+    layout/dtype is not translatable (the caller falls back to the
+    merge-split sort path)."""
+    if not engine.enabled() or n <= 0 or x.split is None:
+        return None
+    if not _orderable(x.larray.dtype):
+        return None
+    ranks = set()
+    for qv in np.asarray(q_arr, dtype=np.float64).reshape(-1):
+        f = (n - 1) * float(qv) / 100.0
+        lo, hi = int(np.floor(f)), int(np.ceil(f))
+        if interpolation == "lower":
+            ranks.add(lo)
+        elif interpolation == "higher":
+            ranks.add(hi)
+        elif interpolation == "nearest":
+            ranks.add(int(np.round(f)))
+        else:  # linear / midpoint interpolate between both neighbours
+            ranks.update((lo, hi))
+    if floating:
+        ranks.add(n - 1)  # the NaN-poisoning probe
+    ranks_t = tuple(sorted(ranks))
+    vals = order_stats(x, ranks_t)
+    index = {r: i for i, r in enumerate(ranks_t)}
+    return lambda i: vals[index[int(i)]]
+
+
+# ---------------------------------------------------------------------- #
+# hash join (inner, integer keys)                                        #
+# ---------------------------------------------------------------------- #
+def _build_join_probe(lphys, lkdt, lvdt, rphys, rkdt, rvdt, n_l, n_r,
+                      comm):
+    """Phase A: hash-partition both sides with the static-shape tiled
+    all_to_all (capacity = the local chunk, validity flags riding the
+    merge-split discipline), then probe the sorted right bucket."""
+    ax = comm.axis_name
+    p = comm.size
+    cl = lphys[0] // p
+    cr = rphys[0] // p
+    idt = _index_dtype()
+
+    def partition(keys, vals, cn, n_side, me):
+        gpos = me.astype(idt) * cn + jnp.arange(cn, dtype=idt)
+        valid = (gpos < n_side) & (keys >= 0)
+        dest = jnp.where(valid, keys % p, p).astype(idt)
+        order = jnp.argsort(dest, stable=True)
+        sd = dest[order]
+        start = jnp.searchsorted(sd, sd, side="left")
+        slot = jnp.arange(cn, dtype=idt) - start.astype(idt)
+        flat = sd * cn + slot  # dest==p rows land past the buffer: drop
+        sk = jnp.full((p * cn,), -1, keys.dtype).at[flat].set(
+            keys[order], mode="drop")
+        sv = jnp.zeros((p * cn,), vals.dtype).at[flat].set(
+            vals[order], mode="drop")
+        rk = jax.lax.all_to_all(sk.reshape(p, cn), ax, 0, 0, tiled=True)
+        rv = jax.lax.all_to_all(sv.reshape(p, cn), ax, 0, 0, tiled=True)
+        return rk.reshape(p * cn), rv.reshape(p * cn)
+
+    def body(lk, lv, rk, rv):
+        me = jax.lax.axis_index(ax)
+        lbk, lbv = partition(lk, lv, cl, n_l, me)
+        rbk, rbv = partition(rk, rv, cr, n_r, me)
+        ordr = jnp.argsort(rbk)  # invalid (-1) sorts first
+        srk, srv = rbk[ordr], rbv[ordr]
+        idx = jnp.searchsorted(srk, lbk, side="left")
+        idxc = jnp.minimum(idx, p * cr - 1)
+        found = (idx < p * cr) & (srk[idxc] == lbk) & (lbk >= 0)
+        mrv = srv[idxc]
+        fm = found.astype(idt)
+        cnt = jnp.sum(fm)
+        off = comm.exscan(cnt)
+        total = jax.lax.psum(cnt, ax)
+        pos = off + jnp.cumsum(fm) - fm
+        outpos = jnp.where(found, pos, -1)
+        return found, outpos, lbk, lbv, mrv, total
+
+    return jax.jit(shard_map(
+        body, mesh=comm.mesh, in_specs=(comm.spec(1, 0),) * 4,
+        out_specs=(comm.spec(1, 0),) * 5 + (comm.spec(0, None),),
+        check_vma=False))
+
+
+def _build_join_compact(bphys, kdt, lvdt, rvdt, M, comm):
+    """Phase B (keyed by the host-synced match count M): route every
+    matched row to its canonical split-0 slot with a capacity-EXACT
+    all_to_all (output positions are unique and contiguous)."""
+    ax = comm.axis_name
+    p = comm.size
+    c_out = comm.chunk_size(M)
+    idt = _index_dtype()
+
+    def body(match, outpos, kk, lv, rv):
+        dest = jnp.where(match, outpos // c_out, p).astype(idt)
+        slot = jnp.where(match, outpos % c_out, 0).astype(idt)
+        flat = dest * c_out + slot  # invalid rows land past the buffer
+
+        def route(vals):
+            s = jnp.zeros((p * c_out,), vals.dtype).at[flat].set(
+                vals, mode="drop")
+            r = jax.lax.all_to_all(s.reshape(p, c_out), ax, 0, 0,
+                                   tiled=True)
+            return r.sum(axis=0)  # exactly one writer per slot
+
+        return route(kk), route(lv), route(rv)
+
+    return jax.jit(shard_map(
+        body, mesh=comm.mesh, in_specs=(comm.spec(1, 0),) * 5,
+        out_specs=(comm.spec(1, 0),) * 3, check_vma=False))
+
+
+def _eager_join(lk, lv, rk, rv, n_l, n_r, p):
+    """Host-side reference with the compiled path's output order:
+    matched left rows sorted by (key % p, original position)."""
+    lk = np.asarray(lk[:n_l])
+    lv = np.asarray(lv[:n_l])
+    rk = np.asarray(rk[:n_r])
+    rv = np.asarray(rv[:n_r])
+    ordr = np.argsort(rk, kind="stable")
+    srk, srv = rk[ordr], rv[ordr]
+    idx = np.searchsorted(srk, lk, side="left")
+    idxc = np.minimum(idx, max(n_r - 1, 0))
+    found = (idx < n_r) & (srk[idxc] == lk) & (lk >= 0)
+    order = np.lexsort((np.arange(n_l), lk % p))
+    sel = order[found[order]]
+    return lk[sel], lv[sel], srv[idxc][sel]
+
+
+def join(left_keys: DNDarray, left_values: DNDarray,
+         right_keys: DNDarray, right_values: DNDarray):
+    """Distributed inner hash join on NON-NEGATIVE integer keys (the
+    right side is the build side and its keys must be unique — duplicate
+    right keys give an unspecified pick). Returns split-0
+    ``(keys, left_values, right_values)`` of the matched rows, ordered
+    by (key % p, left position); ONE host sync fixes the result length.
+    """
+    for a, nd in ((left_keys, 1), (left_values, 1), (right_keys, 1),
+                  (right_values, 1)):
+        if a.ndim != nd:
+            raise ValueError("join expects 1-D keys and 1-D values")
+    for kk in (left_keys, right_keys):
+        if not jnp.issubdtype(jnp.dtype(kk.larray.dtype), jnp.signedinteger):
+            raise TypeError("join keys must be signed integers")
+    n_l, n_r = int(left_keys.shape[0]), int(right_keys.shape[0])
+    if int(left_values.shape[0]) != n_l or int(right_values.shape[0]) != n_r:
+        raise ValueError("join values must be row-aligned with their keys")
+    metrics.inc("data_engine.join_calls")
+    comm = left_keys.comm
+    p = comm.size
+    device = left_keys.device
+    args = (left_keys.larray, left_values.larray,
+            right_keys.larray, right_values.larray)
+
+    def _wrap(kk, lv, rv, split):
+        return (DNDarray.from_logical(kk, split, device, comm),
+                DNDarray.from_logical(lv, split, device, comm),
+                DNDarray.from_logical(rv, split, device, comm))
+
+    translatable = (engine.enabled()
+                    and left_keys.split == 0 and left_values.split == 0
+                    and right_keys.split == 0 and right_values.split == 0)
+    if translatable:
+        cache = engine.program_cache()
+        lkdt, lvdt = (jnp.dtype(a.dtype) for a in args[:2])
+        rkdt, rvdt = (jnp.dtype(a.dtype) for a in args[2:])
+        sig = (tuple(args[0].shape), str(lkdt), str(lvdt),
+               tuple(args[2].shape), str(rkdt), str(rvdt), n_l, n_r,
+               comm.cache_key)
+        try:
+            _faults.check("data.exchange.dispatch")
+            prog_a = cache.get_custom(
+                ("data.join.a",) + sig,
+                lambda: _build_join_probe(
+                    tuple(args[0].shape), lkdt, lvdt,
+                    tuple(args[2].shape), rkdt, rvdt, n_l, n_r, comm))
+            match, outpos, bk, bv, mrv, total = prog_a(*args)
+            M = int(total)  # the ONE host sync (the _setops discipline)
+            if M == 0:
+                empty = _wrap(jnp.zeros((0,), lkdt), jnp.zeros((0,), lvdt),
+                              jnp.zeros((0,), rvdt), 0)
+            else:
+                prog_b = cache.get_custom(
+                    ("data.join.b",) + sig + (M,),
+                    lambda: _build_join_compact(
+                        tuple(bk.shape), lkdt, lvdt, rvdt, M, comm))
+                gk, gl, gr = prog_b(match, outpos, bk, bv, mrv)
+                empty = _wrap(gk[:M], gl[:M], gr[:M], 0)
+        except Exception:
+            metrics.inc("data_engine.exchange_fallbacks")
+            kk, lv, rv = _eager_join(*args, n_l=n_l, n_r=n_r, p=p)
+            return _wrap(jnp.asarray(kk), jnp.asarray(lv),
+                         jnp.asarray(rv), 0)
+        metrics.inc("data_engine.dispatches")
+        return empty
+    kk, lv, rv = _eager_join(*args, n_l=n_l, n_r=n_r, p=p)
+    return _wrap(jnp.asarray(kk), jnp.asarray(lv), jnp.asarray(rv), 0)

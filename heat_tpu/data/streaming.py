@@ -1,0 +1,522 @@
+"""Streaming data-engine ops: fold ``DataStream`` chunks through ONE
+donated carry-state executable.
+
+Memory contract: the resident set is ONE chunk plus a tiny carry —
+``(p, G)`` group partials, ``(p, k)`` top-k candidates, or a
+``(p, m·branch)`` bisection count table — so a 100M-row dataset never
+materializes. Each chunk shape compiles at most one step program (the
+tail chunk adds a second); the carry buffers are DONATED, so XLA updates
+them in place and steady-state chunk folding neither recompiles nor
+grows device memory.
+
+Collective plan: chunk folding is shard-LOCAL (zero collectives per
+chunk — every device accumulates its shard rows into its own carry row);
+the cross-device combine happens ONCE at finalize, on the host, over the
+``(p, …)`` carry (a p-row fetch, not a data gather).
+
+Quantiles run multi-pass ``branch``-way bisection: each pass counts
+``uk <= pivot`` for a grid of ``branch`` pivots per rank (a shard-local
+sort + searchsorted per chunk), then narrows the unsigned-key interval
+by that factor on the host — ``ceil(bits / log2(branch))`` passes
+(4 for f32, 8 for f64 at the default branch=256) converge every rank to
+its EXACT order-statistic key, same total order and bit-exact decode as
+the in-memory engine.
+
+Sources: a ``DataStream`` (re-iterated per pass via ``iter_chunks``), a
+list/tuple of split-0 ``DNDarray`` chunks, or a zero-arg callable
+returning a fresh chunk iterator. Quantile needs a re-iterable source.
+
+Fault site ``data.stream.carry``: an injected (or real) carry-dispatch
+failure degrades THAT chunk to the eager accumulation with identical
+results, counted in ``data_engine.stream_fallbacks``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core._compat import shard_map
+from ..core._sort import _index_dtype
+from ..core.dndarray import DNDarray
+from ..utils import metrics
+from . import engine
+from .ops import (AGGS, _ftype, _key_bits, _orderable, _unsigned_dtype,
+                  decode_key, unsigned_key)
+
+__all__ = ["stream_groupby", "stream_topk", "stream_quantile"]
+
+_SITE = "data.stream.carry"
+_FALLBACK = "data_engine.stream_fallbacks"
+
+
+def _chunk_iter(source, rows_per_chunk: int):
+    if hasattr(source, "iter_chunks"):
+        return source.iter_chunks(rows_per_chunk)
+    if callable(source):
+        return iter(source())
+    return iter(source)
+
+
+def _total_rows(source, rows_per_chunk: int) -> int:
+    if hasattr(source, "shape"):
+        return int(source.shape[0])
+    if isinstance(source, (list, tuple)):
+        return sum(int(c.shape[0]) for c in source)
+    return sum(int(c.shape[0]) for c in _chunk_iter(source,
+                                                    rows_per_chunk))
+
+
+def _col(chb, col):
+    """Extract the value column of a local chunk block (1-D pass-through)."""
+    return chb if chb.ndim == 1 else chb[:, col]
+
+
+def _fold(key, build, carries, chunk_phys, extra, eager, ncarry):
+    """One chunk through the donated carry executable (or eager)."""
+    args = tuple(carries) + (chunk_phys,) + tuple(extra)
+    if engine.enabled():
+        out = engine.engine_call(key, build, args, eager, site=_SITE,
+                                 fallback_counter=_FALLBACK)
+    else:
+        out = eager(*args)
+    metrics.inc("data_engine.stream_chunks")
+    return list(out) if ncarry > 1 else [out]
+
+
+def _put_carry(arr, comm):
+    return jax.device_put(arr, comm.sharding(arr.ndim, 0))
+
+
+# ---------------------------------------------------------------------- #
+# streaming groupby                                                      #
+# ---------------------------------------------------------------------- #
+def _build_stream_groupby(cshapes, cdts, cphys, cjdt, n_chunk, G, op,
+                          key_col, value_col, comm):
+    ax = comm.axis_name
+    p = comm.size
+    c = cphys[0] // p
+    idt = _index_dtype()
+    ft = _ftype()
+
+    def body(*bufs):
+        carries, chb = bufs[:-1], bufs[-1]
+        me = jax.lax.axis_index(ax)
+        gpos = me.astype(idt) * c + jnp.arange(c, dtype=idt)
+        kb = chb[:, key_col].astype(idt)
+        valid = (gpos < n_chunk) & (kb >= 0) & (kb < G)
+        idx = jnp.where(valid, kb, 0)
+        if op == "count":
+            part = jnp.zeros((G,), idt).at[idx].add(valid.astype(idt))
+            return carries[0] + part[None]
+        vb = chb[:, value_col]
+        if op == "sum":
+            contrib = jnp.where(valid, vb, jnp.zeros((), vb.dtype))
+            part = jnp.zeros((G,), vb.dtype).at[idx].add(contrib)
+            return carries[0] + part[None]
+        if op == "mean":
+            vs = jnp.where(valid, vb, jnp.zeros((), vb.dtype)).astype(ft)
+            part = jnp.zeros((G,), ft).at[idx].add(vs)
+            cnt = jnp.zeros((G,), ft).at[idx].add(valid.astype(ft))
+            return carries[0] + part[None], carries[1] + cnt[None]
+        vjdt = jnp.dtype(vb.dtype)
+        if jnp.issubdtype(vjdt, jnp.floating):
+            neutral = jnp.asarray(jnp.inf if op == "min" else -jnp.inf,
+                                  vjdt)
+        else:
+            info = jnp.iinfo(vjdt)
+            neutral = jnp.asarray(info.max if op == "min" else info.min,
+                                  vjdt)
+        contrib = jnp.where(valid, vb, neutral)
+        buf = jnp.full((G,), neutral, vjdt)
+        part = (buf.at[idx].min(contrib) if op == "min"
+                else buf.at[idx].max(contrib))
+        comb = (jnp.minimum if op == "min" else jnp.maximum)
+        return comb(carries[0], part[None])
+
+    nc = len(cshapes)
+    in_specs = tuple(comm.spec(2, 0) for _ in range(nc)) \
+        + (comm.spec(2, 0),)
+    out_specs = tuple(comm.spec(2, 0) for _ in range(nc))
+    return jax.jit(shard_map(
+        body, mesh=comm.mesh, in_specs=in_specs,
+        out_specs=out_specs if nc > 1 else out_specs[0],
+        check_vma=False), donate_argnums=tuple(range(nc)))
+
+
+def stream_groupby(source, num_groups: int, op: str = "sum",
+                   key_col: int = 0, value_col: int = 1,
+                   rows_per_chunk: int = 1 << 16) -> DNDarray:
+    """Groupby-aggregate over a chunked 2-D stream: ``key_col`` holds
+    integral group ids, ``value_col`` the measure. One pass; resident
+    memory = one chunk + the ``(p, num_groups)`` carry. Same semantics
+    as :func:`heat_tpu.data.groupby_agg`."""
+    if op not in AGGS:
+        raise ValueError(f"unknown groupby aggregation {op!r}")
+    G = int(num_groups)
+    if G <= 0:
+        raise ValueError("num_groups must be positive")
+    metrics.inc("data_engine.groupby_calls")
+    idt = _index_dtype()
+    ft = _ftype()
+    carries = comm = device = None
+    cshapes = cdts = None
+    for chunk in _chunk_iter(source, rows_per_chunk):
+        if chunk.ndim != 2 or chunk.split != 0:
+            raise ValueError("stream_groupby needs split-0 2-D chunks")
+        if carries is None:
+            comm, device = chunk.comm, chunk.device
+            p = comm.size
+            vjdt = jnp.dtype(chunk.larray.dtype)
+            if op == "count":
+                cdts = (idt,)
+            elif op == "sum":
+                cdts = (vjdt,)
+            elif op == "mean":
+                cdts = (ft, ft)
+            else:
+                cdts = (vjdt,)
+            cshapes = ((p, G),) * len(cdts)
+            init = []
+            for sh, dt in zip(cshapes, cdts):
+                if op in ("min", "max"):
+                    if jnp.issubdtype(jnp.dtype(dt), jnp.floating):
+                        fill = np.inf if op == "min" else -np.inf
+                    else:
+                        info = np.iinfo(np.dtype(dt))
+                        fill = info.max if op == "min" else info.min
+                    init.append(np.full(sh, fill, dt))
+                else:
+                    init.append(np.zeros(sh, dt))
+            carries = [_put_carry(a, comm) for a in init]
+        n_chunk = int(chunk.shape[0])
+        cphys = tuple(chunk.larray.shape)
+        cjdt = jnp.dtype(chunk.larray.dtype)
+        key = ("data.stream.groupby", cshapes, tuple(map(str, cdts)),
+               cphys, str(cjdt), n_chunk, G, op, key_col, value_col,
+               comm.cache_key)
+
+        def eager(*bufs, _n=n_chunk):
+            cs, chb = bufs[:-1], bufs[-1]
+            ch = chb[:_n]
+            kb = ch[:, key_col].astype(idt)
+            valid = (kb >= 0) & (kb < G)
+            idx = jnp.where(valid, kb, 0)
+            if op == "count":
+                part = jnp.zeros((G,), idt).at[idx].add(valid.astype(idt))
+                return cs[0].at[0].add(part)
+            vb = ch[:, value_col]
+            if op == "sum":
+                contrib = jnp.where(valid, vb, jnp.zeros((), vb.dtype))
+                part = jnp.zeros((G,), vb.dtype).at[idx].add(contrib)
+                return cs[0].at[0].add(part)
+            if op == "mean":
+                vs = jnp.where(valid, vb,
+                               jnp.zeros((), vb.dtype)).astype(ft)
+                part = jnp.zeros((G,), ft).at[idx].add(vs)
+                cnt = jnp.zeros((G,), ft).at[idx].add(valid.astype(ft))
+                return cs[0].at[0].add(part), cs[1].at[0].add(cnt)
+            vjdt2 = jnp.dtype(vb.dtype)
+            if jnp.issubdtype(vjdt2, jnp.floating):
+                neutral = jnp.asarray(
+                    jnp.inf if op == "min" else -jnp.inf, vjdt2)
+            else:
+                info = jnp.iinfo(vjdt2)
+                neutral = jnp.asarray(
+                    info.max if op == "min" else info.min, vjdt2)
+            contrib = jnp.where(valid, vb, neutral)
+            buf = jnp.full((G,), neutral, vjdt2)
+            part = (buf.at[idx].min(contrib) if op == "min"
+                    else buf.at[idx].max(contrib))
+            return (cs[0].at[0].min(part) if op == "min"
+                    else cs[0].at[0].max(part))
+
+        carries = _fold(
+            key,
+            lambda qk, ck, hk, _n=n_chunk, _ph=cphys, _dt=cjdt:
+                _build_stream_groupby(cshapes, cdts, _ph, _dt, _n, G,
+                                      op, key_col, value_col, comm),
+            carries, chunk.larray, (), eager, len(cdts))
+    if carries is None:
+        raise ValueError("stream_groupby: empty stream")
+    host = [np.asarray(a) for a in carries]
+    if op in ("sum", "count"):
+        res = host[0].sum(axis=0)
+    elif op == "mean":
+        with np.errstate(invalid="ignore", divide="ignore"):
+            res = host[0].sum(axis=0) / host[1].sum(axis=0)
+    elif op == "min":
+        res = host[0].min(axis=0)
+    else:
+        res = host[0].max(axis=0)
+    return DNDarray.from_logical(jnp.asarray(res), None, device, comm)
+
+
+# ---------------------------------------------------------------------- #
+# streaming top-k                                                        #
+# ---------------------------------------------------------------------- #
+def _build_stream_topk(cphys, cjdt, n_chunk, k, largest, col, comm,
+                       ukdt, invalid_pos):
+    ax = comm.axis_name
+    p = comm.size
+    c = cphys[0] // p
+    idt = _index_dtype()
+
+    def body(cs, cp, chb, off):
+        me = jax.lax.axis_index(ax)
+        gpos = me.astype(idt) * c + jnp.arange(c, dtype=idt)
+        valid = gpos < n_chunk
+        vb = _col(chb, col)
+        uk = unsigned_key(vb)
+        sel = jnp.where(valid, uk if largest else ~uk,
+                        jnp.zeros((), ukdt))
+        sv, si = jax.lax.top_k(sel, k)
+        npos = jnp.where(valid[si], off + gpos[si], invalid_pos)
+        cat_s = jnp.concatenate([cs[0], sv])
+        cat_p = jnp.concatenate([cp[0], npos])
+        order = jnp.lexsort((cat_p, ~cat_s))[:k]
+        return cat_s[order][None], cat_p[order][None]
+
+    nd = len(cphys)
+    return jax.jit(shard_map(
+        body, mesh=comm.mesh,
+        in_specs=(comm.spec(2, 0), comm.spec(2, 0), comm.spec(nd, 0),
+                  comm.spec(0, None)),
+        out_specs=(comm.spec(2, 0), comm.spec(2, 0)),
+        check_vma=False), donate_argnums=(0, 1))
+
+
+def stream_topk(source, k: int, largest: bool = True, col=None,
+                rows_per_chunk: int = 1 << 16):
+    """Top-k over a chunked stream (1-D chunks, or 2-D with ``col``).
+    Positions index the logical stream rows. Resident memory = one chunk
+    + the ``(p, k)`` candidate carry. Same total order as
+    :func:`heat_tpu.data.topk`."""
+    k = int(k)
+    if k < 1:
+        raise ValueError("k must be positive")
+    metrics.inc("data_engine.topk_calls")
+    idt = _index_dtype()
+    invalid_pos = np.iinfo(np.dtype(idt)).max
+    carries = comm = device = jdt = ukdt = None
+    offset = 0
+    for chunk in _chunk_iter(source, rows_per_chunk):
+        if chunk.split != 0:
+            raise ValueError("stream_topk needs split-0 chunks")
+        vjdt = jnp.dtype(chunk.larray.dtype)
+        if not _orderable(vjdt):
+            raise TypeError(f"stream_topk: unordered dtype {vjdt}")
+        if carries is None:
+            comm, device, jdt = chunk.comm, chunk.device, vjdt
+            p = comm.size
+            ukdt = _unsigned_dtype(_key_bits(jdt))
+            carries = [
+                _put_carry(np.zeros((p, k), ukdt), comm),
+                _put_carry(np.full((p, k), invalid_pos, idt), comm)]
+        n_chunk = int(chunk.shape[0])
+        cphys = tuple(chunk.larray.shape)
+        c = cphys[0] // comm.size
+        off = np.asarray(offset, idt)
+
+        def eager(cs, cp, chb, o, _n=n_chunk):
+            vb = _col(chb[:_n], col)
+            uk = unsigned_key(vb)
+            sel = uk if largest else ~uk
+            pos = o + jnp.arange(_n, dtype=idt)
+            cat_s = jnp.concatenate([cs[0], sel])
+            cat_p = jnp.concatenate([cp[0], pos])
+            order = jnp.lexsort((cat_p, ~cat_s))[:k]
+            return (cs.at[0].set(cat_s[order]),
+                    cp.at[0].set(cat_p[order]))
+
+        if k <= c:
+            key = ("data.stream.topk", cphys, str(vjdt), n_chunk, k,
+                   bool(largest), col, comm.cache_key)
+            carries = _fold(
+                key,
+                lambda qk, ck, hk, _n=n_chunk, _ph=cphys:
+                    _build_stream_topk(_ph, vjdt, _n, k, largest, col,
+                                       comm, ukdt, invalid_pos),
+                carries, chunk.larray, (off,), eager, 2)
+        else:  # chunk smaller than k: merge it eagerly
+            carries = list(eager(carries[0], carries[1],
+                                 chunk.larray, off))
+            metrics.inc("data_engine.stream_chunks")
+        offset += n_chunk
+    if carries is None:
+        raise ValueError("stream_topk: empty stream")
+    if k > offset:
+        raise ValueError(f"k={k} out of range for {offset} rows")
+    sel = np.asarray(carries[0]).reshape(-1)
+    pos = np.asarray(carries[1]).reshape(-1)
+    order = np.lexsort((pos, np.invert(sel)))[:k]
+    sel_t, pos_t = sel[order], pos[order]
+    uk_t = sel_t if largest else np.invert(sel_t)
+    vals = decode_key(jnp.asarray(uk_t, ukdt), jdt)
+    return (DNDarray.from_logical(vals, None, device, comm),
+            DNDarray.from_logical(jnp.asarray(pos_t, idt), None, device,
+                                  comm))
+
+
+# ---------------------------------------------------------------------- #
+# streaming quantile                                                     #
+# ---------------------------------------------------------------------- #
+def _build_stream_quantile(cphys, cjdt, n_chunk, nbins, col, comm, ukdt,
+                           umax):
+    ax = comm.axis_name
+    p = comm.size
+    c = cphys[0] // p
+    idt = _index_dtype()
+    floating = jnp.issubdtype(jnp.dtype(cjdt), jnp.floating)
+
+    def body(carry, ncarry, chb, pivots):
+        me = jax.lax.axis_index(ax)
+        gpos = me.astype(idt) * c + jnp.arange(c, dtype=idt)
+        valid = gpos < n_chunk
+        vb = _col(chb, col)
+        uk = unsigned_key(vb)
+        su = jnp.sort(jnp.where(valid, uk, umax))
+        cnt = jnp.searchsorted(su, pivots, side="right").astype(jnp.int64)
+        nn = (jnp.sum(valid & jnp.isnan(vb)).astype(jnp.int64)
+              if floating else jnp.zeros((), jnp.int64))
+        return carry + cnt[None], ncarry + nn[None]
+
+    nd = len(cphys)
+    return jax.jit(shard_map(
+        body, mesh=comm.mesh,
+        in_specs=(comm.spec(2, 0), comm.spec(1, 0), comm.spec(nd, 0),
+                  comm.spec(1, None)),
+        out_specs=(comm.spec(2, 0), comm.spec(1, 0)),
+        check_vma=False), donate_argnums=(0, 1))
+
+
+def stream_quantile(source, q, col=None, rows_per_chunk: int = 1 << 16,
+                    interpolation: str = "linear", branch: int = 256):
+    """EXACT quantiles (``q`` in [0, 1], scalar or sequence) of a
+    chunked stream via multi-pass ``branch``-way bisection on the
+    unsigned key line — ``ceil(bits/log2(branch))`` passes over the
+    (re-iterable) source, resident memory bounded by one chunk + the
+    count carry. NaN anywhere poisons the result (numpy parity).
+    Returns a python float / numpy array (host values)."""
+    q_np = np.asarray(q, dtype=np.float64)
+    if q_np.size and not bool((q_np >= 0).all() and (q_np <= 1).all()):
+        raise ValueError("Quantiles must be in the range [0, 1]")
+    if interpolation not in ("linear", "lower", "higher", "nearest",
+                             "midpoint"):
+        raise ValueError(f"unknown interpolation method {interpolation!r}")
+    branch = max(int(branch), 2)
+    metrics.inc("data_engine.quantile_calls")
+    n = _total_rows(source, rows_per_chunk)
+    if n <= 0:
+        raise ValueError("stream_quantile: empty stream")
+    # one probe chunk for dtype/mesh metadata (re-iterable source)
+    first = next(_chunk_iter(source, rows_per_chunk))
+    comm, device = first.comm, first.device
+    jdt = jnp.dtype(first.larray.dtype)
+    if not _orderable(jdt):
+        raise TypeError(f"stream_quantile: unordered dtype {jdt}")
+    floating = jnp.issubdtype(jdt, jnp.floating)
+    del first
+    p = comm.size
+    bits = _key_bits(jdt)
+    ukdt = _unsigned_dtype(bits)
+    umax_py = (1 << bits) - 1
+    umax = np.asarray(umax_py, ukdt)
+    # target ranks (0-based) per quantile
+    targets = []
+    for qv in q_np.reshape(-1):
+        f = (n - 1) * float(qv)
+        lo_r, hi_r = int(np.floor(f)), int(np.ceil(f))
+        if interpolation == "lower":
+            need = (lo_r,)
+        elif interpolation == "higher":
+            need = (hi_r,)
+        elif interpolation == "nearest":
+            need = (int(np.round(f)),)
+        else:
+            need = (lo_r, hi_r)
+        targets.append((f, lo_r, hi_r, need))
+    ranks = sorted({r for _, _, _, need in targets for r in need})
+    m = len(ranks)
+    lo = [0] * m
+    hi = [umax_py] * m
+    nan_total = None
+    passes = 0
+    while any(lo[i] < hi[i] for i in range(m)) or nan_total is None:
+        grids = []
+        for i in range(m):
+            width = hi[i] - lo[i] + 1
+            grid = sorted({max(lo[i] + (j * width) // branch - 1, lo[i])
+                           for j in range(1, branch + 1)} | {hi[i]})
+            grid = (grid + [hi[i]] * branch)[:branch]
+            grids.append(grid)
+        # element-wise np.uint64(): list->array conversion routes through
+        # C long and overflows for values in [2^63, 2^64)
+        pivots_np = np.array([[np.uint64(v) for v in g] for g in grids],
+                             dtype=np.uint64).astype(ukdt)
+        pivots_flat = jnp.asarray(pivots_np.reshape(-1))
+        carry = _put_carry(np.zeros((p, m * branch), np.int64), comm)
+        ncarry = _put_carry(np.zeros((p,), np.int64), comm)
+        carries = [carry, ncarry]
+        for chunk in _chunk_iter(source, rows_per_chunk):
+            if chunk.split != 0:
+                raise ValueError("stream_quantile needs split-0 chunks")
+            n_chunk = int(chunk.shape[0])
+            cphys = tuple(chunk.larray.shape)
+            key = ("data.stream.quantile", cphys, str(jdt), n_chunk,
+                   m * branch, col, comm.cache_key)
+
+            def eager(ca, nc, chb, pv, _n=n_chunk):
+                vb = _col(chb[:_n], col)
+                uk = unsigned_key(vb)
+                cnt = jnp.sum(uk[None, :] <= pv[:, None],
+                              axis=1).astype(jnp.int64)
+                nn = (jnp.sum(jnp.isnan(vb)).astype(jnp.int64)
+                      if floating else jnp.zeros((), jnp.int64))
+                return ca.at[0].add(cnt), nc.at[0].add(nn)
+
+            carries = _fold(
+                key,
+                lambda qk, ck, hk, _n=n_chunk, _ph=cphys:
+                    _build_stream_quantile(_ph, jdt, _n, branch, col,
+                                           comm, ukdt, umax),
+                carries, chunk.larray, (pivots_flat,), eager, 2)
+        counts = np.asarray(carries[0]).sum(axis=0).reshape(m, branch)
+        if nan_total is None:
+            nan_total = int(np.asarray(carries[1]).sum())
+        for i in range(m):
+            if lo[i] >= hi[i]:
+                continue
+            row, grid = counts[i], grids[i]
+            j = int(np.argmax(row >= ranks[i] + 1))
+            hi[i] = grid[j]
+            lo[i] = (grid[j - 1] + 1) if j > 0 else lo[i]
+        passes += 1
+        if passes > bits:  # defensive: can't exceed one pass per bit
+            raise RuntimeError("stream_quantile failed to converge")
+    vals = np.asarray(decode_key(
+        jnp.asarray(np.array([np.uint64(v) for v in lo],
+                             dtype=np.uint64).astype(ukdt)), jdt))
+    by_rank = {r: vals[i] for i, r in enumerate(ranks)}
+    ft = np.float64 if jax.config.jax_enable_x64 else np.float32
+    out = []
+    for f, lo_r, hi_r, _ in targets:
+        if interpolation == "lower":
+            r = ft(by_rank[lo_r])
+        elif interpolation == "higher":
+            r = ft(by_rank[hi_r])
+        elif interpolation == "nearest":
+            r = ft(by_rank[int(np.round(f))])
+        elif interpolation == "midpoint":
+            r = (ft(by_rank[lo_r]) + ft(by_rank[hi_r])) / 2
+        else:
+            a = ft(by_rank[lo_r])
+            r = a if hi_r == lo_r else \
+                a + (ft(by_rank[hi_r]) - a) * ft(f - lo_r)
+        if floating and nan_total:
+            r = ft(np.nan)
+        out.append(r)
+    if q_np.ndim == 0:
+        return float(out[0]) if not np.isnan(out[0]) else float("nan")
+    return np.asarray(out, ft).reshape(q_np.shape)

@@ -160,7 +160,10 @@ def runtime_stats() -> dict:
     ``"op_engine"`` carries the alignment counter plus the fusion engine's
     figures (``"fusion"`` is exactly :func:`heat_tpu.core.fusion.stats`:
     enabled flag, flush count, fused-op count, their ops-per-flush ratio,
-    and the fusion program cache); ``"faults"`` is exactly
+    and the fusion program cache); ``"data_engine"`` is exactly
+    :func:`heat_tpu.data.engine.stats` (enabled flag, dispatch/fallback/
+    per-op counters and the data-engine program cache —
+    ``doc/data_engine.md``); ``"faults"`` is exactly
     :func:`heat_tpu.utils.faults.stats` (armed plan + per-site fire
     counts — empty on a production run; ``doc/robustness.md``);
     ``"counters"`` is the full process-wide
@@ -170,6 +173,7 @@ def runtime_stats() -> dict:
     fallback counters in the robustness matrix).
     """
     from ..core import fusion, resharding
+    from ..data import engine as _data_engine
     from ..utils import faults as _faults
     from ..utils import metrics as _pm
 
@@ -240,6 +244,9 @@ def runtime_stats() -> dict:
             "align_resplits": int(counters.get("op_engine.align_resplits", 0)),
             "fusion": fusion.stats(),
         },
+        # tape-compiled data engine (heat_tpu.data): dispatch/fallback
+        # counters + its program cache — see doc/data_engine.md
+        "data_engine": _data_engine.stats(),
         # fault-injection surface (heat_tpu.utils.faults): armed plan +
         # per-site fire counts — all zeros/empty on a production run
         "faults": _faults.stats(),

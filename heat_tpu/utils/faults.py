@@ -152,6 +152,19 @@ SITES: Dict[str, tuple] = {
         "(serve/decode.py::DecodeEngine._dispatch_step) — that step "
         "degrades to the eager per-slot path with every future intact, "
         "counted in serve.decode_fallbacks"),
+    # distributed data engine (data/engine.py, data/streaming.py)
+    "data.exchange.dispatch": (
+        FaultInjected,
+        "compiled data-engine exchange dispatch (data/engine.py::"
+        "engine_call: the groupby/top-k/order-statistic/join programs) — "
+        "degrades to the eager per-op reference path with identical "
+        "results, counted in data_engine.exchange_fallbacks"),
+    "data.stream.carry": (
+        FaultInjected,
+        "streaming carry-fold dispatch (data/streaming.py: the donated "
+        "chunk-fold executables) — that chunk degrades to the eager "
+        "accumulation with identical results, counted in "
+        "data_engine.stream_fallbacks"),
     # shared program cache (utils/program_cache.py)
     "program_cache.compile": (
         FaultInjected,

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""User-style drive for the tape-compiled data engine (ISSUE 17).
+
+Exercises `heat_tpu.data` the way an analytics user would — uneven
+shards, every aggregate, special floats, exact quantiles against the
+sort path, joins, out-of-core streaming, the escape hatch, fault
+injection, observability — and checks every contract the PR claims.
+~18 checks, ~1 min.
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python scripts/data_drive_r17.py
+"""
+
+import sys
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu import data
+from heat_tpu.utils import faults
+
+PASS = []
+
+
+def check(name, ok):
+    PASS.append(bool(ok))
+    print(f"  [{'PASS' if ok else 'FAIL'}] {name}", flush=True)
+
+
+def main() -> int:
+    p = ht.get_comm().size
+    print(f"data drive: {p} devices")
+    rng = np.random.default_rng(17)
+
+    # 1: minimum slice — exact integer reduction over the mesh
+    check("arange(1000).sum() exact",
+          int(ht.arange(1000, split=0).sum().numpy()) == 499500)
+
+    # 2-4: groupby, UNEVEN rows (10007 % 8 != 0), every aggregate
+    N, G = 10_007, 23
+    keys = rng.integers(0, G, N).astype(np.int64)
+    vals = rng.standard_normal(N)
+    k, v = ht.array(keys, split=0), ht.array(vals, split=0)
+    gb = data.groupby(k, G)
+    ok = np.allclose(gb.sum(v).numpy(),
+                     np.bincount(keys, weights=vals, minlength=G),
+                     rtol=1e-12, atol=1e-12)
+    ok &= np.array_equal(gb.count().numpy(),
+                         np.bincount(keys, minlength=G))
+    check("groupby sum+count on uneven 10007 rows", ok)
+    mref = np.full(G, np.inf)
+    np.minimum.at(mref, keys, vals)
+    check("groupby min bitwise",
+          np.array_equal(gb.min(v).numpy(), mref))
+    cnt = np.bincount(keys, minlength=G)
+    check("groupby mean", np.allclose(
+        gb.mean(v).numpy(),
+        np.bincount(keys, weights=vals, minlength=G) / cnt, rtol=1e-12))
+
+    # 5-6: top-k bitwise incl. special floats (NaN above inf, like sort)
+    order = np.argsort(-vals, kind="stable")[:16]
+    tv, ti = data.topk(v, 16)
+    check("topk values+indices bitwise the stable argsort",
+          np.array_equal(tv.numpy(), vals[order])
+          and np.array_equal(ti.numpy(), order))
+    sp = vals.copy()
+    sp[7], sp[4999], sp[10_000] = np.inf, -np.inf, np.nan
+    tvs, tis = data.topk(ht.array(sp, split=0), 3)
+    check("topk special floats: NaN > inf ordering",
+          np.isnan(tvs.numpy()[0]) and tvs.numpy()[1] == np.inf
+          and int(tis.numpy()[1]) == 7)
+
+    # 7-9: exact order statistics vs the sort path, every interpolation
+    q = [0.0, 12.5, 37.3, 50.0, 99.1, 100.0]
+    ok = True
+    for interp in ("linear", "lower", "higher", "nearest", "midpoint"):
+        eng = ht.percentile(v, q, interpolation=interp).numpy()
+        with data.override(False):
+            srt = ht.percentile(v, q, interpolation=interp).numpy()
+        ok &= np.array_equal(eng, srt)
+    check("percentile == sort path EXACTLY, all 5 interpolations", ok)
+    check("median matches numpy", np.allclose(
+        float(np.asarray(ht.median(v).numpy())), np.median(vals),
+        rtol=1e-12))
+    nan_in = vals.copy()
+    nan_in[123] = np.nan
+    check("NaN input poisons the percentile (numpy semantics)",
+          np.isnan(float(np.asarray(
+              ht.percentile(ht.array(nan_in, split=0), 50.0).numpy()))))
+
+    # 10: inner join vs a dict reference, uneven left, unique build keys
+    # (the right side is the build side — its keys must be unique)
+    lk = rng.integers(0, 40, 1003).astype(np.int64)
+    rk = rng.permutation(40)[:29].astype(np.int64)
+    lv = rng.standard_normal(1003)
+    rv = rng.standard_normal(29)
+    jk, jl, jr = (x.numpy() for x in data.join(
+        ht.array(lk, split=0), ht.array(lv, split=0),
+        ht.array(rk, split=0), ht.array(rv, split=0)))
+    rdict = dict(zip(rk.tolist(), rv.tolist()))
+    want = sorted((int(a), float(lv[i]), rdict[int(a)])
+                  for i, a in enumerate(lk) if int(a) in rdict)
+    got = sorted(zip(jk.tolist(), jl.tolist(), jr.tolist()))
+    check("join == dict reference (1003 probe x 29 unique build)",
+          got == want)
+
+    # 11-12: steady state — a repeat burst with DIFFERENT quantiles
+    # compiles NOTHING; zero fallbacks anywhere
+    def burst(qq):
+        data.groupby(k, G).sum(v).numpy()
+        data.topk(v, 16)[0].numpy()
+        ht.percentile(v, qq).numpy()
+
+    burst([5.0, 95.0])
+    m0 = data.engine.program_cache().stats()["misses"]
+    burst([33.0, 66.0])
+    st = data.stats()
+    check("repeat burst at new q: ZERO cache misses",
+          data.engine.program_cache().stats()["misses"] == m0)
+    check("zero fallbacks across the whole drive so far",
+          st["exchange_fallbacks"] == 0 and st["stream_fallbacks"] == 0)
+
+    # 13-15: out-of-core streaming over a chunked source
+    tab = np.stack([keys.astype(np.float64), vals], axis=1)
+
+    def chunks():
+        return iter(ht.array(tab[i:i + 1024], split=0)
+                    for i in range(0, N, 1024))
+
+    check("stream_groupby == in-memory groupby", np.allclose(
+        data.stream_groupby(chunks, G, "sum").numpy(),
+        np.bincount(keys, weights=vals, minlength=G), rtol=1e-12))
+    sv, si = data.stream_topk(
+        lambda: iter(ht.array(vals[i:i + 1024], split=0)
+                     for i in range(0, N, 1024)), 16)
+    check("stream_topk BITWISE the in-memory topk",
+          np.array_equal(sv.numpy(), tv.numpy())
+          and np.array_equal(si.numpy(), ti.numpy()))
+    sq = np.asarray(data.stream_quantile(
+        lambda: iter(ht.array(vals[i:i + 1024], split=0)
+                     for i in range(0, N, 1024)),
+        [0.25, 0.5, 0.75], interpolation="nearest"))
+    check("stream_quantile bit-equal ht.percentile (nearest)",
+          np.array_equal(sq, ht.percentile(
+              v, [25.0, 50.0, 75.0], interpolation="nearest").numpy()))
+
+    # 16: escape hatch — override(False) gives identical results and
+    # routes nothing through the engine
+    d0 = data.stats()["dispatches"]
+    with data.override(False):
+        g_eager = data.groupby(k, G).sum(v).numpy()
+    check("override(False): identical result, zero engine dispatches",
+          np.allclose(g_eager, gb.sum(v).numpy(), rtol=1e-15)
+          and data.stats()["dispatches"] == d0 + 1)  # the re-run above
+
+    # 17: chaos — one injected dispatch fault degrades to eager with
+    # the SAME result and exactly one fallback counter tick
+    f0 = data.stats()["exchange_fallbacks"]
+    faults.arm(faults.parse_spec("data.exchange.dispatch=nth:1"))
+    try:
+        g_faulted = data.groupby(k, G).sum(v).numpy()
+    finally:
+        faults.disarm()
+    st = data.stats()
+    check("injected fault: eager fallback, equal payload, counter +1",
+          np.allclose(g_faulted, g_eager, rtol=1e-15)
+          and st["exchange_fallbacks"] == f0 + 1)
+
+    # 18: observability — the pinned runtime_stats surface
+    rt = ht.runtime_stats()["data_engine"]
+    check("runtime_stats()['data_engine'] pinned shape + live counters",
+          set(rt) == {"enabled", "dispatches", "exchange_fallbacks",
+                      "stream_chunks", "stream_fallbacks", "groupby_calls",
+                      "topk_calls", "quantile_calls", "join_calls",
+                      "program_cache"}
+          and rt["dispatches"] > 0 and rt["stream_chunks"] > 0
+          and rt["join_calls"] >= 1)
+
+    n_ok = sum(PASS)
+    print(f"{n_ok}/{len(PASS)} checks passed"
+          + ("  ALL PASS" if all(PASS) else "  FAILURES"))
+    return 0 if all(PASS) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

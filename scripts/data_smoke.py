@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Data-engine smoke for the CI ladder (ISSUE 17).
+
+Drives the tape-compiled data engine end to end over the launch mesh
+(the ladder runs it at 4 virtual CPU devices) and checks the engine
+contract:
+
+* groupby-aggregate equals the numpy reference; top-k values AND
+  indices bitwise-equal to the gathered argsort; the engine-routed
+  ``ht.percentile`` equals both the merge-split sort path (exactly) and
+  numpy;
+* the streaming folds (groupby / top-k / multi-pass quantile) over a
+  chunked out-of-core pass agree with the in-memory results;
+* ZERO steady-state program-cache misses on the second pass at the same
+  structural signatures, and ZERO eager fallbacks anywhere;
+* ``ht.runtime_stats()["data_engine"]`` present with the pinned shape.
+
+Prints ONE JSON line; exit 1 on any violation (the ladder fails the
+round).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+        python scripts/data_smoke.py
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    import heat_tpu as ht
+    from heat_tpu import data
+
+    n_dev = ht.get_comm().size
+    rng = np.random.default_rng(0)
+    N, G, K = 100_000, 32, 8
+    keys = rng.integers(0, G, N).astype(np.int64)
+    vals = rng.standard_normal(N)
+
+    k = ht.array(keys, split=0)
+    v = ht.array(vals, split=0)
+
+    def burst():
+        g = data.groupby(k, G).sum(v).numpy()
+        tv, ti = data.topk(v, K)
+        p = ht.percentile(v, [7.0, 50.0, 93.0]).numpy()
+        return g, tv.numpy(), ti.numpy(), p
+
+    gsum, tvn, tin, pct = burst()  # warm pass compiles everything once
+    misses0 = data.engine.program_cache().stats()["misses"]
+    g2, tv2, ti2, p2 = burst()
+    steady_misses = data.engine.program_cache().stats()["misses"] - misses0
+
+    gref = np.bincount(keys, weights=vals, minlength=G)
+    order = np.argsort(-vals, kind="stable")[:K]
+    with data.override(False):
+        pct_sort = ht.percentile(v, [7.0, 50.0, 93.0]).numpy()
+
+    # streaming pass: the same table chunked out-of-core
+    tab = np.stack([keys.astype(np.float64), vals], axis=1)
+    rows = 1 << 14
+
+    def chunks():
+        return iter(ht.array(tab[i:i + rows], split=0)
+                    for i in range(0, N, rows))
+
+    sg = data.stream_groupby(chunks, G, "sum").numpy()
+    sv, sp = data.stream_topk(lambda: iter(
+        ht.array(vals[i:i + rows], split=0) for i in range(0, N, rows)), K)
+    sq = data.stream_quantile(lambda: iter(
+        ht.array(vals[i:i + rows], split=0) for i in range(0, N, rows)),
+        [0.07, 0.5, 0.93])
+
+    st = data.stats()
+    rt = ht.runtime_stats()
+
+    verdicts = {
+        "groupby_matches_numpy": bool(
+            np.allclose(gsum, gref, rtol=1e-10, atol=1e-8)),
+        "topk_bitwise": bool(np.array_equal(tin, order)
+                             and np.array_equal(tvn, vals[order])),
+        "percentile_equals_sort_path": bool(
+            np.array_equal(pct, pct_sort)
+            and np.allclose(pct, np.percentile(vals, [7.0, 50.0, 93.0]),
+                            rtol=1e-9)),
+        "second_pass_deterministic": bool(
+            np.array_equal(gsum, g2) and np.array_equal(tvn, tv2)
+            and np.array_equal(tin, ti2) and np.array_equal(pct, p2)),
+        "zero_steady_misses": steady_misses == 0,
+        "stream_groupby_matches": bool(
+            np.allclose(sg, gref, rtol=1e-10, atol=1e-8)),
+        "stream_topk_bitwise": bool(
+            np.array_equal(sp.numpy(), order)
+            and np.array_equal(sv.numpy(), vals[order])),
+        "stream_quantile_matches": bool(
+            np.allclose(sq, np.percentile(vals, [7.0, 50.0, 93.0]),
+                        rtol=1e-9)),
+        "no_fallbacks": (st["exchange_fallbacks"] == 0
+                         and st["stream_fallbacks"] == 0),
+        "stats_shape": (set(rt["data_engine"]) == {
+            "enabled", "dispatches", "exchange_fallbacks", "stream_chunks",
+            "stream_fallbacks", "groupby_calls", "topk_calls",
+            "quantile_calls", "join_calls", "program_cache"}
+            and st["dispatches"] > 0 and st["stream_chunks"] > 0),
+    }
+    record = {
+        "devices": n_dev,
+        "rows": N,
+        "groups": G,
+        "k": K,
+        "steady_misses": steady_misses,
+        "dispatches": st["dispatches"],
+        "stream_chunks": st["stream_chunks"],
+        "program_cache": st["program_cache"],
+        "verdicts": verdicts,
+        "ok": all(verdicts.values()),
+    }
+    print(json.dumps(record), flush=True)
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -422,6 +422,13 @@ def main():
     ap.add_argument("--no-decode-smoke", dest="decode_smoke",
                     action="store_false",
                     help="skip the decode engine smoke step")
+    ap.add_argument("--data-smoke", dest="data_smoke", action="store_true",
+                    default=True,
+                    help="run the tape-compiled data-engine smoke "
+                         "(default on)")
+    ap.add_argument("--no-data-smoke", dest="data_smoke",
+                    action="store_false",
+                    help="skip the data engine smoke step")
     ap.add_argument("--serve-soak", dest="serve_soak", action="store_true",
                     default=True,
                     help="run the open-loop overload soak with "
@@ -517,6 +524,32 @@ def main():
             artifact["decode_smoke"] = {"error": "decode smoke exceeded 600s"}
             decode_bad = True
         print(json.dumps({"decode_smoke_ok": not decode_bad}), flush=True)
+
+    data_bad = False
+    if args.data_smoke and not args.examples_only:
+        # data-engine gate (ISSUE 17): groupby/top-k/percentile + the
+        # streaming folds — numpy parity, percentile == sort path, zero
+        # steady-state misses, zero fallbacks (scripts/data_smoke.py)
+        print("=== data engine smoke (4 devices) ===", flush=True)
+        env = _env(4)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["PYTHONPATH"] = _REPO
+        try:
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(_REPO, "scripts", "data_smoke.py")],
+                env=env, capture_output=True, text=True, timeout=600.0,
+                cwd=_REPO)
+            line = next((l for l in reversed(out.stdout.splitlines())
+                         if l.startswith("{")), None)
+            artifact["data_smoke"] = (
+                json.loads(line) if line
+                else {"error": (out.stderr or "no output").strip()[-300:]})
+            data_bad = out.returncode != 0
+        except subprocess.TimeoutExpired:
+            artifact["data_smoke"] = {"error": "data smoke exceeded 600s"}
+            data_bad = True
+        print(json.dumps({"data_smoke_ok": not data_bad}), flush=True)
 
     soak_bad = False
     if args.serve_soak and not args.examples_only:
@@ -649,9 +682,9 @@ def main():
     print(f"wrote {args.out}")
     bad = ([r for r in ladder if r.get("rc") != 0]
            + [r for r in ex if r.get("rc") != 0])
-    sys.exit(1 if bad or audit_bad or serve_bad or decode_bad or soak_bad
-             or fusion_bad or quant_bad or chunk_bad or hier_bad or fit_bad
-             or chaos_bad else 0)
+    sys.exit(1 if bad or audit_bad or serve_bad or decode_bad or data_bad
+             or soak_bad or fusion_bad or quant_bad or chunk_bad or hier_bad
+             or fit_bad or chaos_bad else 0)
 
 
 if __name__ == "__main__":

@@ -142,6 +142,17 @@ def pytest_runtest_teardown(item, nextitem):
             "serve_decode_steps": int(c.get("serve.decode_steps", 0)),
             "serve_decode_fallbacks": int(
                 c.get("serve.decode_fallbacks", 0)),
+            # tape-compiled data engine (the --data-smoke ladder stage
+            # reads these: which tests dispatched compiled exchange /
+            # carry-fold programs, and whether any degraded to eager)
+            "data_engine_dispatches": int(
+                c.get("data_engine.dispatches", 0)),
+            "data_engine_exchange_fallbacks": int(
+                c.get("data_engine.exchange_fallbacks", 0)),
+            "data_engine_stream_chunks": int(
+                c.get("data_engine.stream_chunks", 0)),
+            "data_engine_stream_fallbacks": int(
+                c.get("data_engine.stream_fallbacks", 0)),
             "zero_fills": int(c.get("op_engine.zero_fills", 0)),
             "fusion_ops": int(c.get("op_engine.fusion_ops", 0)),
             "fusion_program_compiles": int(

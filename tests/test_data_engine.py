@@ -1,0 +1,604 @@
+"""The tape-compiled distributed data engine (ISSUE 17).
+
+The contract under test (``doc/data_engine.md``):
+
+* every primitive — groupby-aggregate, top-k, exact order statistics,
+  inner hash join, and the streaming folds — produces results EQUAL to
+  its eager reference (bitwise for selected elements and integer
+  aggregates, few-ulp for float accumulations whose summation order the
+  exchange legitimately reassociates), across aggregation ops × dtypes ×
+  uneven logical sizes, at any device count (the ladder re-runs this
+  module at 1/2/4/8);
+* the compiled exchanges match their declared collective plans in the
+  optimized HLO: groupby is exactly ONE communicating all-reduce
+  (sum/mean/count ride one packed psum, min/max one pmin/pmax), top-k
+  and the order-statistic bisection move ZERO all-gathers of the data
+  axis, the join rides all-to-all/collective-permute only, and the
+  streaming chunk folds emit ZERO communicating collectives;
+* steady state recompiles NOTHING: repeated calls at the same structural
+  signature are pure program-cache hits (ranks/pivots/offsets are traced
+  inputs, so a different percentile ``q`` at the same rank count reuses
+  the program);
+* ``ht.percentile`` / ``ht.median`` route through the engine and return
+  results EQUAL to the merge-split sort path (regression-pinned exactly,
+  per interpolation, NaN poisoning included), falling back eager under
+  ``HEAT_TPU_DATA_ENGINE=0`` / :func:`heat_tpu.data.override` or on
+  non-translatable layouts.
+
+Module teardown drops every cached program (the PR 9 executable-budget
+discipline: share compiles within the module, release them after).
+"""
+
+import gc
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu import data
+from heat_tpu.core import fusion
+from heat_tpu.data import engine, ops, streaming
+from heat_tpu.utils import hlo_audit
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _drop_data_programs():
+    yield
+    data.reset()
+    gc.collect()
+
+
+def _moving(hlo):
+    return {k: v for k, v
+            in hlo_audit.communicating_collective_stats(hlo).items()
+            if v["count"]}
+
+
+def _wire_keys():
+    return fusion.quant_key(), fusion.chunk_key(), fusion.hier_key()
+
+
+# --------------------------------------------------------------------- #
+# total-order key encoding                                              #
+# --------------------------------------------------------------------- #
+class TestKeyEncoding:
+    @pytest.mark.parametrize("dtype", ["float64", "float32", "int64",
+                                       "int32", "int8", "uint32"])
+    def test_round_trip_bit_exact(self, dtype):
+        rng = np.random.default_rng(3)
+        if dtype.startswith("float"):
+            x = rng.standard_normal(64).astype(dtype)
+            x[:6] = [0.0, -0.0, np.inf, -np.inf, 1e-300, -1e-300]
+        else:
+            info = np.iinfo(dtype)
+            x = rng.integers(info.min, info.max, 64,
+                             dtype=dtype, endpoint=True)
+            x[:3] = [info.min, 0, info.max]
+        back = np.asarray(ops.decode_key(ops.unsigned_key(jnp.asarray(x)),
+                                         jnp.dtype(dtype)))
+        # -0.0 round-trips bit-exactly too
+        np.testing.assert_array_equal(back.view(np.uint8 if x.itemsize == 1
+                                                else f"uint{x.itemsize * 8}"),
+                                      x.view(np.uint8 if x.itemsize == 1
+                                             else f"uint{x.itemsize * 8}"))
+
+    def test_unsigned_order_matches_total_order(self):
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal(128)
+        x[:5] = [np.inf, -np.inf, 0.0, -0.0, np.nan]
+        uk = np.asarray(ops.unsigned_key(jnp.asarray(x)))
+        by_key = x[np.argsort(uk, kind="stable")]
+        # numpy's sort is the same total order up to the -0.0/+0.0 tie
+        # and puts NaN last as well
+        ref = np.sort(x)
+        assert np.isnan(by_key[-1]) and np.isnan(ref[-1])
+        np.testing.assert_array_equal(by_key[:-1], ref[:-1])
+
+    def test_nan_key_below_umax(self):
+        """The order-statistic padding key (umax) must sit strictly above
+        the canonical NaN key, or padding would alias real data."""
+        for dt in (jnp.float32, jnp.float64):
+            nk = int(np.asarray(ops.unsigned_key(
+                jnp.asarray([np.nan], dt))).item())
+            bits = ops._key_bits(dt)
+            assert nk < (1 << bits) - 1
+
+
+# --------------------------------------------------------------------- #
+# groupby-aggregate                                                     #
+# --------------------------------------------------------------------- #
+def _np_groupby(k, v, G, op):
+    out = []
+    for g in range(G):
+        sel = v[k == g] if v is not None else None
+        if op == "count":
+            out.append(np.sum(k == g))
+        elif op == "sum":
+            out.append(sel.sum(axis=0))
+        elif op == "mean":
+            with np.errstate(invalid="ignore", divide="ignore"):
+                out.append(sel.astype(np.float64).sum(axis=0) / len(sel))
+        elif op == "min":
+            out.append(sel.min(axis=0) if len(sel) else
+                       (np.inf if v.dtype.kind == "f"
+                        else np.iinfo(v.dtype).max))
+        else:
+            out.append(sel.max(axis=0) if len(sel) else
+                       (-np.inf if v.dtype.kind == "f"
+                        else np.iinfo(v.dtype).min))
+    return np.asarray(out)
+
+
+class TestGroupby:
+    @pytest.mark.parametrize("op", ops.AGGS)
+    @pytest.mark.parametrize("n", [37, 64])
+    def test_matches_numpy_float64(self, op, n):
+        rng = np.random.default_rng(11)
+        G = 5
+        # group 4 left EMPTY: sum 0, count 0, mean NaN, min/max identity
+        k = rng.integers(0, 4, n)
+        v = rng.standard_normal(n)
+        res = data.groupby_agg(ht.array(k, split=0), G, op,
+                               ht.array(v, split=0) if op != "count"
+                               else None)
+        assert res.split is None and res.shape[0] == G
+        ref = _np_groupby(k, None if op == "count" else v, G, op)
+        np.testing.assert_allclose(res.numpy(), ref, rtol=1e-12, atol=0)
+
+    @pytest.mark.parametrize("op", ["sum", "count", "min", "max"])
+    def test_integer_bitwise(self, op):
+        rng = np.random.default_rng(12)
+        n, G = 53, 4
+        k = rng.integers(0, G, n).astype(np.int64)
+        v = rng.integers(-1000, 1000, n).astype(np.int64)
+        res = data.groupby_agg(ht.array(k, split=0), G, op,
+                               None if op == "count"
+                               else ht.array(v, split=0))
+        ref = _np_groupby(k, None if op == "count" else v, G, op)
+        np.testing.assert_array_equal(res.numpy(), ref)
+
+    def test_2d_values_and_out_of_range_keys_dropped(self):
+        rng = np.random.default_rng(13)
+        n, G, d = 41, 3, 4
+        k = rng.integers(-2, G + 2, n)  # out-of-range rows must be dropped
+        v = rng.standard_normal((n, d))
+        res = data.groupby(ht.array(k, split=0), G).sum(
+            ht.array(v, split=0))
+        assert res.shape == (G, d)
+        sel = (k >= 0) & (k < G)
+        ref = _np_groupby(k[sel], v[sel], G, "sum")
+        np.testing.assert_allclose(res.numpy(), ref, rtol=1e-12, atol=0)
+
+    def test_engine_off_matches_engine_on(self):
+        rng = np.random.default_rng(14)
+        k = rng.integers(0, 6, 45)
+        v = rng.standard_normal(45).astype(np.float32)
+        kk, vv = ht.array(k, split=0), ht.array(v, split=0)
+        on = data.groupby(kk, 6).mean(vv).numpy()
+        with data.override(False):
+            off = data.groupby(kk, 6).mean(vv).numpy()
+        np.testing.assert_allclose(on, off, rtol=1e-6, atol=0)
+
+    def test_rejects_bad_inputs(self):
+        k = ht.array(np.zeros(8, np.int64), split=0)
+        v = ht.array(np.zeros(8), split=0)
+        with pytest.raises(ValueError, match="unknown groupby"):
+            data.groupby_agg(k, 2, "median", v)
+        with pytest.raises(TypeError, match="integers"):
+            data.groupby_agg(v, 2, "count")
+        with pytest.raises(ValueError, match="needs values"):
+            data.groupby_agg(k, 2, "sum")
+        with pytest.raises(ValueError, match="row-aligned"):
+            data.groupby_agg(k, 2, "sum",
+                             ht.array(np.zeros(6), split=0))
+
+
+# --------------------------------------------------------------------- #
+# top-k                                                                 #
+# --------------------------------------------------------------------- #
+class TestTopK:
+    @pytest.mark.parametrize("largest", [True, False])
+    @pytest.mark.parametrize("dtype", ["float64", "int32"])
+    def test_values_and_indices_match_reference(self, largest, dtype):
+        rng = np.random.default_rng(21)
+        n, k = 59, 3
+        if dtype == "float64":
+            x = rng.standard_normal(n)
+            x[7], x[11] = x[3], x[3]  # duplicates: tie-break by position
+        else:
+            x = rng.integers(-50, 50, n).astype(dtype)
+        tv, ti = data.topk(ht.array(x, split=0), k, largest=largest)
+        sel = np.asarray(ops.unsigned_key(jnp.asarray(x)))
+        if not largest:
+            sel = ~sel
+        order = np.lexsort((np.arange(n), np.invert(sel)))[:k]
+        np.testing.assert_array_equal(ti.numpy(), order)
+        np.testing.assert_array_equal(tv.numpy(), x[order])
+
+    def test_special_floats_total_order(self):
+        x = np.array([1.0, np.nan, -np.inf, np.inf, -0.0, 0.0, 2.5, -1.0])
+        tv, ti = data.topk(ht.array(x, split=0), 3)
+        # NaN sorts greatest, then +inf, then the largest finite
+        assert np.isnan(tv.numpy()[0])
+        np.testing.assert_array_equal(tv.numpy()[1:], [np.inf, 2.5])
+        bv, bi = data.topk(ht.array(x, split=0), 2, largest=False)
+        np.testing.assert_array_equal(bv.numpy(), [-np.inf, -1.0])
+
+    def test_k_beyond_shard_falls_back_eager(self):
+        """k > per-device chunk is out of the compiled plan's contract;
+        the call must still answer correctly via the eager path."""
+        rng = np.random.default_rng(22)
+        n = 4 * ht.get_comm().size
+        x = rng.standard_normal(n)
+        k = n - 1
+        tv, _ = data.topk(ht.array(x, split=0), k)
+        np.testing.assert_array_equal(tv.numpy(), np.sort(x)[::-1][:k])
+
+    def test_engine_off_matches_engine_on(self):
+        rng = np.random.default_rng(23)
+        x = ht.array(rng.standard_normal(47), split=0)
+        on_v, on_i = data.topk(x, 4)
+        with data.override(False):
+            off_v, off_i = data.topk(x, 4)
+        np.testing.assert_array_equal(on_v.numpy(), off_v.numpy())
+        np.testing.assert_array_equal(on_i.numpy(), off_i.numpy())
+
+
+# --------------------------------------------------------------------- #
+# order statistics / the percentile route                               #
+# --------------------------------------------------------------------- #
+class TestPercentileRoute:
+    Q = [0.0, 12.5, 37.3, 50.0, 99.1, 100.0]
+
+    @pytest.mark.parametrize("dtype", ["float64", "float32", "int64"])
+    def test_engine_equals_sort_path_exactly(self, dtype):
+        """The regression pin: the bisection route must return the SAME
+        floats the merge-split sort path returned before this PR."""
+        rng = np.random.default_rng(31)
+        if dtype.startswith("float"):
+            x = rng.standard_normal(67).astype(dtype)
+        else:
+            x = rng.integers(-999, 999, 67).astype(dtype)
+        arr = ht.array(x, split=0)
+        via_engine = ht.percentile(arr, self.Q).numpy()
+        with data.override(False):
+            via_sort = ht.percentile(arr, self.Q).numpy()
+        np.testing.assert_array_equal(via_engine, via_sort)
+        np.testing.assert_allclose(via_engine, np.percentile(x, self.Q),
+                                   rtol=1e-6)
+
+    @pytest.mark.parametrize("interp", ["linear", "lower", "higher",
+                                        "nearest", "midpoint"])
+    def test_every_interpolation_pinned(self, interp):
+        rng = np.random.default_rng(32)
+        x = rng.standard_normal(38)
+        arr = ht.array(x, split=0)
+        got = ht.percentile(arr, [7.0, 61.0], interpolation=interp).numpy()
+        with data.override(False):
+            want = ht.percentile(arr, [7.0, 61.0],
+                                 interpolation=interp).numpy()
+        np.testing.assert_array_equal(got, want)
+
+    def test_median_and_nan_poisoning(self):
+        rng = np.random.default_rng(33)
+        x = rng.standard_normal(29)
+        arr = ht.array(x, split=0)
+        assert float(ht.median(arr).numpy()) == float(np.median(x))
+        x[17] = np.nan
+        assert np.isnan(ht.median(ht.array(x, split=0)).numpy())
+
+    def test_order_stats_exact_ranks(self):
+        rng = np.random.default_rng(34)
+        x = rng.standard_normal(43)
+        ranks = (0, 7, 21, 42)
+        got = np.asarray(data.order_stats(ht.array(x, split=0), ranks))
+        np.testing.assert_array_equal(got, np.sort(x)[list(ranks)])
+
+    def test_escape_hatch_env_subprocess(self):
+        """HEAT_TPU_DATA_ENGINE=0 disables the engine process-wide:
+        percentile stays on the sort path with identical results."""
+        code = (
+            "import numpy as np, heat_tpu as ht\n"
+            "from heat_tpu import data\n"
+            "assert not data.enabled()\n"
+            "assert data.stats()['enabled'] is False\n"
+            "rng = np.random.default_rng(31)\n"
+            "x = rng.standard_normal(67)\n"
+            "p = ht.percentile(ht.array(x, split=0), 37.3)\n"
+            "assert float(p.numpy()) == float(np.percentile(x, 37.3))\n"
+            "assert data.stats()['dispatches'] == 0\n"
+            "print('OK')\n")
+        env = dict(os.environ)
+        env.update(HEAT_TPU_DATA_ENGINE="0", JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=2")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-800:]
+        assert "OK" in out.stdout
+
+
+# --------------------------------------------------------------------- #
+# hash join                                                             #
+# --------------------------------------------------------------------- #
+class TestJoin:
+    def _case(self, n_l, n_r, seed, hit_rate=0.7):
+        rng = np.random.default_rng(seed)
+        rk = rng.permutation(4 * max(n_l, n_r))[:n_r].astype(np.int64)
+        lk = np.where(rng.random(n_l) < hit_rate,
+                      rng.choice(rk, n_l),
+                      rng.integers(10 ** 6, 2 * 10 ** 6, n_l)).astype(
+                          np.int64)
+        lv = rng.standard_normal(n_l)
+        rv = rng.standard_normal(n_r)
+        return lk, lv, rk, rv
+
+    @pytest.mark.parametrize("n_l,n_r", [(45, 23), (16, 64)])
+    def test_matches_eager_reference_exactly(self, n_l, n_r):
+        lk, lv, rk, rv = self._case(n_l, n_r, 41)
+        gk, gl, gr = data.join(
+            ht.array(lk, split=0), ht.array(lv, split=0),
+            ht.array(rk, split=0), ht.array(rv, split=0))
+        wk, wl, wr = ops._eager_join(lk, lv, rk, rv, n_l, n_r,
+                                     ht.get_comm().size)
+        assert gk.split == 0
+        np.testing.assert_array_equal(gk.numpy(), wk)
+        np.testing.assert_array_equal(gl.numpy(), wl)
+        np.testing.assert_array_equal(gr.numpy(), wr)
+
+    def test_join_semantics_against_plain_dict(self):
+        """Order-independent check against a hash-map join: same matched
+        multiset of (key, left value, right value) rows."""
+        lk, lv, rk, rv = self._case(37, 19, 42)
+        gk, gl, gr = data.join(
+            ht.array(lk, split=0), ht.array(lv, split=0),
+            ht.array(rk, split=0), ht.array(rv, split=0))
+        rmap = dict(zip(rk.tolist(), rv.tolist()))
+        want = sorted((int(k), float(v), rmap[int(k)])
+                      for k, v in zip(lk, lv) if int(k) in rmap)
+        got = sorted(zip(gk.numpy().tolist(), gl.numpy().tolist(),
+                         gr.numpy().tolist()))
+        assert got == want
+
+    def test_empty_result(self):
+        lk = np.arange(10, dtype=np.int64)
+        rk = np.arange(100, 110, dtype=np.int64)
+        gk, gl, gr = data.join(
+            ht.array(lk, split=0), ht.array(lk * 0.5, split=0),
+            ht.array(rk, split=0), ht.array(rk * 2.0, split=0))
+        assert gk.shape == (0,) and gl.shape == (0,) and gr.shape == (0,)
+
+    def test_engine_off_matches(self):
+        lk, lv, rk, rv = self._case(31, 17, 43)
+        args = (ht.array(lk, split=0), ht.array(lv, split=0),
+                ht.array(rk, split=0), ht.array(rv, split=0))
+        on = data.join(*args)
+        with data.override(False):
+            off = data.join(*args)
+        for a, b in zip(on, off):
+            np.testing.assert_array_equal(a.numpy(), b.numpy())
+
+    def test_rejects_float_keys(self):
+        v = ht.array(np.zeros(8), split=0)
+        with pytest.raises(TypeError, match="signed integers"):
+            data.join(v, v, v, v)
+
+
+# --------------------------------------------------------------------- #
+# HLO acceptance audits: the declared collective plans                  #
+# --------------------------------------------------------------------- #
+class TestCollectivePlans:
+    def _skip_singleton(self):
+        if ht.get_comm().size == 1:
+            pytest.skip("singleton mesh emits no communicating collective")
+
+    @pytest.mark.parametrize("op", ops.AGGS)
+    def test_groupby_is_exactly_one_all_reduce(self, op):
+        """The headline plan: shard-local partial aggregation + ONE
+        communicating collective, whatever the aggregation (mean's sums
+        AND counts share one dtype group in the packed psum)."""
+        self._skip_singleton()
+        comm = ht.get_comm()
+        n, G = 40, 5
+        k = ht.array(np.zeros(n, np.int64), split=0)
+        v = ht.array(np.zeros(n, np.float64), split=0)
+        qk, ck, hk = _wire_keys()
+        prog = ops._build_groupby(
+            tuple(k.larray.shape), jnp.dtype(jnp.int64),
+            None if op == "count" else tuple(v.larray.shape),
+            None if op == "count" else jnp.dtype(jnp.float64),
+            n, G, op, comm, qk, ck, hk)
+        args = (k.larray,) if op == "count" else (k.larray, v.larray)
+        moving = _moving(prog.lower(*args).compile().as_text())
+        assert set(moving) == {"all-reduce"}, (op, moving)
+        assert moving["all-reduce"]["count"] == 1, (op, moving)
+
+    def test_topk_moves_zero_all_gathers(self):
+        self._skip_singleton()
+        comm = ht.get_comm()
+        n, k = 40, 3
+        x = ht.array(np.zeros(n, np.float64), split=0)
+        prog = ops._build_topk(tuple(x.larray.shape), jnp.dtype(jnp.float64),
+                               n, k, True, comm)
+        moving = _moving(prog.lower(x.larray).compile().as_text())
+        assert "all-gather" not in moving, moving
+        assert "all-to-all" not in moving, moving
+        assert set(moving) <= {"all-reduce"}, moving
+        # the exchange payload is the k-sized candidate table, not the data
+        p = comm.size
+        assert moving["all-reduce"]["bytes"] == p * k * 8 * 2
+
+    def test_order_stats_moves_zero_all_gathers(self):
+        self._skip_singleton()
+        comm = ht.get_comm()
+        x = ht.array(np.zeros(40, np.float64), split=0)
+        prog = ops._build_order_stats(tuple(x.larray.shape),
+                                      jnp.dtype(jnp.float64), 0, (40,), 3,
+                                      comm)
+        rk = jnp.asarray([0, 10, 39], jnp.int64)
+        moving = _moving(prog.lower(x.larray, rk).compile().as_text())
+        assert "all-gather" not in moving, moving
+        assert "all-to-all" not in moving, moving
+
+    def test_join_rides_all_to_all_only(self):
+        self._skip_singleton()
+        comm = ht.get_comm()
+        n_l, n_r = 32, 16
+        lk = ht.array(np.zeros(n_l, np.int64), split=0)
+        lv = ht.array(np.zeros(n_l, np.float64), split=0)
+        rk = ht.array(np.zeros(n_r, np.int64), split=0)
+        rv = ht.array(np.zeros(n_r, np.float64), split=0)
+        prog = ops._build_join_probe(
+            tuple(lk.larray.shape), jnp.dtype(jnp.int64),
+            jnp.dtype(jnp.float64), tuple(rk.larray.shape),
+            jnp.dtype(jnp.int64), jnp.dtype(jnp.float64), n_l, n_r, comm)
+        moving = _moving(prog.lower(lk.larray, lv.larray, rk.larray,
+                                    rv.larray).compile().as_text())
+        assert "all-gather" not in moving, moving
+        assert "all-to-all" in moving, moving
+
+    def test_streaming_folds_move_zero_collectives(self):
+        """Chunk folding is shard-local by design: the cross-device
+        combine happens once at finalize, on the host."""
+        comm = ht.get_comm()
+        p = comm.size
+        n, G = 8 * p, 4
+        chunk = ht.array(np.zeros((n, 2)), split=0)
+        prog = streaming._build_stream_groupby(
+            ((p, G),), (jnp.dtype(jnp.float64),),
+            tuple(chunk.larray.shape), jnp.dtype(jnp.float64), n, G,
+            "sum", 0, 1, comm)
+        carry = streaming._put_carry(np.zeros((p, G)), comm)
+        hlo = prog.lower(carry, chunk.larray).compile().as_text()
+        assert _moving(hlo) == {}, _moving(hlo)
+
+
+# --------------------------------------------------------------------- #
+# steady state: zero recompiles                                         #
+# --------------------------------------------------------------------- #
+class TestSteadyState:
+    def test_repeat_calls_are_pure_cache_hits(self):
+        rng = np.random.default_rng(51)
+        k = ht.array(rng.integers(0, 4, 37), split=0)
+        v = ht.array(rng.standard_normal(37), split=0)
+        x = ht.array(rng.standard_normal(52), split=0)
+
+        def mixed(qa, qb):
+            data.groupby(k, 4).sum(v)
+            data.topk(x, 3)
+            ht.percentile(x, qa)
+            ht.percentile(x, qb)
+
+        mixed(30.0, 70.0)  # warm: compiles everything once
+        st1 = engine.program_cache().stats()
+        # different percentile q at the same rank count: ranks are traced
+        # inputs, so these are HITS on the same bisection program
+        mixed(41.0, 83.0)
+        st2 = engine.program_cache().stats()
+        assert st2["misses"] == st1["misses"], (st1, st2)
+        assert st2["compiles"] == st1["compiles"], (st1, st2)
+        assert st2["hits"] > st1["hits"]
+
+    def test_dispatch_counters_tick(self):
+        before = data.stats()
+        rng = np.random.default_rng(52)
+        x = ht.array(rng.standard_normal(36), split=0)
+        data.topk(x, 2)
+        after = data.stats()
+        assert after["topk_calls"] == before["topk_calls"] + 1
+        assert after["dispatches"] >= before["dispatches"] + 1
+        assert after["exchange_fallbacks"] == before["exchange_fallbacks"]
+
+
+# --------------------------------------------------------------------- #
+# streaming variants                                                    #
+# --------------------------------------------------------------------- #
+def _chunked(tab, rows):
+    return [ht.array(tab[i:i + rows], split=0)
+            for i in range(0, len(tab), rows)]
+
+
+class TestStreaming:
+    @pytest.mark.parametrize("op", ops.AGGS)
+    def test_stream_groupby_matches_in_memory(self, op):
+        rng = np.random.default_rng(61)
+        n, G = 200, 6
+        tab = np.stack([rng.integers(0, G, n).astype(np.float64),
+                        rng.standard_normal(n)], axis=1)
+        res = data.stream_groupby(_chunked(tab, 48), G, op)  # uneven tail
+        k = tab[:, 0].astype(np.int64)
+        ref = _np_groupby(k, None if op == "count" else tab[:, 1], G, op)
+        np.testing.assert_allclose(res.numpy(), ref, rtol=1e-12, atol=0)
+
+    def test_stream_topk_matches_in_memory(self):
+        rng = np.random.default_rng(62)
+        x = rng.standard_normal(300)
+        sv, sp = data.stream_topk(_chunked(x, 64), 5)
+        mv, mp = data.topk(ht.array(x, split=0), 5)
+        np.testing.assert_array_equal(sv.numpy(), mv.numpy())
+        np.testing.assert_array_equal(sp.numpy(), mp.numpy())
+
+    @pytest.mark.parametrize("dtype", ["float64", "float32", "int32"])
+    def test_stream_quantile_selects_exact_order_statistics(self, dtype):
+        """The multi-pass bisection converges to the same EXACT order
+        statistics the in-memory engine selects: the interpolation-free
+        modes are bit-equal to ``ht.percentile``; linear differs only in
+        the fractional weight (``(n-1)*q`` vs ``(n-1)*q/100`` — one-ulp
+        host arithmetic), never in the selected elements."""
+        rng = np.random.default_rng(63)
+        if dtype.startswith("float"):
+            x = rng.standard_normal(500).astype(dtype)
+        else:
+            x = rng.integers(-10 ** 6, 10 ** 6, 500).astype(dtype)
+        arr = ht.array(x, split=0)
+        for interp in ("lower", "higher", "nearest"):
+            got = data.stream_quantile(_chunked(x, 128),
+                                       [0.1, 0.5, 0.93],
+                                       interpolation=interp)
+            want = ht.percentile(arr, [10.0, 50.0, 93.0],
+                                 interpolation=interp).numpy()
+            np.testing.assert_array_equal(
+                got, np.asarray(want, got.dtype), err_msg=interp)
+        lin = data.stream_quantile(_chunked(x, 128), [0.1, 0.5, 0.93])
+        ref = ht.percentile(arr, [10.0, 50.0, 93.0]).numpy()
+        np.testing.assert_allclose(lin, np.asarray(ref, lin.dtype),
+                                   rtol=1e-6 if dtype == "float32"
+                                   else 1e-13)
+
+    def test_stream_quantile_nan_poisons(self):
+        x = np.arange(64.0)
+        x[13] = np.nan
+        assert np.isnan(data.stream_quantile(_chunked(x, 16), 0.5))
+
+    def test_callable_source_and_steady_state(self):
+        """A zero-arg callable is a valid (re-iterable) source, and equal
+        chunk shapes fold through ONE program — misses stay flat from the
+        second chunk on."""
+        rng = np.random.default_rng(64)
+        x = rng.standard_normal(256)
+
+        def source():
+            return iter(_chunked(x, 64))  # 4 equal chunks
+
+        before = engine.program_cache().stats()["misses"]
+        sv, _ = data.stream_topk(source, 3)
+        missed = engine.program_cache().stats()["misses"] - before
+        assert missed <= 1, missed  # one chunk shape -> one program
+        np.testing.assert_array_equal(sv.numpy(), np.sort(x)[::-1][:3])
+
+    def test_stream_counters_tick(self):
+        before = data.stats()["stream_chunks"]
+        x = np.arange(96.0)
+        data.stream_topk(_chunked(x, 32), 2)
+        assert data.stats()["stream_chunks"] == before + 3
+
+    def test_empty_stream_rejected(self):
+        with pytest.raises(ValueError, match="empty stream"):
+            data.stream_groupby([], 4, "sum")
+        with pytest.raises(ValueError, match="empty stream"):
+            data.stream_quantile([], 0.5)

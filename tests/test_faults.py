@@ -58,6 +58,8 @@ FALLBACK_COUNTERS = (
     "checkpoint.read_retries",
     "checkpoint.corrupt_skipped",
     "init.connect_retries",
+    "data_engine.exchange_fallbacks",
+    "data_engine.stream_fallbacks",
 )
 
 # site -> (workload, documented fallback counter, expected tick count).
@@ -94,6 +96,14 @@ MATRIX = {
     # worker alive; tokens equal the fault-free continuous-batching run
     "serve.decode.step": ("decode", "serve.decode_fallbacks", 1),
     "program_cache.compile": ("serve", "serve.batch_retries", 1),
+    # the faulted first data-engine dispatch (the groupby) degrades to
+    # the eager reference path — identical numerics by construction; the
+    # top-k and percentile that follow run their compiled programs
+    "data.exchange.dispatch": ("data", "data_engine.exchange_fallbacks", 1),
+    # the faulted first chunk's donated carry-fold degrades that chunk
+    # to the eager accumulation merged into the carry (associative) —
+    # the finalized aggregate is identical
+    "data.stream.carry": ("datastream", "data_engine.stream_fallbacks", 1),
     "checkpoint.manifest.write": ("ckpt", "checkpoint.write_retries", 1),
     "checkpoint.leaf.write": ("ckpt", "checkpoint.write_retries", 1),
     "checkpoint.manifest.read": ("ckpt", "checkpoint.read_retries", 1),
@@ -361,6 +371,40 @@ def _wl_decode(tmp_path):
     return {"toks": np.concatenate(outs)}, {}
 
 
+def _wl_data(tmp_path):
+    """Groupby / top-k / percentile burst through the compiled
+    data-engine exchange programs (data/engine.py::engine_call).
+    ``nth:1`` degrades the FIRST dispatch — the groupby — to the eager
+    per-op reference, which is value-identical by construction; the
+    remaining ops run their compiled programs fault-free."""
+    from heat_tpu import data as htdata
+
+    rng = np.random.default_rng(23)
+    keys = ht.array(rng.integers(0, 5, 40).astype(np.int64), split=0)
+    vals = ht.array(rng.standard_normal(40), split=0)
+    g = htdata.groupby(keys, 5).sum(vals)
+    tv, ti = htdata.topk(vals, 4)
+    p = ht.percentile(vals, 35.0)
+    return {"g": g.numpy(), "tv": tv.numpy(), "ti": ti.numpy(),
+            "p": np.asarray(p.numpy())}, {}
+
+
+def _wl_datastream(tmp_path):
+    """Out-of-core groupby fold over an in-memory chunk list through the
+    donated carry-state executables (data/streaming.py). ``nth:1``
+    degrades the FIRST chunk to the eager accumulation merged into the
+    carry (the fold is associative) — the finalized per-group sums are
+    identical."""
+    from heat_tpu import data as htdata
+
+    rng = np.random.default_rng(29)
+    tab = np.stack([rng.integers(0, 4, 48).astype(np.float64),
+                    rng.standard_normal(48)], axis=1)
+    chunks = [ht.array(tab[i:i + 16], split=0) for i in range(0, 48, 16)]
+    g = htdata.stream_groupby(chunks, 4, "sum")
+    return {"g": g.numpy()}, {}
+
+
 def _wl_ckpt(tmp_path):
     """Save two steps, restore the newest — the full manifest+leaf
     write/read cycle."""
@@ -397,6 +441,7 @@ _WORKLOADS = {"ops": _wl_ops, "train": _wl_train, "quant": _wl_quant,
               "resplit": _wl_resplit,
               "serve": _wl_serve, "mtserve": _wl_mtserve,
               "decode": _wl_decode,
+              "data": _wl_data, "datastream": _wl_datastream,
               "ckpt": _wl_ckpt, "init": _wl_init}
 
 _BASELINES: dict = {}  # workload name -> fault-free payload (per session)

@@ -21,7 +21,8 @@ from heat_tpu.serve import (Pow2Buckets, ServeConfig, ServeMetrics,
 from heat_tpu.utils.program_cache import ProgramCache
 
 # ---- the pinned schema: EXACT key sets per level ---------------------- #
-TOP_KEYS = {"serve", "resharding", "op_engine", "faults", "counters"}
+TOP_KEYS = {"serve", "resharding", "op_engine", "data_engine", "faults",
+            "counters"}
 
 SERVE_KEYS = {"requests", "batches", "rows", "padded_rows", "shed",
               "deadline_expired", "early_shed", "rate_limited",
@@ -65,6 +66,13 @@ FUSION_KEYS = {
 
 FAULTS_KEYS = {"armed", "plan", "sites", "arms", "total_fires", "fires"}
 
+# the tape-compiled data engine's pinned figure set (data/engine.py
+# stats() — the ISSUE 17 shape contract; doc/data_engine.md)
+DATA_ENGINE_KEYS = {"enabled", "dispatches", "exchange_fallbacks",
+                    "stream_chunks", "stream_fallbacks", "groupby_calls",
+                    "topk_calls", "quantile_calls", "join_calls",
+                    "program_cache"}
+
 PROGRAM_CACHE_KEYS = set(ProgramCache.STATS_KEYS)
 
 
@@ -95,6 +103,11 @@ def test_runtime_stats_schema_pinned():
     assert set(rt["op_engine"]["fusion"]) == FUSION_KEYS
     assert set(rt["op_engine"]["fusion"]["program_cache"]) == \
         PROGRAM_CACHE_KEYS
+    assert set(rt["data_engine"]) == DATA_ENGINE_KEYS
+    assert set(rt["data_engine"]["program_cache"]) == PROGRAM_CACHE_KEYS
+    assert isinstance(rt["data_engine"]["enabled"], bool)
+    for k in DATA_ENGINE_KEYS - {"enabled", "program_cache"}:
+        assert isinstance(rt["data_engine"][k], int), k
     assert set(rt["faults"]) == FAULTS_KEYS
     assert isinstance(rt["counters"], dict)
 
